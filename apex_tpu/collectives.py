@@ -1,0 +1,111 @@
+"""The NCCL-equivalent: XLA collectives on named mesh axes.
+
+The reference rides torch.distributed/NCCL for every collective
+(apex/parallel/distributed.py all_reduce buckets,
+apex/transformer/tensor_parallel/mappings.py TP collectives,
+apex/transformer/pipeline_parallel/p2p_communication.py isend/irecv,
+apex/contrib/csrc/nccl_p2p/ raw rings). On TPU all of those map onto XLA
+collectives over ICI/DCN, addressed by mesh axis *name* inside
+``jax.shard_map``/``pjit`` rather than by process group.
+
+These wrappers are intentionally thin — the value is a single place that fixes
+naming, axis conventions, and tiled-vs-concat semantics, mirroring the role of
+the reference's ``flat_dist_call`` (apex/parallel/distributed.py:~30).
+
+All functions must be called inside ``shard_map`` (or a ``pjit`` body with
+manual axes) where ``axis_name`` is bound.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def all_reduce(x, axis_name: AxisName = "data", op: str = "sum"):
+    """NCCL allreduce equivalent (reference: torch.distributed.all_reduce)."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unsupported reduce op: {op}")
+
+
+def all_gather(x, axis_name: AxisName = "model", axis: int = 0, tiled: bool = True):
+    """NCCL allgather equivalent; ``tiled=True`` concatenates along ``axis``
+    (the reference's gather semantics in
+    apex/transformer/tensor_parallel/mappings.py:_GatherFromModelParallelRegion)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: AxisName = "model", axis: int = 0, tiled: bool = True):
+    """NCCL reduce-scatter equivalent (reference:
+    mappings.py:_ReduceScatterToSequenceParallelRegion)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+
+def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int, tiled: bool = True):
+    """NCCL alltoall equivalent (no direct reference use; needed for
+    Ulysses-style sequence parallelism — beyond-reference capability)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def broadcast(x, axis_name: AxisName, src_index: int = 0):
+    """NCCL broadcast equivalent (reference: flat_dist_call broadcast of
+    params rank0 → all in apex/parallel/distributed.py:__init__).
+
+    Implemented as select-then-psum so it works under SPMD.
+    """
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src_index, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def permute(x, axis_name: AxisName, perm: Sequence[tuple]):
+    """collective-permute (reference: NCCL send/recv rings in
+    apex/contrib/csrc/nccl_p2p/nccl_p2p_cuda.cu)."""
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def shift_right(x, axis_name: AxisName, wrap: bool = False):
+    """Send to rank+1 / receive from rank-1 along ``axis_name`` — the pipeline
+    ``send_forward``/``recv_forward`` pair
+    (reference: pipeline_parallel/p2p_communication.py:send_forward).
+
+    With ``wrap=False`` the first rank receives zeros (matching "no previous
+    stage" semantics).
+    """
+    n = lax.axis_size(axis_name)
+    if wrap:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    else:
+        perm = [(i, i + 1) for i in range(n - 1)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def shift_left(x, axis_name: AxisName, wrap: bool = False):
+    """Send to rank-1 / receive from rank+1 — the ``send_backward`` pair
+    (reference: p2p_communication.py:send_backward)."""
+    n = lax.axis_size(axis_name)
+    if wrap:
+        perm = [(i, (i - 1) % n) for i in range(n)]
+    else:
+        perm = [(i, i - 1) for i in range(1, n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def axis_index(axis_name: AxisName):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: AxisName) -> int:
+    return lax.axis_size(axis_name)

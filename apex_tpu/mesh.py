@@ -1,0 +1,114 @@
+"""Global device-mesh management.
+
+The reference (apex/transformer/parallel_state.py:~100-600) tracks NCCL process
+groups for tensor/pipeline/data parallelism, enumerated over
+``world_size = dp * pp * tp`` with tp varying fastest. On TPU the same role is
+played by ONE ``jax.sharding.Mesh`` with named axes — collectives are emitted by
+XLA against axis names rather than process-group handles.
+
+Axis convention (used across the whole package):
+
+    ``data``   — data parallel (reference: _DATA_PARALLEL_GROUP)
+    ``stage``  — pipeline parallel (reference: _PIPELINE_MODEL_PARALLEL_GROUP)
+    ``model``  — tensor parallel (reference: _TENSOR_MODEL_PARALLEL_GROUP)
+    ``context``— sequence/context parallel for ring attention (beyond reference;
+                 the reference has no context parallelism — SURVEY.md §2.4)
+
+Device order is TPU-first, not a copy of the reference's rank enumeration
+(which is tp fastest, dp middle, pp slowest): here ``model`` varies fastest so
+TP peers sit on adjacent devices (latency-critical per-layer collectives ride
+shortest ICI hops), ``stage`` next so pipeline neighbors are also close
+(ppermute activations), and ``data`` slowest — DP gradient all-reduce is
+bandwidth-heavy but latency-tolerant, so it can take the long hops/DCN.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+STAGE_AXIS = "stage"
+MODEL_AXIS = "model"
+CONTEXT_AXIS = "context"
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes of each parallelism axis. -1 for ``data`` means "fill"."""
+
+    data: int = -1
+    stage: int = 1
+    model: int = 1
+    context: int = 1
+
+
+def build_mesh(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global 4-axis mesh (data, stage, context, model).
+
+    Mirrors ``initialize_model_parallel(tp, pp)`` from the reference
+    (apex/transformer/parallel_state.py) but returns a Mesh instead of
+    mutating process-group globals.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    tp = tensor_model_parallel_size
+    pp = pipeline_model_parallel_size
+    cp = context_parallel_size
+    denom = tp * pp * cp
+    if n % denom != 0:
+        raise RuntimeError(
+            f"device count {n} is not divisible by tp({tp}) * pp({pp}) * cp({cp})"
+        )
+    dp = n // denom
+    dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+    return Mesh(dev_array, axis_names=(DATA_AXIS, STAGE_AXIS, CONTEXT_AXIS, MODEL_AXIS))
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Mesh:
+    if _GLOBAL_MESH is None:
+        raise RuntimeError(
+            "global mesh is not initialized; call "
+            "apex_tpu.transformer.parallel_state.initialize_model_parallel() "
+            "or apex_tpu.mesh.set_global_mesh() first"
+        )
+    return _GLOBAL_MESH
+
+
+def maybe_global_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
+
+@contextlib.contextmanager
+def global_mesh(mesh: Mesh):
+    """Context manager installing ``mesh`` as the global mesh."""
+    prev = _GLOBAL_MESH
+    set_global_mesh(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        set_global_mesh(prev)
+
+
+def sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    """NamedSharding on the global (or given) mesh for a PartitionSpec."""
+    m = mesh if mesh is not None else get_global_mesh()
+    return NamedSharding(m, PartitionSpec(*spec))

@@ -1,0 +1,11 @@
+"""Megatron-style model parallelism on a named TPU mesh.
+
+Reference: apex/transformer/ — parallel_state process groups, tensor_parallel
+layers/mappings/cross_entropy/random, pipeline_parallel schedules,
+functional.FusedScaleMaskSoftmax. Rebuilt here over jax.shard_map + XLA
+collectives (SURVEY.md §2.4).
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

@@ -1,0 +1,14 @@
+"""Logging helpers mirroring apex/transformer/log_util.py."""
+
+import logging
+
+_LOGGER_NAME = "apex_tpu"
+
+
+def get_transformer_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def set_logging_level(verbosity) -> None:
+    """Reference: apex/transformer/log_util.py:set_logging_level."""
+    logging.getLogger(_LOGGER_NAME).setLevel(verbosity)

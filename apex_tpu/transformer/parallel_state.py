@@ -1,0 +1,204 @@
+"""Model-parallel state: the apex ``parallel_state`` API over one jax Mesh.
+
+Reference: apex/transformer/parallel_state.py:~100-600 —
+``initialize_model_parallel(tp, pp, vpp, pp_split_rank)`` builds NCCL process
+groups (_TENSOR_MODEL_PARALLEL_GROUP, _PIPELINE_MODEL_PARALLEL_GROUP,
+_DATA_PARALLEL_GROUP, _EMBEDDING_GROUP) with rank order tp-fastest, then pp,
+then dp, plus rank/world-size/is-first/last-stage queries.
+
+TPU design: one global ``jax.sharding.Mesh`` with axes
+``('data', 'stage', 'context', 'model')`` replaces every process group; a
+"group" IS a mesh axis name. World-size queries read the mesh shape on the
+host. Rank queries come in two flavors:
+
+- ``get_*_rank()`` — valid **inside** ``shard_map`` (returns a traced
+  ``lax.axis_index``). This is where per-rank logic lives under SPMD.
+- Host code that needs a static answer (e.g. parameter-shape math) should use
+  the ``*_world_size`` getters, which are static.
+
+``virtual_pipeline_model_parallel`` rank/world-size are process-local Python
+state exactly as in the reference (set by the interleaved schedule loop).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from apex_tpu import mesh as mesh_lib
+from apex_tpu.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS, STAGE_AXIS
+
+# Virtual pipeline state (reference: parallel_state.py virtual pp globals).
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    context_parallel_size_: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build and install the global mesh.
+
+    Mirrors the reference signature (trailing underscores included). Returns
+    the Mesh so callers can also use it directly with ``pjit``/``shard_map``.
+    ``context_parallel_size_`` is a beyond-reference extension (ring
+    attention); the reference has no context parallelism (SURVEY.md §2.4).
+    """
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    m = mesh_lib.build_mesh(
+        tensor_model_parallel_size_,
+        pipeline_model_parallel_size_,
+        context_parallel_size_,
+        devices=devices,
+    )
+    mesh_lib.set_global_mesh(m)
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = virtual_pipeline_model_parallel_size_
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
+    return m
+
+
+def model_parallel_is_initialized() -> bool:
+    return mesh_lib.maybe_global_mesh() is not None
+
+
+def destroy_model_parallel() -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    mesh_lib.set_global_mesh(None)
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
+
+
+# --- "groups" = axis names ----------------------------------------------------
+
+def get_tensor_model_parallel_group() -> str:
+    return MODEL_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    return STAGE_AXIS
+
+
+def get_data_parallel_group() -> str:
+    return DATA_AXIS
+
+
+def get_context_parallel_group() -> str:
+    return CONTEXT_AXIS
+
+
+# --- world sizes (static, from mesh shape) -----------------------------------
+
+def _axis_size(name: str) -> int:
+    return mesh_lib.get_global_mesh().shape[name]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(MODEL_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(STAGE_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
+def get_world_size() -> int:
+    return mesh_lib.get_global_mesh().size
+
+
+# --- ranks (traced; valid inside shard_map) ----------------------------------
+
+def get_tensor_model_parallel_rank():
+    return lax.axis_index(MODEL_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return lax.axis_index(STAGE_AXIS)
+
+
+def get_data_parallel_rank():
+    return lax.axis_index(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return lax.axis_index(CONTEXT_AXIS)
+
+
+def get_tensor_model_parallel_src_rank() -> int:
+    """First rank of the TP group; with a named mesh the "source" is simply
+    index 0 on the ``model`` axis (reference computes a global rank)."""
+    return 0
+
+
+# --- pipeline stage predicates -----------------------------------------------
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (inside shard_map). Reference:
+    parallel_state.py:is_pipeline_first_stage."""
+    if not ignore_virtual:
+        vr = get_virtual_pipeline_model_parallel_rank()
+        if vr is not None and vr != 0:
+            return False
+    return lax.axis_index(STAGE_AXIS) == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vr = get_virtual_pipeline_model_parallel_rank()
+        vw = get_virtual_pipeline_model_parallel_world_size()
+        if vr is not None and vw is not None and vr != (vw - 1):
+            return False
+    return lax.axis_index(STAGE_AXIS) == get_pipeline_model_parallel_world_size() - 1
+
+
+# --- virtual pipeline bookkeeping (host-local ints, as in the reference) -----
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def set_virtual_pipeline_model_parallel_world_size(size: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: Optional[int]) -> None:
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = rank
+
+
+def get_mesh() -> Mesh:
+    """TPU-native accessor: the mesh behind all of the above."""
+    return mesh_lib.get_global_mesh()

@@ -1,0 +1,16 @@
+"""Tensor (+sequence) parallelism: Megatron-style layers over shard_map.
+
+Reference: apex/transformer/tensor_parallel/ — layers.py, mappings.py,
+cross_entropy.py, random.py, data.py, utils.py (SURVEY.md §2.4).
+"""
+
+from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.utils import divide, split_tensor_along_last_dim  # noqa: F401

@@ -1,0 +1,152 @@
+"""TP/SP collective regions as differentiable functions.
+
+Reference: apex/transformer/tensor_parallel/mappings.py:~30-250 — the four
+model-parallel regions (_CopyToModelParallelRegion,
+_ReduceFromModelParallelRegion, _ScatterToModelParallelRegion,
+_GatherFromModelParallelRegion) and the three sequence-parallel regions
+(_ScatterToSequenceParallelRegion, _GatherFromSequenceParallelRegion,
+_ReduceScatterToSequenceParallelRegion), each a torch.autograd.Function whose
+forward/backward issue explicit NCCL collectives.
+
+TPU design: the same fwd/bwd collective pairs expressed with ``jax.custom_vjp``
+over XLA collectives. All functions must run inside ``shard_map`` with the
+given axis bound. Scatter/gather for the *model* region act on the LAST dim
+(hidden); sequence-parallel regions act on the FIRST dim (sequence), matching
+the reference.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from apex_tpu import collectives as coll
+from apex_tpu.mesh import MODEL_AXIS
+
+
+def _split_along(x, axis_name, dim):
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = x.shape[dim] // world
+    return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
+
+
+# --- copy: identity fwd / all-reduce bwd -------------------------------------
+
+def copy_to_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_CopyToModelParallelRegion (fwd identity,
+    bwd all-reduce). In JAX this is precisely ``lax.pvary``: it marks the
+    value as varying over the TP axis (identity on data) and its transpose
+    is ``psum`` — the exact fwd/bwd pair of the reference, with correct
+    varying-manual-axes accounting for free."""
+    return lax.pvary(x, axis_name)
+
+
+# --- reduce: all-reduce fwd / identity bwd -----------------------------------
+
+def reduce_from_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_ReduceFromModelParallelRegion. ``lax.psum``'s
+    transpose is ``pvary`` (identity broadcast of the cotangent), matching
+    the reference's backward exactly."""
+    return lax.psum(x, axis_name)
+
+
+# --- scatter (last dim): split fwd / all-gather bwd --------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_ScatterToModelParallelRegion."""
+    return _split_along(x, axis_name, x.ndim - 1)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along(x, axis_name, x.ndim - 1), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (coll.all_gather(g, axis_name, axis=g.ndim - 1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# --- gather (last dim): all-gather fwd / split bwd ---------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_GatherFromModelParallelRegion."""
+    return coll.all_gather(x, axis_name, axis=x.ndim - 1)
+
+
+def _gather_fwd(x, axis_name):
+    return coll.all_gather(x, axis_name, axis=x.ndim - 1), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along(g, axis_name, g.ndim - 1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- sequence-parallel regions (first dim = sequence) ------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_ScatterToSequenceParallelRegion — split the
+    sequence dim at SP-region entry (used by VocabParallelEmbedding output
+    when sequence_parallel_enabled)."""
+    return _split_along(x, axis_name, 0)
+
+
+def _sp_scatter_fwd(x, axis_name):
+    return _split_along(x, axis_name, 0), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (coll.all_gather(g, axis_name, axis=0),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name=MODEL_AXIS, tensor_parallel_output_grad=True):
+    """Reference: mappings.py:_GatherFromSequenceParallelRegion — all-gather
+    sequence shards at TP-region entry. When the consumer is a TP linear
+    (``tensor_parallel_output_grad=True``) the backward is a reduce-scatter;
+    otherwise a plain split."""
+    return coll.all_gather(x, axis_name, axis=0)
+
+
+def _sp_gather_fwd(x, axis_name, tpog):
+    return coll.all_gather(x, axis_name, axis=0), None
+
+
+def _sp_gather_bwd(axis_name, tpog, _, g):
+    if tpog:
+        return (coll.reduce_scatter(g, axis_name, axis=0),)
+    return (_split_along(g, axis_name, 0),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=MODEL_AXIS):
+    """Reference: mappings.py:_ReduceScatterToSequenceParallelRegion — the
+    TP-region exit under sequence parallelism (replaces the all-reduce)."""
+    return coll.reduce_scatter(x, axis_name, axis=0)
+
+
+def _sp_rs_fwd(x, axis_name):
+    return coll.reduce_scatter(x, axis_name, axis=0), None
+
+
+def _sp_rs_bwd(axis_name, _, g):
+    return (coll.all_gather(g, axis_name, axis=0),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

@@ -1,0 +1,44 @@
+"""Utilities mirroring apex/transformer/utils.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Reference: apex/transformer/utils.py:divide."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Reference: apex/transformer/tensor_parallel/utils.py —
+    split along the last dim into equal chunks (returns a tuple)."""
+    last = tensor.shape[-1]
+    chunk = divide(last, num_partitions)
+    return tuple(
+        lax.slice_in_dim(tensor, i * chunk, (i + 1) * chunk, axis=tensor.ndim - 1)
+        for i in range(num_partitions)
+    )
+
+
+def split_tensor_into_1d_equal_chunks(tensor, axis_name: str = "model"):
+    """Flatten and take this rank's 1/world chunk (inside shard_map).
+    Reference: apex/transformer/utils.py:split_tensor_into_1d_equal_chunks."""
+    flat = tensor.reshape(-1)
+    world = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = flat.shape[0] // world
+    return lax.dynamic_slice_in_dim(flat, rank * chunk, chunk)
+
+
+def gather_split_1d_tensor(tensor, axis_name: str = "model"):
+    """Inverse of the above via all-gather.
+    Reference: apex/transformer/utils.py:gather_split_1d_tensor."""
+    return lax.all_gather(tensor, axis_name, axis=0, tiled=True)

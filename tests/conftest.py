@@ -1,0 +1,55 @@
+"""Test harness: 8 virtual CPU devices so mesh/collective tests run anywhere.
+
+This replaces the reference's MultiProcessTestCase/NCCL-over-localhost trick
+(apex/transformer/testing/distributed_test_base.py) with XLA's host-platform
+device-count override — strictly better: no accelerator needed at all
+(SURVEY.md §4 closing note).
+
+Must run before jax initializes its backends, hence module-level env mutation
+in conftest (pytest imports conftest before test modules).
+"""
+
+import os
+
+# Force CPU even when the session env pins a TPU platform (JAX_PLATFORMS=axon):
+# unit tests exercise numerics + mesh semantics on 8 virtual CPU devices;
+# bench.py is what runs on the real chip. The env may import jax before this
+# file runs (sitecustomize), so set jax.config directly rather than env vars.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_parallel_state():
+    """Tear down global mesh state between tests (reference:
+    destroy_model_parallel in test teardowns)."""
+    yield
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+
+
+@pytest.fixture
+def mesh8():
+    """data=8 mesh."""
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(1, 1)
+
+
+@pytest.fixture
+def mesh_tp2_pp2_dp2():
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(2, 2)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

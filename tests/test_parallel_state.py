@@ -1,0 +1,58 @@
+"""Mirrors tests/L0/run_transformer/test_parallel_state.py from the reference."""
+
+import jax
+import pytest
+
+from apex_tpu import mesh as mesh_lib
+from apex_tpu.transformer import parallel_state
+
+
+def test_initialize_and_query():
+    m = parallel_state.initialize_model_parallel(2, 2)
+    assert parallel_state.model_parallel_is_initialized()
+    assert parallel_state.get_tensor_model_parallel_world_size() == 2
+    assert parallel_state.get_pipeline_model_parallel_world_size() == 2
+    assert parallel_state.get_data_parallel_world_size() == 2
+    assert parallel_state.get_world_size() == 8
+    assert m.axis_names == ("data", "stage", "context", "model")
+
+
+def test_invalid_sizes_raise():
+    with pytest.raises(RuntimeError):
+        parallel_state.initialize_model_parallel(3, 1)  # 8 % 3 != 0
+
+
+def test_destroy():
+    parallel_state.initialize_model_parallel(1, 1)
+    parallel_state.destroy_model_parallel()
+    assert not parallel_state.model_parallel_is_initialized()
+    with pytest.raises(RuntimeError):
+        parallel_state.get_tensor_model_parallel_world_size()
+
+
+def test_virtual_pipeline_state():
+    parallel_state.initialize_model_parallel(1, 2, virtual_pipeline_model_parallel_size_=2)
+    assert parallel_state.get_virtual_pipeline_model_parallel_world_size() == 2
+    parallel_state.set_virtual_pipeline_model_parallel_rank(1)
+    assert parallel_state.get_virtual_pipeline_model_parallel_rank() == 1
+
+
+def test_ranks_inside_shard_map():
+    import jax.numpy as jnp
+    import numpy as np
+
+    m = parallel_state.initialize_model_parallel(2, 1)
+
+    def f(x):
+        tp_rank = parallel_state.get_tensor_model_parallel_rank()
+        return x + tp_rank
+
+    from jax.sharding import PartitionSpec as P
+
+    out = jax.shard_map(
+        f,
+        mesh=m,
+        in_specs=P("model"),
+        out_specs=P("model"),
+    )(jnp.zeros((2,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out), [0, 1])
