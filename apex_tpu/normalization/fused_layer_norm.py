@@ -1,0 +1,125 @@
+"""FusedLayerNorm / FusedRMSNorm modules over the Pallas kernels.
+
+Reference: apex/normalization/fused_layer_norm.py:~30-400 — nn.Modules
+``FusedLayerNorm``/``FusedRMSNorm`` (+ ``MixedFused*`` variants that keep
+params fp32 under fp16/bf16 inputs) and the functional entry points
+``fused_layer_norm_affine`` etc. Here the modules are flax.linen Modules and
+the functionals call the Pallas custom-vjp ops in apex_tpu/ops/layer_norm.py.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import layer_norm as _ln_op
+from apex_tpu.ops import rms_norm as _rms_op
+
+Shape = Union[int, Sequence[int]]
+
+
+def _norm_size(normalized_shape: Shape) -> tuple:
+    if isinstance(normalized_shape, numbers.Integral):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+def _flatten_norm_dims(x, normalized_shape):
+    """Collapse the trailing normalized dims into one (the kernel normalizes
+    the last dim; apex supports multi-dim normalized_shape)."""
+    nd = len(normalized_shape)
+    if tuple(x.shape[x.ndim - nd:]) != tuple(normalized_shape):
+        raise ValueError(
+            f"normalized_shape {tuple(normalized_shape)} does not match the "
+            f"trailing dims of input shape {tuple(x.shape)}"
+        )
+    lead = x.shape[: x.ndim - nd]
+    return x.reshape(lead + (-1,)), lead
+
+
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-6, memory_efficient=False):
+    """Reference: apex/normalization/fused_layer_norm.py:fused_layer_norm_affine."""
+    shape = _norm_size(normalized_shape)
+    x2, lead = _flatten_norm_dims(x, shape)
+    y = _ln_op(x2, weight.reshape(-1), bias.reshape(-1), eps, memory_efficient)
+    return y.reshape(x.shape)
+
+
+def fused_layer_norm(x, normalized_shape, eps=1e-6, memory_efficient=False):
+    shape = _norm_size(normalized_shape)
+    x2, _ = _flatten_norm_dims(x, shape)
+    return _ln_op(x2, None, None, eps, memory_efficient).reshape(x.shape)
+
+
+def fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-6, memory_efficient=False):
+    shape = _norm_size(normalized_shape)
+    x2, _ = _flatten_norm_dims(x, shape)
+    return _rms_op(x2, weight.reshape(-1), eps, memory_efficient).reshape(x.shape)
+
+
+def fused_rms_norm(x, normalized_shape, eps=1e-6, memory_efficient=False):
+    shape = _norm_size(normalized_shape)
+    x2, _ = _flatten_norm_dims(x, shape)
+    return _rms_op(x2, None, eps, memory_efficient).reshape(x.shape)
+
+
+# "Mixed dtype" functionals: params stay fp32 while activations are 16-bit
+# (reference: mixed_dtype_fused_layer_norm_affine / MixedFusedLayerNorm).
+# The kernel always accumulates fp32, so these are the same entry points; the
+# distinction survives in module param dtypes below.
+mixed_dtype_fused_layer_norm_affine = fused_layer_norm_affine
+mixed_dtype_fused_rms_norm_affine = fused_rms_norm_affine
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for apex.normalization.FusedLayerNorm (fused_layer_norm.py:~300).
+
+    Args mirror the reference: ``normalized_shape``, ``eps``,
+    ``elementwise_affine``, ``memory_efficient``.
+    """
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_size(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+            return fused_layer_norm_affine(
+                x, weight, bias, shape, self.eps, self.memory_efficient
+            )
+        return fused_layer_norm(x, shape, self.eps, self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    """Drop-in for apex.normalization.FusedRMSNorm."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _norm_size(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+            return fused_rms_norm_affine(x, weight, shape, self.eps, self.memory_efficient)
+        return fused_rms_norm(x, shape, self.eps, self.memory_efficient)
+
+
+# The reference's Mixed* classes differ only in keeping fp32 params under
+# 16-bit activations — which is already this module's default (param_dtype
+# fp32), so they are aliases kept for API parity.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

@@ -1,0 +1,8 @@
+"""The kernel layer — TPU-native equivalent of the reference's ``csrc/``.
+
+Every op here is a pure function with a ``jax.custom_vjp`` backed by Pallas
+TPU kernels (compiled via Mosaic on TPU; interpret mode off-TPU so the same
+code paths are unit-testable on CPU). Reference mapping in SURVEY.md §2.2.
+"""
+
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm  # noqa: F401

@@ -1,0 +1,35 @@
+"""Backend dispatch for Pallas kernels.
+
+Kernels compile via Mosaic on TPU. Off-TPU (CPU tests, debugging) the same
+kernels run through the Pallas interpreter so numerics tests cover the real
+kernel code, not a separate fallback — replacing the reference's
+"skip-if-extension-not-built" gating (apex/contrib/test SkipTestCase) with
+run-everywhere kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+
+@functools.cache
+def _backend() -> str:
+    return jax.default_backend()
+
+
+def interpret() -> bool:
+    """True when pallas_call must run in interpreter mode (non-TPU backend)."""
+    if os.environ.get("APEX_TPU_FORCE_INTERPRET") == "1":
+        return True
+    return _backend() != "tpu"
+
+
+def round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b

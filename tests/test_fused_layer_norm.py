@@ -1,0 +1,140 @@
+"""Parity tests for the Pallas LayerNorm/RMSNorm kernels vs pure-jnp reference.
+
+Mirrors tests/L0/run_fused_layer_norm/test_fused_layer_norm.py from the
+reference: fused module vs framework-native reference across
+dtype × shape × affine × memory_efficient grids, fwd and bwd.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import layer_norm, rms_norm
+
+
+def ref_layer_norm(x, w=None, b=None, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    if b is not None:
+        y = y + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def ref_rms_norm(x, w=None, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    ms = (xf * xf).mean(-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(ms + eps)
+    if w is not None:
+        y = y * w.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(rtol=2e-5, atol=2e-5)
+
+
+SHAPES = [(4, 64), (3, 5, 128), (16, 1024), (13, 257)]  # incl. ragged/row-odd
+DTYPES = [jnp.float32, jnp.bfloat16]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_layer_norm_affine_forward(shape, dtype, memory_efficient):
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, shape, dtype)
+    w = jax.random.normal(jax.random.PRNGKey(1), shape[-1:], jnp.float32) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(2), shape[-1:], jnp.float32)
+    got = layer_norm(x, w, b, 1e-5, memory_efficient)
+    want = ref_layer_norm(x, w, b)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), **_tol(dtype)
+    )
+
+
+@pytest.mark.parametrize("shape", [(4, 64), (13, 257)])
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_layer_norm_affine_grads(shape, memory_efficient):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape)
+    w = jax.random.normal(jax.random.PRNGKey(1), shape[-1:]) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(2), shape[-1:])
+
+    def loss_fused(x, w, b):
+        return (layer_norm(x, w, b, 1e-5, memory_efficient) ** 2).sum()
+
+    def loss_ref(x, w, b):
+        return (ref_layer_norm(x, w, b) ** 2).sum()
+
+    g = jax.grad(loss_fused, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, e, name in zip(g, gr, "x w b".split()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_layer_norm_no_affine():
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 96))
+    got = layer_norm(x)
+    want = ref_layer_norm(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+    g = jax.grad(lambda v: (layer_norm(v) ** 2).sum())(x)
+    gr = jax.grad(lambda v: (ref_layer_norm(v) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("shape", [(4, 64), (13, 257)])
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_rms_norm_affine(shape, dtype, memory_efficient):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape, dtype)
+    w = jax.random.normal(jax.random.PRNGKey(1), shape[-1:], jnp.float32) + 1.0
+    got = rms_norm(x, w, 1e-5, memory_efficient)
+    want = ref_rms_norm(x, w)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), **_tol(dtype)
+    )
+
+
+@pytest.mark.parametrize("memory_efficient", [False, True])
+def test_rms_norm_grads(memory_efficient):
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, 128))
+    w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+    g = jax.grad(lambda x, w: (rms_norm(x, w, 1e-5, memory_efficient) ** 2).sum(), (0, 1))(x, w)
+    gr = jax.grad(lambda x, w: (ref_rms_norm(x, w) ** 2).sum(), (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(gr[0]), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g[1]), np.asarray(gr[1]), rtol=1e-4, atol=1e-4)
+
+
+def test_module_api():
+    """FusedLayerNorm / FusedRMSNorm flax modules (reference class API)."""
+    from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 7, 64))
+    m = FusedLayerNorm(normalized_shape=64)
+    params = m.init(jax.random.PRNGKey(1), x)
+    y = m.apply(params, x)
+    want = ref_layer_norm(x, params["params"]["weight"], params["params"]["bias"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+    r = FusedRMSNorm(normalized_shape=64, elementwise_affine=False)
+    yr = r.apply(r.init(jax.random.PRNGKey(2), x), x)
+    np.testing.assert_allclose(np.asarray(yr), np.asarray(ref_rms_norm(x)), rtol=2e-5, atol=2e-5)
+
+
+def test_multidim_normalized_shape():
+    """apex supports normalized_shape spanning multiple trailing dims."""
+    from apex_tpu.normalization import fused_layer_norm_affine
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 4, 8))
+    w = jnp.ones((4, 8))
+    b = jnp.zeros((4, 8))
+    y = fused_layer_norm_affine(x, w, b, (4, 8))
+    want = ref_layer_norm(x.reshape(3, 32)).reshape(3, 4, 8)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=2e-5, atol=2e-5)
