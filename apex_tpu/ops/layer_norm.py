@@ -158,11 +158,13 @@ def _ln_bwd_kernel(dy_ref, xhat_src_ref, mean_ref, rstd_ref, w_ref, b_ref,
         mean = mean_ref[...] if not rms else 0.0
         xhat = (src - mean) * rstd
 
-    # mask padded rows so dw/db partials are exact on ragged final tiles
+    # mask padded rows so dw/db partials are exact on ragged final tiles;
+    # where-select, not multiply: OOB rows hold unspecified memory and
+    # 0 * NaN = NaN would poison the cross-row dgamma/dbeta reduction
     row_ids = lax.broadcasted_iota(jnp.int32, dy.shape, 0) + i * tile
-    valid = (row_ids < n_rows).astype(jnp.float32)
-    dy = dy * valid
-    xhat = xhat * valid
+    valid = row_ids < n_rows
+    dy = jnp.where(valid, dy, 0.0)
+    xhat = jnp.where(valid, xhat, 0.0)
 
     wdy = dy * w
     c1 = jnp.mean(xhat * wdy, axis=-1, keepdims=True)

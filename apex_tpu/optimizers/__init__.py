@@ -1,0 +1,22 @@
+"""Fused optimizers (reference: apex/optimizers/__init__.py).
+
+Torch-like classes over flat-buffer Pallas update kernels, plus optax-style
+pure transforms (``adam``/``lamb``/``sgd``/``novograd``) for idiomatic JAX
+training loops.
+"""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.transforms import (  # noqa: F401
+    fused_adam,
+    fused_lamb,
+    fused_novograd,
+    fused_sgd,
+)
+
+# reference: apex/optimizers/fused_mixed_precision_lamb.py — LAMB variant whose
+# state/master handling is mixed precision; our FusedLAMB already keeps fp32
+# masters over arbitrary-dtype params, so it is the same class here.
+FusedMixedPrecisionLamb = FusedLAMB

@@ -1,0 +1,141 @@
+"""Shared machinery for the fused optimizer facades.
+
+The reference optimizers (apex/optimizers/fused_adam.py etc.) are
+``torch.optim.Optimizer`` subclasses that mutate ``p.data`` in place via
+multi-tensor CUDA launches. JAX state is immutable, so the facade here:
+
+- holds the fp32 **master copy** of all parameters as ONE flat buffer
+  (amp-O2-style master weights are therefore the default, as in apex when
+  driven by amp), plus flat optimizer state buffers;
+- ``step(grads)`` flattens the incoming grad pytree (one fused concat),
+  runs the Pallas update kernel(s), and returns the updated params unflattened
+  into the original dtypes/shapes;
+- the whole step is jitted once with donated state buffers — zero reallocation
+  per step.
+
+Weight-decay masks (apex param_groups with wd=0 on bias/LayerNorm) are
+expressed as a predicate over pytree paths mapped to a per-segment wd vector.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flat_buffer
+from apex_tpu.ops.flat_buffer import LANE, FlatSpec, build_spec
+
+
+def path_name(path) -> str:
+    """'/'-joined key path for a pytree leaf (for wd-exclusion predicates)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class FusedOptimizerBase:
+    """Common state handling for FusedAdam/FusedLAMB/FusedSGD/FusedNovoGrad."""
+
+    #: names of flat (rows, LANE) fp32 state buffers, e.g. ("m", "v")
+    STATE_BUFFERS: tuple = ()
+
+    def __init__(self, params, defaults: dict,
+                 exclude_from_weight_decay: Optional[Callable[[str], bool]] = None):
+        self.defaults = dict(defaults)
+        self.spec: FlatSpec = build_spec(params)
+        self.seg_rows = jnp.asarray(self.spec.segment_rows())
+        self.master = flat_buffer.flatten(params, self.spec)
+        self.state = {
+            name: jnp.zeros((self.spec.total_rows, LANE), jnp.float32)
+            for name in self.STATE_BUFFERS
+        }
+        self.step_count = jnp.zeros((), jnp.int32)
+
+        wd = float(self.defaults.get("weight_decay", 0.0))
+        if exclude_from_weight_decay is not None:
+            paths, _ = jax.tree_util.tree_flatten_with_path(params)
+            wd_list = [
+                0.0 if exclude_from_weight_decay(path_name(p)) else wd
+                for p, _ in paths
+            ]
+            self.wd_per_segment = jnp.asarray(wd_list, jnp.float32)
+        else:
+            self.wd_per_segment = None
+        self._jit_step = None
+
+    # -- torch-API parity shims ------------------------------------------------
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op: JAX grads are values, not buffers (kept for API parity)."""
+
+    @property
+    def param_groups(self):
+        """Minimal parity: one group carrying the defaults."""
+        return [dict(self.defaults, params=None)]
+
+    # -- state dict ------------------------------------------------------------
+    def state_dict(self):
+        return {
+            "master": self.master,
+            "state": dict(self.state),
+            "step": self.step_count,
+            "defaults": dict(self.defaults),
+        }
+
+    def load_state_dict(self, sd):
+        self.master = jnp.asarray(sd["master"])
+        self.state = {k: jnp.asarray(v) for k, v in sd["state"].items()}
+        self.step_count = jnp.asarray(sd["step"])
+        self.defaults.update(sd.get("defaults", {}))
+
+    # -- stepping --------------------------------------------------------------
+    def _update(self, g_flat, master, state, step, hyper):
+        """Pure update: returns (new_master, new_state). Implemented by
+        subclasses via the Pallas kernels."""
+        raise NotImplementedError
+
+    def step(self, grads, grad_scale=None, noop=None):
+        """Apply one optimizer step for the given grad pytree; returns the
+        updated parameter pytree (original shapes/dtypes).
+
+        ``grad_scale`` multiplies grads inside the kernel (amp unscale + clip
+        folded in); ``noop`` (0/1) skips the step (dynamic-loss-scale
+        overflow), matching the reference's noop_flag semantics.
+        """
+        gdef = jax.tree.structure(grads)
+        if gdef != self.spec.treedef:
+            raise ValueError(
+                f"grad pytree structure {gdef} does not match the parameter "
+                f"structure this optimizer was built with ({self.spec.treedef})"
+            )
+        if self._jit_step is None:
+            spec = self.spec
+
+            def _pure(g_tree, master, state, step, hyper, gs, noop_):
+                g_flat = flat_buffer.flatten(g_tree, spec)
+                new_master, new_state = self._update(
+                    g_flat, master, state, step + 1, dict(hyper, grad_scale=gs, noop=noop_)
+                )
+                params = flat_buffer.unflatten(new_master, spec)
+                return params, new_master, new_state, step + 1
+
+            self._jit_step = jax.jit(_pure, donate_argnums=(1, 2))
+
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in self.defaults.items()
+                 if isinstance(v, (int, float))}
+        gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
+        noop_ = jnp.asarray(0.0 if noop is None else noop, jnp.float32)
+        params, self.master, self.state, self.step_count = self._jit_step(
+            grads, self.master, self.state, self.step_count, hyper, gs, noop_
+        )
+        return params

@@ -1,0 +1,42 @@
+"""FusedAdam — reference: apex/optimizers/fused_adam.py:~15.
+
+Same knobs as the reference ctor (lr, bias_correction, betas, eps,
+adam_w_mode, weight_decay, amsgrad unsupported — reference raises too).
+One Pallas launch updates every parameter (csrc/multi_tensor_adam.cu analog).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optim_kernels
+from apex_tpu.optimizers.common import FusedOptimizerBase
+
+
+class FusedAdam(FusedOptimizerBase):
+    STATE_BUFFERS = ("m", "v")
+
+    def __init__(self, params, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False,
+                 set_grad_none=True, exclude_from_weight_decay=None):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        defaults = dict(lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+                        weight_decay=weight_decay)
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        super().__init__(params, defaults,
+                         exclude_from_weight_decay=exclude_from_weight_decay)
+
+    def _update(self, g_flat, master, state, step, hyper):
+        wd = self.wd_per_segment if self.wd_per_segment is not None else hyper["weight_decay"]
+        p, m, v = optim_kernels.adam_update(
+            g_flat, master, state["m"], state["v"],
+            beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
+            weight_decay=wd, lr=hyper["lr"],
+            step=step, grad_scale=hyper.get("grad_scale"),
+            noop=hyper.get("noop"),
+            adam_w_mode=self.adam_w_mode, bias_correction=self.bias_correction,
+            seg_rows=self.seg_rows, num_segments=self.spec.num_tensors,
+        )
+        return p, dict(m=m, v=v)

@@ -1,0 +1,65 @@
+"""FusedLAMB — reference: apex/optimizers/fused_lamb.py:~15.
+
+Two fused Pallas phases (direction + per-tensor norms; trust-ratio apply),
+mirroring csrc/multi_tensor_lamb.cu. Global-grad-norm clipping
+(``max_grad_norm``) is folded into the grad scale, computed by the fused
+stats pass (csrc/multi_tensor_l2norm_kernel.cu analog). Per-tensor
+weight-decay exclusion replaces the reference's param groups.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optim_kernels
+from apex_tpu.optimizers.common import FusedOptimizerBase
+
+
+class FusedLAMB(FusedOptimizerBase):
+    STATE_BUFFERS = ("m", "v")
+
+    def __init__(self, params, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-6, weight_decay=0.01, amsgrad=False, adam_w_mode=True,
+                 grad_averaging=True, set_grad_none=True, max_grad_norm=1.0,
+                 use_nvlamb=False, exclude_from_weight_decay=None):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        if not adam_w_mode:
+            raise NotImplementedError("FusedLAMB: only adam_w_mode=True is implemented "
+                                      "(reference default).")
+        defaults = dict(lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+                        weight_decay=weight_decay, max_grad_norm=max_grad_norm)
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.use_nvlamb = use_nvlamb
+        super().__init__(params, defaults,
+                         exclude_from_weight_decay=exclude_from_weight_decay)
+
+    def _update(self, g_flat, master, state, step, hyper):
+        # fused global grad norm (+ finite check) — one pass over g
+        gnorm, finite, _ = optim_kernels.global_grad_norm_and_finite(
+            g_flat, self.seg_rows, self.spec.num_tensors
+        )
+        gs = hyper.get("grad_scale")
+        gs = jnp.float32(1.0) if gs is None else gs
+        gnorm = gnorm * gs
+        max_norm = hyper["max_grad_norm"]
+        clip = jnp.where(
+            (max_norm > 0.0) & (gnorm > max_norm), max_norm / gnorm, jnp.float32(1.0)
+        )
+        noop = hyper.get("noop")
+        noop = jnp.zeros((), jnp.float32) if noop is None else noop
+        noop = jnp.maximum(noop, 1.0 - finite.astype(jnp.float32))
+
+        wd = self.wd_per_segment if self.wd_per_segment is not None else hyper["weight_decay"]
+        p, m, v = optim_kernels.lamb_update(
+            g_flat, master, state["m"], state["v"],
+            self.seg_rows, self.spec.num_tensors,
+            beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
+            weight_decay=wd, lr=hyper["lr"], step=step,
+            grad_scale=gs * clip, noop=noop,
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging,
+            use_nvlamb=self.use_nvlamb,
+        )
+        return p, dict(m=m, v=v)

@@ -1,0 +1,40 @@
+"""FusedNovoGrad — reference: apex/optimizers/fused_novograd.py
+(csrc/multi_tensor_novograd.cu analog: per-tensor second moments)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import optim_kernels
+from apex_tpu.optimizers.common import FusedOptimizerBase
+
+
+class FusedNovoGrad(FusedOptimizerBase):
+    STATE_BUFFERS = ("m",)
+
+    def __init__(self, params, lr=1e-3, bias_correction=True, betas=(0.95, 0.98),
+                 eps=1e-8, weight_decay=0.0, amsgrad=False, reg_inside_moment=False,
+                 grad_averaging=True, norm_type=2, init_zero=False,
+                 set_grad_none=True):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        if norm_type != 2:
+            raise ValueError("FusedNovoGrad only supports norm_type=2")
+        defaults = dict(lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+                        weight_decay=weight_decay)
+        self.init_zero = init_zero
+        self.grad_averaging = grad_averaging
+        super().__init__(params, defaults)
+        # per-tensor second moment (one float per tensor, as in the reference)
+        self.state["v_per_tensor"] = jnp.zeros((self.spec.num_tensors,), jnp.float32)
+
+    def _update(self, g_flat, master, state, step, hyper):
+        p, m, v = optim_kernels.novograd_update(
+            g_flat, master, state["m"], state["v_per_tensor"],
+            self.seg_rows, self.spec.num_tensors,
+            beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
+            weight_decay=hyper["weight_decay"], lr=hyper["lr"], step=step,
+            grad_scale=hyper.get("grad_scale"), noop=hyper.get("noop"),
+            grad_averaging=self.grad_averaging, init_zero=self.init_zero,
+        )
+        return p, dict(m=m, v_per_tensor=v)

@@ -1,0 +1,33 @@
+"""FusedSGD — reference: apex/optimizers/fused_sgd.py
+(csrc/multi_tensor_sgd_kernel.cu analog)."""
+
+from __future__ import annotations
+
+from apex_tpu.ops import optim_kernels
+from apex_tpu.optimizers.common import FusedOptimizerBase
+
+
+class FusedSGD(FusedOptimizerBase):
+    STATE_BUFFERS = ("momentum_buffer",)
+
+    def __init__(self, params, lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
+                 nesterov=False, wd_after_momentum=False,
+                 materialize_master_grads=True, set_grad_none=False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        if wd_after_momentum:
+            raise NotImplementedError("wd_after_momentum=True not implemented")
+        defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
+                        weight_decay=weight_decay)
+        self.nesterov = nesterov
+        self.momentum = momentum
+        super().__init__(params, defaults)
+
+    def _update(self, g_flat, master, state, step, hyper):
+        p, m = optim_kernels.sgd_update(
+            g_flat, master, state["momentum_buffer"],
+            lr=hyper["lr"], momentum=self.momentum,
+            dampening=hyper["dampening"], weight_decay=hyper["weight_decay"],
+            nesterov=self.nesterov, noop=hyper.get("noop"), step=step,
+        )
+        return p, dict(momentum_buffer=m)

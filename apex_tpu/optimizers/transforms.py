@@ -1,0 +1,147 @@
+"""Optax-style pure transforms over the fused flat-buffer kernels.
+
+The idiomatic-JAX entry point: ``tx = fused_adam(1e-3); state = tx.init(p);
+updates, state = tx.update(g, state, p)``. The transform flattens grads (and
+params where the rule needs them) into the lane-aligned buffer, runs the
+single-launch Pallas kernel, and returns deltas as a pytree. State (m/v) stays
+flat between steps — no per-step re-layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.ops import flat_buffer, optim_kernels
+from apex_tpu.ops.flat_buffer import LANE
+
+
+class FlatOptState(NamedTuple):
+    count: jax.Array
+    m: jax.Array
+    v: jax.Array  # (rows, LANE) for adam/lamb; (num_tensors,) for novograd; () for sgd
+
+
+def _prep(params_or_grads):
+    spec = flat_buffer.build_spec(params_or_grads)
+    seg = jnp.asarray(spec.segment_rows())
+    return spec, seg
+
+
+def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+               adam_w_mode=True, bias_correction=True) -> optax.GradientTransformation:
+    def init_fn(params):
+        spec, _ = _prep(params)
+        z = jnp.zeros((spec.total_rows, LANE), jnp.float32)
+        return FlatOptState(count=jnp.zeros((), jnp.int32), m=z, v=z)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        spec, _ = _prep(updates)
+        g = flat_buffer.flatten(updates, spec)
+        p = flat_buffer.flatten(params, spec)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        p_new, m, v = optim_kernels.adam_update(
+            g, p, state.m, state.v,
+            beta1=b1, beta2=b2, eps=eps, weight_decay=weight_decay, lr=lr,
+            step=count, adam_w_mode=adam_w_mode, bias_correction=bias_correction,
+        )
+        deltas = flat_buffer.unflatten(p_new - p, spec)
+        return deltas, FlatOptState(count=count, m=m, v=v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def fused_lamb(learning_rate, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.01,
+               max_grad_norm=1.0, grad_averaging=True,
+               bias_correction=True) -> optax.GradientTransformation:
+    def init_fn(params):
+        spec, _ = _prep(params)
+        z = jnp.zeros((spec.total_rows, LANE), jnp.float32)
+        return FlatOptState(count=jnp.zeros((), jnp.int32), m=z, v=z)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_lamb requires params")
+        spec, seg = _prep(updates)
+        g = flat_buffer.flatten(updates, spec)
+        p = flat_buffer.flatten(params, spec)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        gnorm, finite, _ = optim_kernels.global_grad_norm_and_finite(
+            g, seg, spec.num_tensors
+        )
+        clip = jnp.where(
+            (max_grad_norm > 0.0) & (gnorm > max_grad_norm),
+            max_grad_norm / gnorm, jnp.float32(1.0),
+        )
+        noop = 1.0 - finite.astype(jnp.float32)
+        p_new, m, v = optim_kernels.lamb_update(
+            g, p, state.m, state.v, seg, spec.num_tensors,
+            beta1=b1, beta2=b2, eps=eps, weight_decay=weight_decay, lr=lr,
+            step=count, grad_scale=clip, noop=noop,
+            bias_correction=bias_correction, grad_averaging=grad_averaging,
+        )
+        deltas = flat_buffer.unflatten(p_new - p, spec)
+        return deltas, FlatOptState(count=count, m=m, v=v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def fused_sgd(learning_rate, momentum=0.0, dampening=0.0, weight_decay=0.0,
+              nesterov=False) -> optax.GradientTransformation:
+    def init_fn(params):
+        spec, _ = _prep(params)
+        z = jnp.zeros((spec.total_rows, LANE), jnp.float32)
+        return FlatOptState(count=jnp.zeros((), jnp.int32), m=z, v=jnp.zeros((), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_sgd requires params")
+        spec, _ = _prep(updates)
+        g = flat_buffer.flatten(updates, spec)
+        p = flat_buffer.flatten(params, spec)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        p_new, m = optim_kernels.sgd_update(
+            g, p, state.m, lr=lr, momentum=momentum, dampening=dampening,
+            weight_decay=weight_decay, nesterov=nesterov,
+        )
+        deltas = flat_buffer.unflatten(p_new - p, spec)
+        return deltas, FlatOptState(count=count, m=m, v=state.v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def fused_novograd(learning_rate, b1=0.95, b2=0.98, eps=1e-8, weight_decay=0.0,
+                   grad_averaging=True) -> optax.GradientTransformation:
+    def init_fn(params):
+        spec, _ = _prep(params)
+        z = jnp.zeros((spec.total_rows, LANE), jnp.float32)
+        return FlatOptState(
+            count=jnp.zeros((), jnp.int32), m=z,
+            v=jnp.zeros((spec.num_tensors,), jnp.float32),
+        )
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        spec, seg = _prep(updates)
+        g = flat_buffer.flatten(updates, spec)
+        p = flat_buffer.flatten(params, spec)
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        p_new, m, v = optim_kernels.novograd_update(
+            g, p, state.m, state.v, seg, spec.num_tensors,
+            beta1=b1, beta2=b2, eps=eps, weight_decay=weight_decay, lr=lr,
+            step=count, grad_averaging=grad_averaging,
+        )
+        deltas = flat_buffer.unflatten(p_new - p, spec)
+        return deltas, FlatOptState(count=count, m=m, v=v)
+
+    return optax.GradientTransformation(init_fn, update_fn)

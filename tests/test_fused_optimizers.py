@@ -1,0 +1,272 @@
+"""Fused optimizer parity tests.
+
+Mirrors tests/L0/run_optimizers/test_fused_optimizer.py (FusedAdam/FusedSGD vs
+framework-native reference) and test_lamb.py (FusedLAMB vs an in-test RefLAMB)
+from the reference. The pytree has ragged/odd shapes to exercise flat-buffer
+padding and per-tensor segmentation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import FusedAdam, FusedLAMB, FusedNovoGrad, FusedSGD
+from apex_tpu.optimizers import fused_adam, fused_lamb, fused_sgd
+
+
+def make_tree(key, dtype=jnp.float32):
+    k = jax.random.split(key, 4)
+    return {
+        "dense": {"kernel": jax.random.normal(k[0], (37, 129), dtype),
+                  "bias": jax.random.normal(k[1], (129,), dtype)},
+        "emb": jax.random.normal(k[2], (100, 64), dtype),
+        "scale": jax.random.normal(k[3], (7,), dtype),
+    }
+
+
+def tree_close(a, b, rtol=1e-5, atol=1e-5, msg=""):
+    fa = jax.tree.leaves(a)
+    fb = jax.tree.leaves(b)
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(x, np.float32), np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol, err_msg=msg)
+
+
+def test_fused_adam_matches_optax_adamw():
+    params = make_tree(jax.random.PRNGKey(0))
+    opt = FusedAdam(params, lr=1e-2, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01)
+
+    ref_tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    ref_state = ref_tx.init(params)
+    ref_params = params
+
+    cur = params
+    for i in range(3):
+        grads = jax.tree.map(lambda p: jnp.sin(p) * 0.1, cur)
+        cur = opt.step(grads)
+        ref_grads = jax.tree.map(lambda p: jnp.sin(p) * 0.1, ref_params)
+        upd, ref_state = ref_tx.update(ref_grads, ref_state, ref_params)
+        ref_params = optax.apply_updates(ref_params, upd)
+    tree_close(cur, ref_params, rtol=1e-4, atol=1e-5, msg="adam trajectory")
+
+
+def test_fused_adam_l2_mode():
+    """adam_w_mode=False applies wd as L2 into the gradient (reference mode 1)."""
+    params = {"w": jnp.ones((8, 8))}
+    opt = FusedAdam(params, lr=1e-2, weight_decay=0.1, adam_w_mode=False)
+    grads = {"w": jnp.full((8, 8), 0.5)}
+    out = opt.step(grads)
+    # manual reference
+    g = 0.5 + 0.1 * 1.0
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    want = 1.0 - 1e-2 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full((8, 8), want), rtol=1e-5)
+
+
+def test_fused_adam_bf16_params_fp32_master():
+    """bf16 params: master stays fp32, returned params are bf16 (amp-O2 flow)."""
+    params = make_tree(jax.random.PRNGKey(1), jnp.bfloat16)
+    opt = FusedAdam(params, lr=1e-3)
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+    out = opt.step(grads)
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(out))
+    assert opt.master.dtype == jnp.float32
+
+
+def test_noop_skips_step():
+    """noop=1 (dynamic-loss-scale overflow) leaves params and state unchanged."""
+    params = make_tree(jax.random.PRNGKey(2))
+    opt = FusedAdam(params, lr=1e-2)
+    grads = jax.tree.map(jnp.ones_like, params)
+    m0 = opt.master
+    out = opt.step(grads, noop=1.0)
+    tree_close(out, params, msg="params changed despite noop")
+    np.testing.assert_allclose(np.asarray(opt.state["m"]), 0.0)
+
+
+def ref_lamb_step(params, grads, m, v, step, lr, b1, b2, eps, wd, max_norm):
+    """Pure-jnp RefLAMB (mirrors the in-test reference of test_lamb.py)."""
+    leaves_g = jax.tree.leaves(grads)
+    gnorm = jnp.sqrt(sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves_g))
+    clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0)
+
+    def one(p, g, m, v):
+        g = g * clip
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        u = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        pn = jnp.sqrt(jnp.sum(p ** 2))
+        un = jnp.sqrt(jnp.sum(u ** 2))
+        ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+        return p - lr * ratio * u, m, v
+
+    out = jax.tree.map(one, params, grads, m, v)
+    ps = jax.tree.map(lambda t: t[0], out, is_leaf=lambda t: isinstance(t, tuple))
+    ms = jax.tree.map(lambda t: t[1], out, is_leaf=lambda t: isinstance(t, tuple))
+    vs = jax.tree.map(lambda t: t[2], out, is_leaf=lambda t: isinstance(t, tuple))
+    return ps, ms, vs
+
+
+def test_fused_lamb_matches_ref():
+    params = make_tree(jax.random.PRNGKey(3))
+    lr, b1, b2, eps, wd = 1e-2, 0.9, 0.999, 1e-6, 0.01
+    opt = FusedLAMB(params, lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd,
+                    max_grad_norm=1.0)
+    ref_p = params
+    ref_m = jax.tree.map(jnp.zeros_like, params)
+    ref_v = jax.tree.map(jnp.zeros_like, params)
+    cur = params
+    for i in range(3):
+        grads = jax.tree.map(lambda p: jnp.cos(p) * 0.3, cur)
+        cur = opt.step(grads)
+        ref_g = jax.tree.map(lambda p: jnp.cos(p) * 0.3, ref_p)
+        ref_p, ref_m, ref_v = ref_lamb_step(ref_p, ref_g, ref_m, ref_v, i + 1,
+                                            lr, b1, b2, eps, wd, 1.0)
+    tree_close(cur, ref_p, rtol=2e-4, atol=2e-5, msg="lamb trajectory")
+
+
+def test_fused_lamb_inf_grads_auto_skip():
+    params = make_tree(jax.random.PRNGKey(4))
+    opt = FusedLAMB(params, lr=1e-2)
+    grads = jax.tree.map(jnp.ones_like, params)
+    grads["scale"] = grads["scale"].at[0].set(jnp.inf)
+    out = opt.step(grads)
+    tree_close(out, params, msg="step applied despite inf grad")
+
+
+def test_fused_lamb_wd_exclusion():
+    """bias/scale excluded from weight decay via path predicate (param-group
+    parity)."""
+    params = make_tree(jax.random.PRNGKey(5))
+    opt = FusedLAMB(params, lr=1e-2, weight_decay=0.5,
+                    exclude_from_weight_decay=lambda name: "bias" in name)
+    # wd vector: order of tree leaves (dense/bias, dense/kernel, emb, scale)
+    wd = np.asarray(opt.wd_per_segment)
+    names = ["dense/bias", "dense/kernel", "emb", "scale"]
+    want = [0.0, 0.5, 0.5, 0.5]
+    np.testing.assert_allclose(wd, want)
+
+
+def test_fused_adam_wd_exclusion_applies():
+    """exclude_from_weight_decay must actually zero decay on excluded tensors
+    (per-segment wd path through the adam kernel)."""
+    params = {"kernel": jnp.ones((8, 8)), "bias": jnp.ones((8,))}
+    opt = FusedAdam(params, lr=0.0, weight_decay=0.5,
+                    exclude_from_weight_decay=lambda n: "bias" in n)
+    # lr=0 with adamw: p -= lr*(...) = p unchanged regardless — use lr>0 and
+    # zero grads so the only update is the decoupled decay term
+    opt.defaults["lr"] = 0.1
+    grads = jax.tree.map(jnp.zeros_like, params)
+    out = opt.step(grads)
+    np.testing.assert_allclose(np.asarray(out["bias"]), 1.0)  # excluded: no decay
+    np.testing.assert_allclose(np.asarray(out["kernel"]), 1.0 - 0.1 * 0.5)
+
+
+def test_fused_lamb_ratio_not_applied_to_wd_excluded():
+    """use_nvlamb=False (default): decay-excluded tensors get trust ratio 1
+    (reference multi_tensor_lamb semantics)."""
+    params = {"kernel": jnp.full((8, 8), 2.0), "bias": jnp.full((8,), 2.0)}
+    grads = {"kernel": jnp.full((8, 8), 1e-3), "bias": jnp.full((8,), 1e-3)}
+    opt = FusedLAMB(params, lr=1e-2, weight_decay=0.5, max_grad_norm=0.0,
+                    exclude_from_weight_decay=lambda n: "bias" in n)
+    out = opt.step(grads)
+    # bias: ratio = 1, u = mhat/(sqrt(vhat)+eps) = 1 elementwise (constant g)
+    np.testing.assert_allclose(np.asarray(out["bias"]), 2.0 - 1e-2, rtol=1e-4)
+    # kernel: wd>0, ratio = ||p||/||u|| applied
+    pn = np.sqrt(64 * 4.0)
+    un = np.sqrt(64 * (1.0 + 0.5 * 2.0) ** 2)
+    want = 2.0 - 1e-2 * (pn / un) * (1.0 + 0.5 * 2.0)
+    np.testing.assert_allclose(np.asarray(out["kernel"]), want, rtol=1e-4)
+
+
+def test_fused_sgd_first_step_dampening():
+    """First momentum step uses the raw gradient (torch/apex first-use rule)."""
+    params = {"w": jnp.ones((4, 4))}
+    opt = FusedSGD(params, lr=0.1, momentum=0.9, dampening=0.3)
+    g = {"w": jnp.full((4, 4), 1.0)}
+    out = opt.step(g)
+    # step 1: m = g (no dampening), p = 1 - 0.1*1
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.9, rtol=1e-6)
+    out2 = opt.step(g)
+    # step 2: m = 0.9*1 + 0.7*1 = 1.6
+    np.testing.assert_allclose(np.asarray(out2["w"]), 0.9 - 0.16, rtol=1e-6)
+
+
+def test_fused_sgd_matches_optax():
+    params = make_tree(jax.random.PRNGKey(6))
+    opt = FusedSGD(params, lr=0.1, momentum=0.9, weight_decay=0.01)
+    ref_tx = optax.chain(
+        optax.add_decayed_weights(0.01),
+        optax.sgd(0.1, momentum=0.9),
+    )
+    ref_state = ref_tx.init(params)
+    ref_p = params
+    cur = params
+    for _ in range(3):
+        grads = jax.tree.map(lambda p: jnp.sin(p), cur)
+        cur = opt.step(grads)
+        rg = jax.tree.map(lambda p: jnp.sin(p), ref_p)
+        upd, ref_state = ref_tx.update(rg, ref_state, ref_p)
+        ref_p = optax.apply_updates(ref_p, upd)
+    tree_close(cur, ref_p, rtol=1e-5, atol=1e-6, msg="sgd trajectory")
+
+
+def test_fused_novograd_runs_and_descends():
+    params = {"w": jnp.ones((16, 130)), "b": jnp.ones((5,))}
+    opt = FusedNovoGrad(params, lr=1e-2, betas=(0.95, 0.98))
+
+    def loss(tree):
+        return sum(jnp.sum(l ** 2) for l in jax.tree.leaves(tree))
+
+    cur = params
+    l0 = float(loss(cur))
+    g0 = jax.grad(loss)(cur)
+    cur = opt.step(g0)
+    # per-tensor v: after step 1 it equals ||g||^2 per tensor (reference
+    # init-from-first-grad-norm semantics)
+    want_v = [float(jnp.sum(g ** 2)) for g in jax.tree.leaves(g0)]
+    np.testing.assert_allclose(np.asarray(opt.state["v_per_tensor"]), want_v, rtol=1e-5)
+    for _ in range(4):
+        grads = jax.grad(loss)(cur)
+        cur = opt.step(grads)
+    assert float(loss(cur)) < l0
+
+
+def test_optax_transforms():
+    params = make_tree(jax.random.PRNGKey(7))
+    for tx, ref_tx in [
+        (fused_adam(1e-2, weight_decay=0.01), optax.adamw(1e-2, weight_decay=0.01)),
+        (fused_sgd(0.1), optax.sgd(0.1)),
+    ]:
+        state = tx.init(params)
+        ref_state = ref_tx.init(params)
+        p1, p2 = params, params
+        for _ in range(2):
+            g1 = jax.tree.map(lambda p: jnp.sin(p), p1)
+            upd, state = tx.update(g1, state, p1)
+            p1 = optax.apply_updates(p1, upd)
+            g2 = jax.tree.map(lambda p: jnp.sin(p), p2)
+            upd2, ref_state = ref_tx.update(g2, ref_state, p2)
+            p2 = optax.apply_updates(p2, upd2)
+        tree_close(p1, p2, rtol=1e-4, atol=1e-5)
+
+
+def test_flat_buffer_roundtrip():
+    from apex_tpu.ops import flat_buffer
+
+    tree = make_tree(jax.random.PRNGKey(8), jnp.bfloat16)
+    spec = flat_buffer.build_spec(tree)
+    flat = flat_buffer.flatten(tree, spec)
+    assert flat.shape[1] == flat_buffer.LANE
+    back = flat_buffer.unflatten(flat, spec)
+    tree_close(back, tree, rtol=1e-2, atol=1e-2)
+    seg = spec.segment_rows()
+    assert seg.shape == (spec.total_rows,)
+    assert seg[0] == 0 and seg[-1] == spec.num_tensors - 1
