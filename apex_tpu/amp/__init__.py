@@ -1,0 +1,157 @@
+"""apex_tpu.amp — mixed precision with apex.amp's API shape.
+
+Reference: apex/amp/ — ``initialize()`` (frontend.py), ``scale_loss()``
+(handle.py), ``master_params()``, ``state_dict()`` (+ the O0-O3 opt-level
+system). The TPU translation (SURVEY.md §3.1): the O1 monkey-patch machinery
+becomes a dtype Policy consulted by modules; O2's master weights are the flat
+fp32 master the fused optimizers already hold; dynamic loss scaling exists
+for fp16-parity runs and is fused into the optimizer step (found-inf from the
+stats kernel, scaler state updated on device).
+
+Typical use:
+
+    params, optimizer = amp.initialize(params, optimizer, opt_level="O2")
+    ...
+    with amp.scale_loss(loss, optimizer) as scaled_loss:
+        grads = jax.grad(loss_fn)(...)   # of the scaled loss
+    new_params = optimizer.step(grads)   # unscale + inf-skip fused
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import Policy, is_norm_param_name, make_policy
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.optimizers.common import path_name as _path_name
+
+__all__ = ["initialize", "scale_loss", "master_params", "current_policy",
+           "state_dict", "load_state_dict", "Policy", "make_policy", "LossScaler"]
+
+# module-level amp state (reference: apex/amp/_amp_state.py)
+_current_policy: Optional[Policy] = None
+_loss_scalers: list = []
+
+
+def current_policy() -> Optional[Policy]:
+    """The active Policy (modules consult this for compute dtypes)."""
+    return _current_policy
+
+
+def initialize(models, optimizers=None, enabled=True, opt_level="O1",
+               cast_model_type=None, patch_torch_functions=None,
+               keep_batchnorm_fp32=None, master_weights=None, loss_scale=None,
+               cast_model_outputs=None, num_losses=1, verbosity=1,
+               min_loss_scale=1.0, max_loss_scale=2.0 ** 24,
+               half_dtype=jnp.bfloat16, keep_fp32_predicate=None):
+    """Reference: apex/amp/frontend.py:initialize (same signature shape;
+    torch-only knobs like patch_torch_functions are accepted and ignored).
+
+    ``models`` is a parameter pytree (or list of pytrees); returns the
+    policy-cast pytree(s) and the optimizer(s) with a LossScaler attached.
+    With multiple losses AND multiple optimizers, scaler i is attached to
+    optimizer i (the DCGAN pattern: one loss per optimizer). A single
+    optimizer driven by several dynamically-scaled losses is not supported.
+    """
+    global _current_policy, _loss_scalers
+    if not enabled:
+        if optimizers is None:
+            return models
+        return models, optimizers
+
+    policy = make_policy(opt_level, half_dtype=half_dtype,
+                         cast_model_type=cast_model_type,
+                         keep_batchnorm_fp32=keep_batchnorm_fp32,
+                         master_weights=master_weights, loss_scale=loss_scale)
+    _current_policy = policy
+
+    keep_fp32 = keep_fp32_predicate or is_norm_param_name
+
+    def cast_params(tree):
+        if policy.param_dtype == jnp.float32:
+            return tree
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for path, leaf in flat:
+            if (policy.keep_norm_fp32 and keep_fp32(_path_name(path))) or not jnp.issubdtype(leaf.dtype, jnp.floating):
+                out.append(leaf)
+            else:
+                out.append(leaf.astype(policy.param_dtype))
+        return jax.tree_util.tree_unflatten(jax.tree.structure(tree), out)
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    model_list = [cast_params(m) for m in model_list]
+
+    _loss_scalers = [
+        LossScaler(policy.loss_scale, min_loss_scale=min_loss_scale,
+                   max_loss_scale=max_loss_scale)
+        for _ in range(num_losses)
+    ]
+
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        if num_losses > 1 and len(opt_list) not in (1, num_losses):
+            raise ValueError("num_losses must be 1 or match the optimizer count")
+        if num_losses > 1 and len(opt_list) == 1 and _loss_scalers[0].dynamic:
+            raise NotImplementedError(
+                "one optimizer driven by multiple dynamically-scaled losses is "
+                "not supported; use one optimizer per loss (DCGAN pattern)")
+        for i, opt in enumerate(opt_list):
+            scaler = _loss_scalers[min(i, num_losses - 1)]
+            # skip the no-op scaler entirely: static scale 1.0 needs neither
+            # an unscale nor a found-inf pass (saves a full grad-buffer read
+            # per step and keeps inf grads loud instead of silently skipping)
+            if hasattr(opt, "attach_amp_scaler") and (
+                    scaler.dynamic or float(scaler.state.scale) != 1.0):
+                opt.attach_amp_scaler(scaler)
+            # O2/O3: the optimizer must hand back params in the cast dtypes
+            if hasattr(opt, "set_output_dtypes") and policy.param_dtype != jnp.float32:
+                model_idx = min(i, len(model_list) - 1)
+                opt.set_output_dtypes(
+                    [l.dtype for l in jax.tree.leaves(model_list[model_idx])]
+                )
+        out_opt = opt_list[0] if single_opt else opt_list
+        return (model_list[0] if single_model else model_list), out_opt
+    return model_list[0] if single_model else model_list
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizers=None, loss_id=0, model=None, delay_unscale=False,
+               delay_overflow_check=False):
+    """Reference: apex/amp/handle.py:scale_loss. Yields ``loss * scale``;
+    the unscale (and overflow skip) is fused into ``optimizer.step``.
+
+    Usable inside jit — it is pure arithmetic on the traced loss value.
+    """
+    if not _loss_scalers:
+        yield loss
+        return
+    scaler = _loss_scalers[loss_id]
+    yield scaler.scale_loss(loss)
+
+
+def master_params(optimizer):
+    """Reference: apex/amp/__init__.py:master_params — the fp32 master
+    parameter pytree held by a fused optimizer."""
+    from apex_tpu.ops import flat_buffer
+
+    fp32_dtypes = [jnp.float32] * optimizer.spec.num_tensors
+    return flat_buffer.unflatten(optimizer.master, optimizer.spec, dtypes=fp32_dtypes)
+
+
+def state_dict(destination=None):
+    """Reference: apex/amp/frontend.py:state_dict — loss-scaler state."""
+    return {f"loss_scaler{i}": s.state_dict() for i, s in enumerate(_loss_scalers)}
+
+
+def load_state_dict(sd):
+    for i, s in enumerate(_loss_scalers):
+        key = f"loss_scaler{i}"
+        if key in sd:
+            s.load_state_dict(sd[key])

@@ -1,0 +1,108 @@
+"""Precision policies — the TPU re-expression of apex.amp opt levels.
+
+Reference: apex/amp/frontend.py opt-level Properties:
+  O0 = fp32 everything;
+  O1 = per-op cast lists (GEMM/conv in fp16, softmax/norm/loss in fp32) via
+       monkey-patching torch (apex/amp/lists/*_overrides.py, amp.py:init);
+  O2 = fp16 model weights + fp32 master weights + fp32 batchnorm;
+  O3 = pure fp16.
+
+On TPU the per-op patch machinery collapses into a dtype policy consulted by
+modules: params dtype, compute dtype, and whether normalization/softmax/loss
+run in fp32 (they always accumulate fp32 in our kernels regardless). bf16 is
+the native 16-bit type (no loss scaling needed); fp16 is allowed for parity
+experiments and engages the dynamic LossScaler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# parameter-name tokens treated as normalization params for keep_batchnorm_fp32
+# (shared by amp.initialize and fp16_utils.BN_convert_float)
+NORM_NAME_TOKENS = ("norm", "bn", "batchnorm", "layernorm")
+
+
+def is_norm_param_name(path_name: str) -> bool:
+    n = path_name.lower()
+    return any(t in n for t in NORM_NAME_TOKENS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """What dtype each tensor class uses (jmp-style, apex-shaped)."""
+
+    opt_level: str
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+    output_dtype: jnp.dtype
+    keep_norm_fp32: bool  # keep_batchnorm_fp32 in the reference
+    master_weights: bool
+    loss_scale: Optional[object]  # None, float, or "dynamic"
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_param(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+    def cast_to_output(self, tree):
+        return jax.tree.map(
+            lambda x: x.astype(self.output_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            tree,
+        )
+
+
+def make_policy(opt_level: str, half_dtype=jnp.bfloat16,
+                cast_model_type=None, keep_batchnorm_fp32=None,
+                master_weights=None, loss_scale=None) -> Policy:
+    """Map an apex opt_level (+ overrides) to a Policy.
+
+    Mirrors apex/amp/frontend.py: explicit kwargs override the opt-level
+    defaults, as in the reference's Properties handling.
+    """
+    opt_level = opt_level.upper()
+    if opt_level == "O0":
+        p = dict(param_dtype=jnp.float32, compute_dtype=jnp.float32,
+                 output_dtype=jnp.float32, keep_norm_fp32=False,
+                 master_weights=False, loss_scale=1.0)
+    elif opt_level == "O1":
+        p = dict(param_dtype=jnp.float32, compute_dtype=half_dtype,
+                 output_dtype=jnp.float32, keep_norm_fp32=True,
+                 master_weights=False,
+                 loss_scale="dynamic" if half_dtype == jnp.float16 else 1.0)
+    elif opt_level == "O2":
+        p = dict(param_dtype=half_dtype, compute_dtype=half_dtype,
+                 output_dtype=jnp.float32, keep_norm_fp32=True,
+                 master_weights=True,
+                 loss_scale="dynamic" if half_dtype == jnp.float16 else 1.0)
+    elif opt_level == "O3":
+        p = dict(param_dtype=half_dtype, compute_dtype=half_dtype,
+                 output_dtype=half_dtype, keep_norm_fp32=False,
+                 master_weights=False, loss_scale=1.0)
+    else:
+        raise ValueError(f"Unexpected optimization level {opt_level}; "
+                         "options are 'O0', 'O1', 'O2', 'O3'.")
+    if cast_model_type is not None:
+        p["param_dtype"] = cast_model_type
+    if keep_batchnorm_fp32 is not None:
+        p["keep_norm_fp32"] = keep_batchnorm_fp32
+    if master_weights is not None:
+        p["master_weights"] = master_weights
+    if loss_scale is not None:
+        p["loss_scale"] = loss_scale
+    return Policy(opt_level=opt_level, **p)

@@ -1,0 +1,87 @@
+"""Dynamic loss scaler — reference: apex/amp/scaler.py:LossScaler.
+
+Keeps the reference's algorithm (init 2**16, halve on overflow, double after
+``growth_interval=2000`` clean steps — frontend.py dynamic defaults) but the
+state lives as device scalars updated functionally inside the jitted
+optimizer step, so no host sync is needed per step. ``found_inf`` comes from
+the fused stats kernel (the noop_flag analog of multi_tensor_scale).
+
+On TPU the default precision is bf16 (same exponent range as fp32), so the
+scaler is a no-op unless an fp16 policy or explicit scale is requested —
+matching SURVEY.md §3.1's translation note.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerState(NamedTuple):
+    scale: jax.Array            # f32 scalar
+    growth_tracker: jax.Array   # i32 scalar — clean steps since last growth
+    dynamic: jax.Array          # f32 0/1 flag (static per scaler, kept for pytree)
+
+
+class LossScaler:
+    """API mirror of apex/amp/scaler.py:LossScaler."""
+
+    def __init__(self, loss_scale: Union[float, str] = 1.0,
+                 init_scale: float = 2.0 ** 16,
+                 scale_factor: float = 2.0,
+                 scale_window: int = 2000,
+                 min_loss_scale: float = 1.0,
+                 max_loss_scale: float = 2.0 ** 24):
+        self.dynamic = loss_scale == "dynamic"
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._min_scale = min_loss_scale
+        self._max_scale = max_loss_scale  # reference default cap (frontend.py)
+        init = init_scale if self.dynamic else float(loss_scale)
+        self.state = ScalerState(
+            scale=jnp.asarray(init, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            dynamic=jnp.asarray(1.0 if self.dynamic else 0.0, jnp.float32),
+        )
+
+    def loss_scale(self) -> jax.Array:
+        return self.state.scale
+
+    def scale_loss(self, loss):
+        return loss * self.state.scale.astype(loss.dtype)
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        """Pure update (traceable): halve on overflow, double after
+        scale_window clean steps, clamped to [min, max] (reference
+        update_scale semantics incl. the 2**24 cap). Branches on the traced
+        ``state.dynamic`` flag, so a checkpoint restore that flips dynamic
+        does not require re-tracing callers."""
+        found = found_inf.astype(jnp.bool_)
+        new_scale = jnp.where(found, state.scale / self._scale_factor, state.scale)
+        tracker = jnp.where(found, 0, state.growth_tracker + 1)
+        grow = tracker >= self._scale_window
+        new_scale = jnp.where(grow, new_scale * self._scale_factor, new_scale)
+        tracker = jnp.where(grow, 0, tracker)
+        new_scale = jnp.clip(new_scale, self._min_scale, self._max_scale)
+        is_dyn = state.dynamic > 0.0
+        return ScalerState(
+            scale=jnp.where(is_dyn, new_scale, state.scale),
+            growth_tracker=jnp.where(is_dyn, tracker, state.growth_tracker),
+            dynamic=state.dynamic,
+        )
+
+    # -- checkpointing (reference: amp.state_dict saves loss scalers) ---------
+    def state_dict(self):
+        return {"scale": self.state.scale,
+                "growth_tracker": self.state.growth_tracker,
+                "dynamic": self.dynamic}
+
+    def load_state_dict(self, sd):
+        self.dynamic = bool(sd["dynamic"])
+        self.state = ScalerState(
+            scale=jnp.asarray(sd["scale"], jnp.float32),
+            growth_tracker=jnp.asarray(sd["growth_tracker"], jnp.int32),
+            dynamic=jnp.asarray(1.0 if self.dynamic else 0.0, jnp.float32),
+        )

@@ -1,0 +1,85 @@
+"""apex_tpu.fp16_utils — legacy fp16 helpers (reference: apex/fp16_utils/).
+
+The reference predates apex.amp; kept for API parity. On TPU the half type
+defaults to bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+
+# reference: apex/fp16_utils/loss_scaler.py — static & dynamic scalers
+DynamicLossScaler = LossScaler
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Reference: apex/fp16_utils/fp16util.py:network_to_half — cast floating
+    leaves to half, keeping norm-ish params fp32 via BN_convert_float."""
+    return jax.tree.map(
+        lambda x: x.astype(half_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )
+
+
+def BN_convert_float(params):
+    """Reference: fp16util.py:BN_convert_float — restore norm params to fp32.
+    Heuristic: leaves whose path mentions a normalization layer."""
+    from apex_tpu.amp.policy import is_norm_param_name
+    from apex_tpu.optimizers.common import path_name
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        if is_norm_param_name(path_name(path)) and jnp.issubdtype(leaf.dtype, jnp.floating):
+            out.append(leaf.astype(jnp.float32))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Reference: fp16util.py — cast fp32 masters into the model dtypes."""
+    return jax.tree.map(lambda mp, m: m.astype(mp.dtype), model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """Reference: fp16util.py — upcast half grads to fp32."""
+    return jax.tree.map(lambda g: g.astype(jnp.float32), model_grads)
+
+
+class FP16_Optimizer:
+    """Reference: apex/fp16_utils/fp16_optimizer.py — wraps an optimizer with
+    fp32 master weights + (dynamic) loss scaling. Our fused optimizers already
+    hold flat fp32 masters, so this is a thin scaler shim around them."""
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None, verbose=False):
+        self.optimizer = init_optimizer
+        scale = "dynamic" if dynamic_loss_scale else static_loss_scale
+        self.loss_scaler = LossScaler(scale, **(dynamic_loss_args or {}))
+        if hasattr(init_optimizer, "attach_amp_scaler"):
+            init_optimizer.attach_amp_scaler(self.loss_scaler)
+
+    @property
+    def loss_scale(self):
+        return float(self.loss_scaler.state.scale)
+
+    def scale_loss(self, loss):
+        return self.loss_scaler.scale_loss(loss)
+
+    def step(self, grads, **kw):
+        return self.optimizer.step(grads, **kw)
+
+    def zero_grad(self, set_to_none=True):
+        self.optimizer.zero_grad(set_to_none)
+
+    def state_dict(self):
+        return {"optimizer": self.optimizer.state_dict(),
+                "loss_scaler": self.loss_scaler.state_dict()}
+
+    def load_state_dict(self, sd):
+        self.optimizer.load_state_dict(sd["optimizer"])
+        self.loss_scaler.load_state_dict(sd["loss_scaler"])

@@ -72,6 +72,21 @@ class FusedOptimizerBase:
         else:
             self.wd_per_segment = None
         self._jit_step = None
+        self._amp_scaler = None
+        self._out_dtypes = None
+
+    def attach_amp_scaler(self, scaler) -> None:
+        """Called by amp.initialize: fuses unscale + found-inf skip + dynamic
+        scale update into this optimizer's jitted step."""
+        self._amp_scaler = scaler
+        self._jit_step = None  # re-trace with the scaler path
+
+    def set_output_dtypes(self, dtypes) -> None:
+        """Called by amp.initialize under O2/O3: step() must return params in
+        the policy-cast dtypes (master->model half copy of the reference),
+        not the dtypes the optimizer was constructed with."""
+        self._out_dtypes = list(dtypes)
+        self._jit_step = None
 
     # -- torch-API parity shims ------------------------------------------------
     def zero_grad(self, set_to_none: bool = True):
@@ -119,14 +134,33 @@ class FusedOptimizerBase:
             )
         if self._jit_step is None:
             spec = self.spec
+            seg_rows = self.seg_rows
+            scaler = self._amp_scaler
+            out_dtypes = self._out_dtypes
 
-            def _pure(g_tree, master, state, step, hyper, gs, noop_):
+            def _pure(g_tree, master, state, step, hyper, gs, noop_, scaler_state):
                 g_flat = flat_buffer.flatten(g_tree, spec)
+                if scaler is not None:
+                    # fused unscale + overflow skip (reference: scaler.py
+                    # unscale + _process_optimizer's skip-on-overflow)
+                    from apex_tpu.ops import optim_kernels
+
+                    _, finite, _ = optim_kernels.global_grad_norm_and_finite(
+                        g_flat, seg_rows, spec.num_tensors
+                    )
+                    found_inf = 1.0 - finite.astype(jnp.float32)
+                    gs = gs / scaler_state.scale
+                    noop_ = jnp.maximum(noop_, found_inf)
+                    scaler_state = scaler.update(scaler_state, found_inf)
+                # a skipped step must not advance the count (the reference
+                # skips optimizer.step() entirely, so Adam bias correction
+                # sees only applied steps)
+                new_step = step + jnp.where(noop_ > 0.0, 0, 1).astype(step.dtype)
                 new_master, new_state = self._update(
-                    g_flat, master, state, step + 1, dict(hyper, grad_scale=gs, noop=noop_)
+                    g_flat, master, state, new_step, dict(hyper, grad_scale=gs, noop=noop_)
                 )
-                params = flat_buffer.unflatten(new_master, spec)
-                return params, new_master, new_state, step + 1
+                params = flat_buffer.unflatten(new_master, spec, dtypes=out_dtypes)
+                return params, new_master, new_state, new_step, scaler_state
 
             self._jit_step = jax.jit(_pure, donate_argnums=(1, 2))
 
@@ -135,7 +169,10 @@ class FusedOptimizerBase:
                  if isinstance(v, (int, float))}
         gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
         noop_ = jnp.asarray(0.0 if noop is None else noop, jnp.float32)
-        params, self.master, self.state, self.step_count = self._jit_step(
-            grads, self.master, self.state, self.step_count, hyper, gs, noop_
+        sstate = self._amp_scaler.state if self._amp_scaler is not None else None
+        params, self.master, self.state, self.step_count, sstate = self._jit_step(
+            grads, self.master, self.state, self.step_count, hyper, gs, noop_, sstate
         )
+        if self._amp_scaler is not None:
+            self._amp_scaler.state = sstate
         return params

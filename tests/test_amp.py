@@ -1,0 +1,181 @@
+"""amp policy/scaler tests — mirrors tests/L0/run_amp from the reference
+(cast behavior, dynamic loss scaling incl. inf-skip and scale growth/backoff,
+checkpointing of scaler state)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+
+def params():
+    return {
+        "dense": {"kernel": jnp.ones((8, 8)), "bias": jnp.ones((8,))},
+        "layernorm": {"weight": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+    }
+
+
+def test_opt_levels_policy():
+    for lvl, pd, cd in [("O0", jnp.float32, jnp.float32),
+                        ("O1", jnp.float32, jnp.bfloat16),
+                        ("O2", jnp.bfloat16, jnp.bfloat16),
+                        ("O3", jnp.bfloat16, jnp.bfloat16)]:
+        pol = amp.make_policy(lvl)
+        assert pol.param_dtype == pd and pol.compute_dtype == cd, lvl
+    with pytest.raises(ValueError):
+        amp.make_policy("O4")
+
+
+def test_initialize_o2_casts_but_keeps_norm_fp32():
+    p = params()
+    opt = FusedAdam(p, lr=1e-3)
+    cast_p, opt2 = amp.initialize(p, opt, opt_level="O2")
+    assert cast_p["dense"]["kernel"].dtype == jnp.bfloat16
+    assert cast_p["layernorm"]["weight"].dtype == jnp.float32  # keep_batchnorm_fp32
+    # bf16 static scale 1.0: no scaler attached (no per-step stats pass), but
+    # output dtypes are registered so step() keeps the model half
+    assert opt2 is opt and opt._amp_scaler is None
+    assert opt._out_dtypes is not None
+
+
+def test_o2_step_returns_cast_dtypes():
+    """After O2 initialize, step() must hand back HALF params (master->model
+    copy), not the fp32 dtypes the optimizer was built with."""
+    p = params()
+    opt = FusedAdam(p, lr=1e-3)
+    cast_p, opt = amp.initialize(p, opt, opt_level="O2")
+    out = opt.step(jax.tree.map(jnp.ones_like, cast_p))
+    assert out["dense"]["kernel"].dtype == jnp.bfloat16
+    assert out["layernorm"]["weight"].dtype == jnp.float32
+
+
+def test_noop_does_not_advance_step_count():
+    """Skipped (overflow) steps must not advance Adam bias correction —
+    the reference skips optimizer.step() entirely."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2)
+    amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                   loss_scale="dynamic")
+    opt.step({"w": jnp.full((4, 4), jnp.inf, jnp.float16)})
+    assert int(opt.step_count) == 0
+    opt.step({"w": jnp.full((4, 4), 0.5, jnp.float16)})
+    assert int(opt.step_count) == 1
+
+
+def test_enabled_false_passthrough_shapes():
+    p = params()
+    assert amp.initialize(p, enabled=False) is p
+    opt = FusedAdam(p, lr=1e-3)
+    m, o = amp.initialize(p, opt, enabled=False)
+    assert m is p and o is opt
+
+
+def test_scale_clamped_at_max():
+    from apex_tpu.amp.scaler import LossScaler
+
+    s = LossScaler("dynamic", init_scale=2.0 ** 23, scale_window=1,
+                   max_loss_scale=2.0 ** 24)
+    st = s.state
+    z = jnp.zeros(())
+    st = s.update(st, z)
+    assert float(st.scale) == 2.0 ** 24
+    st = s.update(st, z)
+    assert float(st.scale) == 2.0 ** 24  # capped (reference max_loss_scale)
+
+
+def test_o2_master_params_roundtrip():
+    p = params()
+    opt = FusedAdam(p, lr=1e-3)
+    amp.initialize(p, opt, opt_level="O2")
+    masters = amp.master_params(opt)
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(masters))
+    np.testing.assert_allclose(np.asarray(masters["dense"]["kernel"]), 1.0)
+
+
+def test_scale_loss_and_fused_unscale_fp16_dynamic():
+    """fp16 + dynamic scaling: grads of the scaled loss are unscaled inside
+    step; master update matches the unscaled-gradient update."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2, weight_decay=0.0)
+    _, opt = amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                            loss_scale="dynamic")
+    scale0 = float(opt._amp_scaler.state.scale)
+    assert scale0 == 2.0 ** 16
+
+    with amp.scale_loss(jnp.float32(1.0), opt) as sl:
+        assert float(sl) == scale0
+
+    # grads as if computed from a scaled loss
+    g_unscaled = jnp.full((4, 4), 0.5)
+    out = opt.step({"w": g_unscaled * scale0})
+    # reference: one unscaled adam step from ones with g=0.5
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    want = 1.0 - 1e-2 * (m / (1 - 0.9)) / (np.sqrt(v / (1 - 0.999)) + 1e-8)
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), want, rtol=1e-3)
+
+
+def test_dynamic_scaler_backoff_on_inf():
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2)
+    amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                   loss_scale="dynamic")
+    scale0 = float(opt._amp_scaler.state.scale)
+    out = opt.step({"w": jnp.full((4, 4), jnp.inf)})
+    # step skipped, scale halved
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), 1.0)
+    assert float(opt._amp_scaler.state.scale) == scale0 / 2
+    np.testing.assert_allclose(np.asarray(opt.state["m"]), 0.0)
+
+
+def test_dynamic_scaler_growth():
+    from apex_tpu.amp.scaler import LossScaler
+
+    s = LossScaler("dynamic", init_scale=4.0, scale_window=3)
+    st = s.state
+    zero = jnp.zeros(())
+    for _ in range(3):
+        st = s.update(st, zero)
+    assert float(st.scale) == 8.0 and int(st.growth_tracker) == 0
+    st = s.update(st, jnp.ones(()))
+    assert float(st.scale) == 4.0
+
+
+def test_amp_state_dict_roundtrip():
+    p = {"w": jnp.ones((2, 2))}
+    opt = FusedAdam(p, lr=1e-3)
+    amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                   loss_scale="dynamic")
+    opt.step({"w": jnp.full((2, 2), jnp.inf)})  # halves the scale
+    sd = amp.state_dict()
+    assert float(sd["loss_scaler0"]["scale"]) == 2.0 ** 15
+    amp.load_state_dict(sd)
+
+
+def test_static_loss_scale_bf16_noop():
+    """bf16 default: loss_scale 1.0, scale_loss is identity."""
+    p = {"w": jnp.ones((2, 2))}
+    opt = FusedAdam(p, lr=1e-3)
+    amp.initialize(p, opt, opt_level="O2")  # bf16
+    with amp.scale_loss(jnp.float32(3.5), opt) as sl:
+        assert float(sl) == 3.5
+
+
+def test_fp16_utils():
+    from apex_tpu import fp16_utils
+
+    p = params()
+    h = fp16_utils.network_to_half(p)
+    assert h["dense"]["kernel"].dtype == jnp.bfloat16
+    h2 = fp16_utils.BN_convert_float(h)
+    assert h2["layernorm"]["weight"].dtype == jnp.float32
+    assert h2["dense"]["kernel"].dtype == jnp.bfloat16
+
+    opt = FusedAdam(p, lr=1e-3)
+    fo = fp16_utils.FP16_Optimizer(opt, dynamic_loss_scale=True)
+    assert fo.loss_scale == 2.0 ** 16
+    out = fo.step(jax.tree.map(jnp.ones_like, p))
+    assert jax.tree.structure(out) == jax.tree.structure(p)
