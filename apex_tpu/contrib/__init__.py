@@ -1,0 +1,7 @@
+"""apex_tpu.contrib — rebuild of apex.contrib (SURVEY.md §2.3).
+
+Subpackages import lazily:
+  multihead_attn, xentropy, clip_grad, optimizers (distributed/ZeRO),
+  sparsity (ASP), layer_norm, fmha, group_norm, focal_loss, index_mul_2d,
+  transducer.
+"""

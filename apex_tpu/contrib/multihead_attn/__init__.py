@@ -1,0 +1,4 @@
+"""Reference: apex/contrib/multihead_attn/__init__.py."""
+
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import SelfMultiheadAttn  # noqa: F401
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import EncdecMultiheadAttn  # noqa: F401

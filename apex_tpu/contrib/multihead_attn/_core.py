@@ -1,0 +1,65 @@
+"""Shared attention core for the multihead_attn modules.
+
+One implementation of (a) the reference's two-mask folding and (b) the
+fast-vs-default attention dispatch, used by both SelfMultiheadAttn and
+EncdecMultiheadAttn (the reference duplicates this across
+fast_self_multihead_attn_func.py / fast_encdec_multihead_attn_func.py /
+the impl='default' python paths; here it lives once).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention as _flash_attention
+
+_NEG = -1e9
+
+
+def masks_to_bias(key_padding_mask, attn_mask, mask_additive):
+    """Fold the reference's two masks into one additive flash bias
+    broadcastable to [b, 1, sq, sk].
+
+    key_padding_mask: [b, sk] bool (True = pad) or additive float when
+    ``mask_additive``; attn_mask: [sq, sk] likewise.
+    """
+    bias = None
+    if key_padding_mask is not None:
+        if mask_additive:
+            pad = key_padding_mask.astype(jnp.float32)
+        else:
+            pad = jnp.where(key_padding_mask, _NEG, 0.0)
+        bias = pad[:, None, None, :]
+    if attn_mask is not None:
+        if mask_additive:
+            am = attn_mask.astype(jnp.float32)
+        else:
+            am = jnp.where(attn_mask, _NEG, 0.0)
+        am = am[None, None, :, :]
+        bias = am if bias is None else bias + am
+    return bias
+
+
+def attention_core(module, q, q_dim, k, v, bias, rate, impl):
+    """softmax(q k^T / sqrt(d) + bias) v with dropout; fast = Pallas flash
+    kernel (in-kernel dropout), default = unfused jnp ground truth.
+
+    q/k/v: [b, h, s, d]; ``module`` supplies make_rng('dropout') when needed.
+    """
+    scale = q_dim ** -0.5
+    if impl == "fast":
+        seed = (jax.random.randint(module.make_rng("dropout"), (), 0,
+                                   jnp.iinfo(jnp.int32).max)
+                if rate > 0.0 else 0)
+        return _flash_attention(q, k, v, bias=bias, scale=scale,
+                                dropout_rate=rate, dropout_seed=seed)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    p = jax.nn.softmax(s, axis=-1)
+    if rate > 0.0:
+        keep = jax.random.bernoulli(module.make_rng("dropout"), 1.0 - rate,
+                                    p.shape)
+        p = p * keep / (1.0 - rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)

@@ -1,0 +1,132 @@
+"""SelfMultiheadAttn — fused self-attention block.
+
+Reference: apex/contrib/multihead_attn/self_multihead_attn.py:~30 (module) +
+fast_self_multihead_attn_func.py / self_multihead_attn_func.py (autograd fns
+over the fast_multihead_attn CUDA extension — QKV GEMM, masked
+softmax+dropout, AV GEMM, out-proj, optional pre-LN+residual "norm_add").
+
+Here ``impl='fast'`` routes the attention core through the Pallas flash
+kernel (apex_tpu/ops/flash_attention.py) with in-kernel dropout;
+``impl='default'`` is the unfused pure-jnp path that the reference's tests
+use as ground truth. The projections are plain jnp matmuls — on TPU, XLA
+fuses bias/reshape into the MXU GEMM, which is exactly what the CUDA
+strided-batched-GEMM plumbing hand-built.
+
+Layout matches the reference: inputs [seq, batch, embed_dim]; weights are
+torch-layout (out_features, in_features).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.multihead_attn._core import attention_core, masks_to_bias
+from apex_tpu.ops.layer_norm import layer_norm as _layer_norm
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Drop-in for apex.contrib.multihead_attn.SelfMultiheadAttn.
+
+    Ctor args mirror the reference; ``forward`` is ``__call__`` with the same
+    signature (query==key==value for self-attention).
+    """
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+    impl: str = "fast"
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        assert self.embed_dim % self.num_heads == 0, (
+            "embed_dim must be divisible by num_heads")
+        e = self.embed_dim
+        init = nn.initializers.xavier_uniform()
+        if self.separate_qkv_params:
+            self.q_weight = self.param("q_weight", init, (e, e), self.param_dtype)
+            self.k_weight = self.param("k_weight", init, (e, e), self.param_dtype)
+            self.v_weight = self.param("v_weight", init, (e, e), self.param_dtype)
+        else:
+            self.qkv_weight = self.param("qkv_weight", init, (3 * e, e),
+                                         self.param_dtype)
+        if self.bias:
+            zeros = nn.initializers.zeros
+            if self.separate_qkv_params:
+                self.q_bias = self.param("q_bias", zeros, (e,), self.param_dtype)
+                self.k_bias = self.param("k_bias", zeros, (e,), self.param_dtype)
+                self.v_bias = self.param("v_bias", zeros, (e,), self.param_dtype)
+            else:
+                self.qkv_bias = self.param("qkv_bias", zeros, (3 * e,),
+                                           self.param_dtype)
+            self.out_proj_bias = self.param("out_proj_bias", zeros, (e,),
+                                            self.param_dtype)
+        self.out_proj_weight = self.param("out_proj_weight", init, (e, e),
+                                          self.param_dtype)
+        if self.include_norm_add:
+            self.lyr_nrm_gamma_weights = self.param(
+                "lyr_nrm_gamma_weights", nn.initializers.ones, (e,),
+                self.param_dtype)
+            self.lyr_nrm_beta_weights = self.param(
+                "lyr_nrm_beta_weights", nn.initializers.zeros, (e,),
+                self.param_dtype)
+
+    def __call__(self, query, key=None, value=None,
+                 key_padding_mask: Optional[jax.Array] = None,
+                 need_weights: bool = False,
+                 attn_mask: Optional[jax.Array] = None,
+                 is_training: bool = True):
+        del key, value  # self-attention: the reference ignores them too
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights is unsupported by the fused path (same as the "
+                "reference fast impl)")
+        sq, b, e = query.shape
+        h = self.num_heads
+        d = e // h
+        residual = query
+
+        x = query
+        if self.include_norm_add:
+            x = _layer_norm(x, self.lyr_nrm_gamma_weights,
+                            self.lyr_nrm_beta_weights, eps=1e-5)
+
+        if self.separate_qkv_params:
+            q = x @ self.q_weight.T
+            k = x @ self.k_weight.T
+            v = x @ self.v_weight.T
+            if self.bias:
+                q, k, v = q + self.q_bias, k + self.k_bias, v + self.v_bias
+        else:
+            qkv = x @ self.qkv_weight.T
+            if self.bias:
+                qkv = qkv + self.qkv_bias
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        # [sq, b, e] -> [b, h, sq, d]
+        def to_bhsd(t):
+            return t.reshape(sq, b, h, d).transpose(1, 2, 0, 3)
+
+        q, k, v = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+        bias_ = masks_to_bias(key_padding_mask, attn_mask, self.mask_additive)
+        rate = self.dropout if is_training else 0.0
+        ctx = attention_core(self, q, d, k, v, bias_, rate, self.impl)
+
+        # [b, h, sq, d] -> [sq, b, e]
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b, e)
+        out = ctx @ self.out_proj_weight.T
+        if self.bias:
+            out = out + self.out_proj_bias
+        if self.include_norm_add:
+            out = out + residual
+        return out, None
+
+    # torch-style alias
+    forward = __call__

@@ -1,0 +1,3 @@
+"""Reference: apex/contrib/xentropy/__init__.py."""
+
+from apex_tpu.contrib.xentropy.softmax_xentropy import SoftmaxCrossEntropyLoss  # noqa: F401

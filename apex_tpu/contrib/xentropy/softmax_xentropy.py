@@ -1,0 +1,42 @@
+"""Memory-saving label-smoothed cross entropy.
+
+Reference: apex/contrib/xentropy/softmax_xentropy.py:~10 —
+``SoftmaxCrossEntropyLoss`` autograd Function over ``xentropy_cuda``; the
+kernel here is apex_tpu/ops/xentropy.py (saves only logsumexp, recomputes
+softmax in backward).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.ops import xentropy as _ops
+
+
+class SoftmaxCrossEntropyLoss:
+    """Same call surface as the reference autograd Function.
+
+    ``SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing=0.0,
+    padding_idx=0, half_to_float=False)`` returns per-row losses.
+    """
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        losses = _ops.softmax_cross_entropy(
+            logits, labels, smoothing=smoothing, padding_idx=padding_idx)
+        if not half_to_float and losses.dtype != logits.dtype:
+            # reference keeps fp16 losses unless half_to_float=True
+            losses = losses.astype(logits.dtype)
+        return losses
+
+    def __call__(self, logits, labels, smoothing=0.0, padding_idx=0,
+                 half_to_float=False):
+        return self.apply(logits, labels, smoothing, padding_idx,
+                          half_to_float)
+
+
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0,
+                               half_to_float=False):
+    return SoftmaxCrossEntropyLoss.apply(logits, labels, smoothing,
+                                         padding_idx, half_to_float)

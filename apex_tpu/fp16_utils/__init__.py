@@ -17,11 +17,13 @@ DynamicLossScaler = LossScaler
 
 def network_to_half(params, half_dtype=jnp.bfloat16):
     """Reference: apex/fp16_utils/fp16util.py:network_to_half — cast floating
-    leaves to half, keeping norm-ish params fp32 via BN_convert_float."""
-    return jax.tree.map(
+    leaves to half, keeping norm-ish params fp32 (the reference composes
+    ``BN_convert_float(network.half())``; same composition here)."""
+    halved = jax.tree.map(
         lambda x: x.astype(half_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
         params,
     )
+    return BN_convert_float(halved)
 
 
 def BN_convert_float(params):

@@ -1,0 +1,9 @@
+"""Reference: apex/fused_dense/__init__.py."""
+
+from apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    DenseNoBias,
+    FusedDense,
+    FusedDenseGeluDense,
+    fused_dense_function,
+    fused_dense_gelu_dense_function,
+)

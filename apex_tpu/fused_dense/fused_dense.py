@@ -1,0 +1,101 @@
+"""apex.fused_dense equivalent — GEMM with fused bias/GELU epilogues.
+
+Reference: apex/fused_dense/fused_dense.py:~20-200 (``FusedDense``,
+``FusedDenseGeluDense``, ``DenseNoBias`` over csrc/fused_dense_cuda.cu —
+cublasLt GEMMs with bias and gelu_aux epilogues, ~800 LoC). On TPU, XLA's
+epilogue fusion produces exactly these fused GEMMs from the naive
+expression, including saving gelu input for backward via autodiff, so the
+modules are thin; parity is the API and the gelu flavor (tanh approximation,
+matching cublasLt's CUBLASLT_EPILOGUE_GELU_AUX).
+
+Weights are torch-layout (out_features, in_features).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _gelu(x):
+    # cublasLt GELU epilogue uses the tanh approximation
+    return jax.nn.gelu(x, approximate=True)
+
+
+def fused_dense_function(x, weight, bias=None):
+    """Reference: fused_dense_function / FusedDenseFunc."""
+    y = x @ weight.T
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
+    """Reference: fused_dense_gelu_dense_function / FusedDenseGeluDenseFunc."""
+    return fused_dense_function(
+        _gelu(fused_dense_function(x, weight1, bias1)), weight2, bias2)
+
+
+class FusedDense(nn.Module):
+    """Drop-in for apex.fused_dense.FusedDense(in_features, out_features)."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.lecun_normal(),
+                       (self.out_features, self.in_features), self.param_dtype)
+        b = (self.param("bias", nn.initializers.zeros, (self.out_features,),
+                        self.param_dtype) if self.bias else None)
+        return fused_dense_function(x, w, b)
+
+    forward = __call__
+
+
+class DenseNoBias(nn.Module):
+    """Drop-in for apex.fused_dense.DenseNoBias."""
+
+    in_features: int
+    out_features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.lecun_normal(),
+                       (self.out_features, self.in_features), self.param_dtype)
+        return fused_dense_function(x, w, None)
+
+    forward = __call__
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Drop-in for apex.fused_dense.FusedDenseGeluDense(in, intermediate, out)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.bias, (
+            "DenseGeluDense module without bias is currently not supported"
+        )  # same restriction as the reference module
+        w1 = self.param("weight1", nn.initializers.lecun_normal(),
+                        (self.intermediate_features, self.in_features),
+                        self.param_dtype)
+        b1 = self.param("bias1", nn.initializers.zeros,
+                        (self.intermediate_features,), self.param_dtype)
+        w2 = self.param("weight2", nn.initializers.lecun_normal(),
+                        (self.out_features, self.intermediate_features),
+                        self.param_dtype)
+        b2 = self.param("bias2", nn.initializers.zeros, (self.out_features,),
+                        self.param_dtype)
+        return fused_dense_gelu_dense_function(x, w1, b1, w2, b2)
+
+    forward = __call__

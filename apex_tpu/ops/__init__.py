@@ -6,3 +6,10 @@ code paths are unit-testable on CPU). Reference mapping in SURVEY.md §2.2.
 """
 
 from apex_tpu.ops.layer_norm import layer_norm, rms_norm  # noqa: F401
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference  # noqa: F401
+from apex_tpu.ops.scaled_softmax import (  # noqa: F401
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import softmax_cross_entropy  # noqa: F401

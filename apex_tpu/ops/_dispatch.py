@@ -33,3 +33,13 @@ def round_up(x: int, m: int) -> int:
 
 def cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
+
+
+def row_tile(n_cols: int, n_rows: int, *, budget_bytes: int = 2 * 1024 * 1024,
+             cap: int = 256, bytes_per_el: int = 4) -> int:
+    """Row-tile size so one (tile, n_cols) fp32 block stays within a VMEM
+    budget; multiple of 8 (sublane), bounded by ``cap`` and the row count."""
+    tile = max(8, budget_bytes // max(1, n_cols * bytes_per_el))
+    tile = min(tile, cap)
+    tile = max(8, (tile // 8) * 8)
+    return min(tile, round_up(n_rows, 8))

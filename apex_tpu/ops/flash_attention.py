@@ -1,0 +1,631 @@
+"""Pallas flash attention (fwd + bwd) — the TPU answer to the reference's
+attention kernel zoo.
+
+One kernel family subsumes four reference CUDA extensions (SURVEY.md §2.2):
+- ``fast_multihead_attn`` (apex/contrib/csrc/multihead_attn/*.cu — fused QKV
+  GEMM + masked softmax + dropout + AV GEMM, self & enc-dec variants)
+- ``fmhalib`` (apex/contrib/csrc/fmha/ — flash-style MHA, fp16, seqlen <= 512,
+  varlen via cu_seqlens; here varlen = segment_ids and there is NO seqlen cap)
+- ``scaled_masked_softmax_cuda`` / ``scaled_upper_triang_masked_softmax_cuda``
+  (csrc/megatron/ — the softmax is folded into the attention kernel; a
+  standalone fused softmax lives in apex_tpu/ops/scaled_softmax.py)
+- attention dropout (``philox.h``) — threaded TPU PRNG seeded per block so the
+  backward regenerates the identical keep-mask without storing it.
+
+Algorithm: FlashAttention-2 style. Forward tiles (Bq x Bk) with online
+softmax carrying (m, l, acc) in VMEM scratch across the sequential k-block
+grid axis; saves only O and LSE. Backward recomputes P from (q, k, LSE) and
+accumulates dq over k-blocks and (dk, dv) over q-blocks in two kernels.
+All matmuls hit the MXU in the input dtype with fp32 accumulation; softmax
+math is fp32 on the VPU.
+
+Layout: q [B, H, Sq, D], k/v [B, H, Sk, D] (batch-first; module facades adapt
+the reference's seq-first [S, B, H*D] layout).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import _dispatch
+
+_INTERPRET = _dispatch.interpret
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_sizes(sq: int, sk: int, block_q: Optional[int], block_k: Optional[int]):
+    bq = block_q or min(128, _dispatch.round_up(sq, 8))
+    bk = block_k or min(128, _dispatch.round_up(sk, 128))
+    return bq, bk
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = _dispatch.round_up(size, mult) - size
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _mask_block(s, *, b_q, b_k, bq, bk, q_len, kv_len, causal, causal_offset,
+                q_seg, kv_seg):
+    """Padding / causal / segment masking for one (bq, bk) score tile.
+
+    Returns (s_filled, live): masked entries get the finite
+    DEFAULT_MASK_VALUE (NaN-free max), and callers must ALSO zero their
+    exp() by ``live`` — otherwise a fully-masked row degenerates to a
+    uniform distribution over every key including the block padding
+    (fully-masked rows here output exactly 0, like the padded rows of the
+    reference's varlen fmha).
+    """
+    rows = lax.broadcasted_iota(jnp.int32, s.shape, 0) + b_q * bq
+    cols = lax.broadcasted_iota(jnp.int32, s.shape, 1) + b_k * bk
+    mask = cols < kv_len
+    if causal:
+        mask &= (rows + causal_offset) >= cols
+    if q_seg is not None:
+        mask &= q_seg.reshape(-1, 1) == kv_seg.reshape(1, -1)
+    del q_len  # padded q rows produce garbage that the caller slices away
+    return jnp.where(mask, s, DEFAULT_MASK_VALUE), mask
+
+
+def _dropout_keep(shape, rate, seed, bh, row0, col0):
+    """Deterministic keep mask / (1-rate) scale for one score tile.
+
+    Counter-based (Philox-spirit, reference: multihead_attn philox.h): each
+    global (batch*head, row, col) position hashes to a uniform u32 via murmur3
+    finalizer mixing, so forward and both backward kernels regenerate the
+    identical mask from the seed alone — nothing is stored, and the mask is
+    independent of block shape / grid order. Runs on any backend (the VPU cost
+    is a handful of integer ops per element).
+    """
+    rows = lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.uint32(row0)
+    cols = lax.broadcasted_iota(jnp.uint32, shape, 1) + jnp.uint32(col0)
+    x = (rows * jnp.uint32(0x9E3779B1)
+         + cols * jnp.uint32(0x85EBCA77)
+         + jnp.uint32(bh) * jnp.uint32(0xC2B2AE3D)
+         + jnp.uint32(seed))
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    threshold = jnp.uint32(min(int(rate * (2.0 ** 32)), 2 ** 32 - 1))
+    return (x >= threshold).astype(jnp.float32) / (1.0 - rate)
+
+
+# =============================================================================
+# forward
+# =============================================================================
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
+                o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, causal_offset, q_len, kv_len, bq, bk, nk,
+                dropout_rate):
+    b, h, i, j = (pl.program_id(d) for d in range(4))
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # causal: skip blocks strictly above the diagonal band
+    block_live = True
+    if causal:
+        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+
+    @pl.when(block_live)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if bias_ref is not None:
+            s += bias_ref[0, 0].astype(jnp.float32)
+        s, live = _mask_block(
+            s, b_q=i, b_k=j, bq=bq, bk=bk, q_len=q_len, kv_len=kv_len,
+            causal=causal, causal_offset=causal_offset,
+            q_seg=qseg_ref[0] if qseg_ref is not None else None,
+            kv_seg=kseg_ref[0] if kseg_ref is not None else None,
+        )
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        if dropout_rate > 0.0:
+            bh = b * pl.num_programs(1) + h
+            p = p * _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
+                                  bh, i * bq, j * bk)
+        v = v_ref[0, 0]
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → output 0
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[...] + jnp.log(l_safe)
+
+
+def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
+            block_q, block_k):
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
+    d_pad = _dispatch.round_up(d, 128)
+
+    qp = _pad_to(_pad_to(q, 2, bq), 3, 128)
+    kp = _pad_to(_pad_to(k, 2, bk), 3, 128)
+    vp = _pad_to(_pad_to(v, 2, bk), 3, 128)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    nq, nk = sq_p // bq, sk_p // bk
+    causal_offset = kv_len - q_len
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, i, j: (b, h, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, i, j: (b, h, j, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        bias = jnp.broadcast_to(
+            bias, (bias.shape[0], bias.shape[1], q_len, kv_len))
+        bias = _pad_to(_pad_to(bias, 2, bq), 3, bk)
+        bb, bh = bias.shape[0], bias.shape[1]
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bq, bk),
+            lambda b, h, i, j, bb=bb, bh=bh: (b % bb, h % bh, i, j),
+            memory_space=pltpu.VMEM))
+        args.append(bias)
+    if q_seg is not None:
+        qsp = _pad_to(q_seg.astype(jnp.int32), 1, bq)
+        ksp = _pad_to(kv_seg.astype(jnp.int32), 1, bk)
+        # pad kv segments with -1 so padded keys never match a real segment
+        if ksp.shape[1] != kv_seg.shape[1]:
+            ksp = ksp.at[:, kv_seg.shape[1]:].set(-1)
+        # rank-3 with singleton middle dim so block last-two-dims = (1, bq)
+        # satisfies Mosaic's (8, 128)-or-full-dim rule
+        in_specs.append(pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, 0, i),
+                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
+                                     memory_space=pltpu.VMEM))
+        args.extend([qsp[:, None], ksp[:, None]])
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        args.append(seed)
+
+    def fn(*refs):
+        it = iter(refs)
+        q_ref, k_ref, v_ref = next(it), next(it), next(it)
+        bias_ref = next(it) if bias is not None else None
+        qseg_ref = next(it) if q_seg is not None else None
+        kseg_ref = next(it) if q_seg is not None else None
+        seed_ref = next(it) if dropout_rate > 0.0 else None
+        o_ref, lse_ref = next(it), next(it)
+        acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
+                    o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                    scale=scale, causal=causal, causal_offset=causal_offset,
+                    q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk,
+                    dropout_rate=dropout_rate)
+
+    o, lse = pl.pallas_call(
+        fn,
+        grid=(batch, heads, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i, j: (b, h, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, sq_p, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d_pad), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET(),
+    )(*args)
+    return o[:, :, :q_len, :d], lse[:, :, :q_len, 0]
+
+
+# =============================================================================
+# backward
+# =============================================================================
+
+def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
+                 scale, causal, causal_offset, kv_len, bq, bk, b_q, b_k):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if bias_ref is not None:
+        s += bias_ref[0, 0].astype(jnp.float32)
+    s, live = _mask_block(
+        s, b_q=b_q, b_k=b_k, bq=bq, bk=bk, q_len=None, kv_len=kv_len,
+        causal=causal, causal_offset=causal_offset,
+        q_seg=qseg_ref[0] if qseg_ref is not None else None,
+        kv_seg=kseg_ref[0] if kseg_ref is not None else None,
+    )
+    return jnp.where(live, jnp.exp(s - lse_ref[0, 0].reshape(-1, 1)), 0.0)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               bias_ref, qseg_ref, kseg_ref, seed_ref, dq_ref, dq_acc, *,
+               scale, causal, causal_offset, kv_len, bq, bk, nk,
+               dropout_rate):
+    b, h, i, j = (pl.program_id(d) for d in range(4))
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    block_live = True
+    if causal:
+        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+
+    @pl.when(block_live)
+    def _body():
+        p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
+                         scale=scale, causal=causal,
+                         causal_offset=causal_offset, kv_len=kv_len,
+                         bq=bq, bk=bk, b_q=i, b_k=j)
+        do = do_ref[0, 0]
+        v = v_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            bh = b * pl.num_programs(1) + h
+            dp = dp * _dropout_keep(dp.shape, dropout_rate, seed_ref[0, 0],
+                                    bh, i * bq, j * bk)
+        ds = p * (dp - delta_ref[0, 0].reshape(-1, 1)) * scale
+        k = k_ref[0, 0]
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 bias_ref, qseg_ref, kseg_ref, seed_ref, dk_ref, dv_ref,
+                 dk_acc, dv_acc, *,
+                 scale, causal, causal_offset, kv_len, bq, bk, nq,
+                 dropout_rate):
+    # NOTE grid order: (b, h, j over k-blocks, i over q-blocks)
+    b, h, j, i = (pl.program_id(d) for d in range(4))
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    block_live = True
+    if causal:
+        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+
+    @pl.when(block_live)
+    def _body():
+        p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
+                         scale=scale, causal=causal,
+                         causal_offset=causal_offset, kv_len=kv_len,
+                         bq=bq, bk=bk, b_q=i, b_k=j)
+        do = do_ref[0, 0]
+        v = v_ref[0, 0]
+        if dropout_rate > 0.0:
+            bh = b * pl.num_programs(1) + h
+            keep = _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
+                                 bh, i * bq, j * bk)
+            p_dropped = p * keep
+        else:
+            keep = None
+            p_dropped = p
+        dv_acc[...] += jax.lax.dot_general(
+            p_dropped.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if keep is not None:
+            dp = dp * keep
+        ds = p * (dp - delta_ref[0, 0].reshape(-1, 1)) * scale
+        q = q_ref[0, 0]
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
+                 dropout_rate, block_q, block_k, o, lse, do):
+    batch, heads, q_len, d = q.shape
+    kv_len = k.shape[2]
+    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
+    d_pad = _dispatch.round_up(d, 128)
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qp = _pad_to(_pad_to(q, 2, bq), 3, 128)
+    kp = _pad_to(_pad_to(k, 2, bk), 3, 128)
+    vp = _pad_to(_pad_to(v, 2, bk), 3, 128)
+    dop = _pad_to(_pad_to(do, 2, bq), 3, 128)
+    # pad lse with +inf → p = exp(s - inf) = 0 for padded q rows
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - q_len)),
+                   constant_values=jnp.inf)[..., None]
+    deltap = _pad_to(delta, 2, bq)[..., None]
+    nq, nk = sq_p // bq, sk_p // bk
+    causal_offset = kv_len - q_len
+
+    base_args = [qp, kp, vp, dop, lsep, deltap]
+    if bias is not None:
+        bias_b = jnp.broadcast_to(
+            bias, (bias.shape[0], bias.shape[1], q_len, kv_len))
+        bias_p = _pad_to(_pad_to(bias_b, 2, bq), 3, bk)
+        bb, bh = bias_p.shape[0], bias_p.shape[1]
+        base_args.append(bias_p)
+    if q_seg is not None:
+        qsp = _pad_to(q_seg.astype(jnp.int32), 1, bq)
+        ksp = _pad_to(kv_seg.astype(jnp.int32), 1, bk)
+        if ksp.shape[1] != kv_seg.shape[1]:
+            ksp = ksp.at[:, kv_seg.shape[1]:].set(-1)
+        base_args.extend([qsp[:, None], ksp[:, None]])
+    if dropout_rate > 0.0:
+        base_args.append(seed)
+
+    def make_specs(idx_q, idx_k):
+        """Index maps for one kernel given q-block/k-block extractors."""
+        def qspec():
+            return pl.BlockSpec((1, 1, bq, d_pad),
+                                lambda *g: (g[0], g[1], idx_q(g), 0),
+                                memory_space=pltpu.VMEM)
+
+        def kspec():
+            return pl.BlockSpec((1, 1, bk, d_pad),
+                                lambda *g: (g[0], g[1], idx_k(g), 0),
+                                memory_space=pltpu.VMEM)
+
+        def rspec():
+            return pl.BlockSpec((1, 1, bq, 1),
+                                lambda *g: (g[0], g[1], idx_q(g), 0),
+                                memory_space=pltpu.VMEM)
+
+        specs = [qspec(), kspec(), kspec(), qspec(), rspec(), rspec()]
+        if bias is not None:
+            specs.append(pl.BlockSpec(
+                (1, 1, bq, bk),
+                lambda *g: (g[0] % bb, g[1] % bh, idx_q(g), idx_k(g)),
+                memory_space=pltpu.VMEM))
+        if q_seg is not None:
+            specs.append(pl.BlockSpec((1, 1, bq), lambda *g: (g[0], 0, idx_q(g)),
+                                      memory_space=pltpu.VMEM))
+            specs.append(pl.BlockSpec((1, 1, bk), lambda *g: (g[0], 0, idx_k(g)),
+                                      memory_space=pltpu.VMEM))
+        if dropout_rate > 0.0:
+            specs.append(pl.BlockSpec((1, 1), lambda *g: (0, 0),
+                                      memory_space=pltpu.SMEM))
+        return specs
+
+    def split_refs(refs, n_out):
+        it = iter(refs)
+        ins = [next(it) for _ in range(6)]
+        bias_ref = next(it) if bias is not None else None
+        qseg_ref = next(it) if q_seg is not None else None
+        kseg_ref = next(it) if q_seg is not None else None
+        seed_ref = next(it) if dropout_rate > 0.0 else None
+        outs = [next(it) for _ in range(n_out)]
+        scratch = list(it)
+        return ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch
+
+    # ---- dq ----
+    def dq_fn(*refs):
+        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch = \
+            split_refs(refs, 1)
+        _dq_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
+                   outs[0], scratch[0],
+                   scale=scale, causal=causal, causal_offset=causal_offset,
+                   kv_len=kv_len, bq=bq, bk=bk, nk=nk,
+                   dropout_rate=dropout_rate)
+
+    dq = pl.pallas_call(
+        dq_fn,
+        grid=(batch, heads, nq, nk),
+        in_specs=make_specs(lambda g: g[2], lambda g: g[3]),
+        out_specs=[pl.BlockSpec((1, 1, bq, d_pad),
+                                lambda b, h, i, j: (b, h, i, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((batch, heads, sq_p, d_pad), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET(),
+    )(*base_args)[0]
+
+    # ---- dk, dv ----
+    def dkdv_fn(*refs):
+        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch = \
+            split_refs(refs, 2)
+        _dkdv_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
+                     outs[0], outs[1], scratch[0], scratch[1],
+                     scale=scale, causal=causal, causal_offset=causal_offset,
+                     kv_len=kv_len, bq=bq, bk=bk, nq=nq,
+                     dropout_rate=dropout_rate)
+
+    dk, dv = pl.pallas_call(
+        dkdv_fn,
+        grid=(batch, heads, nk, nq),
+        in_specs=make_specs(lambda g: g[3], lambda g: g[2]),
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, j, i: (b, h, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, sk_p, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((batch, heads, sk_p, d_pad), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET(),
+    )(*base_args)
+
+    return (dq[:, :, :q_len, :d], dk[:, :, :kv_len, :d], dv[:, :, :kv_len, :d])
+
+
+# =============================================================================
+# custom-vjp entry
+# =============================================================================
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
+           block_q, block_k):
+    o, _ = _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
+                   dropout_rate, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
+               dropout_rate, block_q, block_k):
+    o, lse = _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
+                     dropout_rate, block_q, block_k)
+    return o, (q, k, v, bias, q_seg, kv_seg, seed, o, lse)
+
+
+def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
+    q, k, v, bias, q_seg, kv_seg, seed, o, lse = res
+    dq, dk, dv = _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale,
+                              causal, dropout_rate, block_q, block_k,
+                              o, lse, do)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseg = None if q_seg is None else jnp.zeros_like(q_seg)
+    dkseg = None if kv_seg is None else jnp.zeros_like(kv_seg)
+    return dq, dk, dv, dbias, dseg, dkseg, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    bias: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: int = 0,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """Flash attention: softmax(scale * q @ k^T + bias [masked]) @ v.
+
+    Args:
+      q: [batch, heads, q_len, head_dim].
+      k, v: [batch, heads, kv_len, head_dim].
+      bias: optional additive bias/mask broadcastable to
+        [batch, heads, q_len, kv_len] (the reference's arbitrary attention
+        mask, generic_scaled_masked_softmax); NOT differentiated (masks are
+        constants in the reference API).
+      segment_ids / kv_segment_ids: optional int32 [batch, len] varlen packing
+        (reference fmha cu_seqlens, apex/contrib/csrc/fmha/fmha_api.cpp);
+        tokens attend only within equal segment ids. kv_segment_ids defaults
+        to segment_ids (self attention).
+      causal: upper-triangular masking (scaled_upper_triang_masked_softmax).
+      scale: softmax scale; default 1/sqrt(head_dim).
+      dropout_rate/dropout_seed: attention-prob dropout (multihead_attn's
+        fused softmax-dropout); the keep mask is regenerated in backward from
+        the seed, never materialized.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    # seed is a *traced* (1,1) SMEM scalar so jitted training steps can vary
+    # it per step without recompiling (unlike a static-arg seed)
+    seed = (jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
+            if dropout_rate > 0.0 else None)
+    return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
+                  float(scale), bool(causal), float(dropout_rate),
+                  block_q, block_k)
+
+
+def mha_reference(q, k, v, bias=None, segment_ids=None, kv_segment_ids=None,
+                  *, causal=False, scale=None, dropout_rate=0.0,
+                  dropout_seed=0):
+    """Pure-jnp unfused reference (the 'impl=default' ground-truth path that
+    the reference's tests compare the fast kernels against)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s += bias.astype(jnp.float32)
+    q_len, kv_len = q.shape[2], k.shape[2]
+    mask = jnp.ones((q_len, kv_len), bool)
+    if causal:
+        rows = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+        mask &= rows >= jnp.arange(kv_len)[None, :]
+    mask = mask[None, None]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, None, :, None]
+                       == kv_segment_ids[:, None, None, :])
+    # same semantics as the kernel: masked entries contribute exactly zero
+    # and fully-masked rows output exactly zero
+    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.where(mask, jnp.exp(s - m), 0.0)
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / jnp.where(denom == 0.0, 1.0, denom)
+    if dropout_rate > 0.0:
+        raise NotImplementedError(
+            "reference path has no in-kernel PRNG; compare dropout runs "
+            "statistically against the kernel instead")
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

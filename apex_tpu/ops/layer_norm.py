@@ -41,11 +41,8 @@ _INTERPRET = _dispatch.interpret
 
 def _row_tile(n_cols: int, n_rows: int, bytes_per_el: int = 4) -> int:
     """Pick a row-tile so x-tile + scratch stay well under VMEM (~16MB)."""
-    budget = 2 * 1024 * 1024  # bytes for the x tile
-    tile = max(8, budget // max(1, n_cols * bytes_per_el))
-    tile = min(tile, 512)
-    tile = max(8, (tile // 8) * 8)
-    return min(tile, _dispatch.round_up(n_rows, 8))
+    return _dispatch.row_tile(n_cols, n_rows, cap=512,
+                              bytes_per_el=bytes_per_el)
 
 
 # =============================================================================

@@ -1,0 +1,12 @@
+"""Reference: apex/transformer/functional/__init__.py."""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+    ScaledMaskedSoftmax,
+    ScaledSoftmax,
+    ScaledUpperTriangMaskedSoftmax,
+)
+from apex_tpu.transformer.functional.fused_rope import (  # noqa: F401
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+)

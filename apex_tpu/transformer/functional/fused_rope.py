@@ -1,0 +1,47 @@
+"""Fused rotary positional embedding.
+
+Reference: ``fused_rotary_positional_embedding`` extension
+(csrc/megatron/fused_rotary_positional_embedding.h/.cpp/_cuda.cu — RoPE apply
+fwd/bwd, cached cos/sin variant). On TPU this is a pure elementwise rewrite
+that XLA fuses into the surrounding matmuls, so there is deliberately no
+Pallas kernel: a hand kernel would only block fusion (SURVEY.md §2.2 row
+"fused_rotary_positional_embedding"). Gradients come from autodiff of the
+same expression, which matches the reference backward (rotation transposed).
+
+Layout matches the reference: t [sq, b, np, hn], freqs [sq, 1, 1, hn2<=hn];
+only the first hn2 features are rotated (partial-rotary supported).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate((-x2, x1), axis=-1)
+
+
+def fused_apply_rotary_pos_emb(t, freqs):
+    """Apply RoPE with freqs in radians (reference fused_apply_rotary_pos_emb).
+
+    t: [sq, b, np, hn]; freqs: [sq, 1, 1, hn2], hn2 <= hn, hn2 even.
+    """
+    hn2 = freqs.shape[-1]
+    rot, pass_through = t[..., :hn2], t[..., hn2:]
+    cos = jnp.cos(freqs).astype(t.dtype)
+    sin = jnp.sin(freqs).astype(t.dtype)
+    rot = rot * cos + _rotate_half(rot) * sin
+    if pass_through.shape[-1] == 0:
+        return rot
+    return jnp.concatenate((rot, pass_through), axis=-1)
+
+
+def fused_apply_rotary_pos_emb_cached(t, cos_, sin_):
+    """Cached-cos/sin variant (reference fused_apply_rotary_pos_emb_cached)."""
+    hn2 = cos_.shape[-1]
+    rot, pass_through = t[..., :hn2], t[..., hn2:]
+    rot = rot * cos_.astype(t.dtype) + _rotate_half(rot) * sin_.astype(t.dtype)
+    if pass_through.shape[-1] == 0:
+        return rot
+    return jnp.concatenate((rot, pass_through), axis=-1)

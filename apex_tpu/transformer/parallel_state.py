@@ -53,6 +53,7 @@ def initialize_model_parallel(
     ``context_parallel_size_`` is a beyond-reference extension (ring
     attention); the reference has no context parallelism (SURVEY.md §2.4).
     """
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
     m = mesh_lib.build_mesh(
@@ -62,6 +63,11 @@ def initialize_model_parallel(
         devices=devices,
     )
     mesh_lib.set_global_mesh(m)
+    # reference sets the virtual rank to 0 whenever a virtual pp size is given
+    # (parallel_state.py:initialize_model_parallel); also clears any rank
+    # leaked from a previous initialization that skipped destroy
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = (
+        0 if virtual_pipeline_model_parallel_size_ is not None else None)
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = virtual_pipeline_model_parallel_size_
     _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank_
     return m

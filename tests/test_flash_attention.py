@@ -1,0 +1,168 @@
+"""Flash attention kernel vs unfused reference.
+
+Mirrors the reference test strategy (SURVEY.md §4): fused kernel vs pure
+framework implementation over dtype/shape/flag grids
+(apex/contrib/test/multihead_attn/, apex/contrib/test/fmha/test_fmha.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.flash_attention import (
+    flash_attention,
+    mha_reference,
+)
+
+TOLS = {jnp.float32: 2e-5, jnp.bfloat16: 2e-2}
+
+
+def _qkv(rng, b, h, sq, sk, d, dtype):
+    q = jnp.asarray(rng.standard_normal((b, h, sq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, h, sk, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, h, sk, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(1, 2, 64, 64, 32), (2, 2, 100, 100, 64),
+                                   (1, 1, 72, 136, 40)])
+def test_forward_matches_reference(rng, dtype, causal, shape):
+    b, h, sq, sk, d = shape
+    q, k, v = _qkv(rng, b, h, sq, sk, d, dtype)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        atol=TOLS[dtype], rtol=TOLS[dtype])
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grads_match_reference(rng, causal):
+    q, k, v = _qkv(rng, 2, 2, 72, 72, 32, jnp.float32)
+
+    g = jax.grad(lambda *a: (flash_attention(*a, causal=causal) ** 2).sum(),
+                 argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (mha_reference(*a, causal=causal) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+
+
+def test_bias_and_cross_attention(rng):
+    b, h, sq, sk, d = 2, 2, 40, 88, 32
+    q, k, v = _qkv(rng, b, h, sq, sk, d, jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((1, h, sq, sk)), jnp.float32)
+    out = flash_attention(q, k, v, bias=bias)
+    ref = mha_reference(q, k, v, bias=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda q: (flash_attention(q, k, v, bias=bias) ** 2).sum())(q)
+    gr = jax.grad(lambda q: (mha_reference(q, k, v, bias=bias) ** 2).sum())(q)
+    np.testing.assert_allclose(g, gr, atol=5e-5, rtol=5e-4)
+
+
+def test_segment_ids_varlen(rng):
+    """Packed-sequence masking (reference fmha cu_seqlens equivalent)."""
+    b, h, s, d = 2, 2, 96, 32
+    q, k, v = _qkv(rng, b, h, s, s, d, jnp.float32)
+    seg = jnp.asarray(rng.integers(0, 3, (b, s)), jnp.int32)
+    seg = jnp.sort(seg, axis=1)  # packed layout: contiguous segments
+    out = flash_attention(q, k, v, segment_ids=seg)
+    ref = mha_reference(q, k, v, segment_ids=seg)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_block_size_invariance(rng):
+    q, k, v = _qkv(rng, 1, 2, 256, 256, 32, jnp.float32)
+    a = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    b_ = flash_attention(q, k, v, causal=True, block_q=64, block_k=256)
+    np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-5)
+
+
+def _np_keep(bh, s1, s2, rate, seed):
+    """Reimplementation of the kernel's counter-based dropout hash."""
+    rows = np.arange(s1, dtype=np.uint32)[:, None] * np.uint32(0x9E3779B1)
+    cols = np.arange(s2, dtype=np.uint32)[None, :] * np.uint32(0x85EBCA77)
+    with np.errstate(over="ignore"):
+        x = rows + cols + np.uint32(bh) * np.uint32(0xC2B2AE3D) + np.uint32(seed)
+        x ^= x >> np.uint32(16)
+        x *= np.uint32(0x85EBCA6B)
+        x ^= x >> np.uint32(13)
+        x *= np.uint32(0xC2B2AE35)
+        x ^= x >> np.uint32(16)
+    thr = np.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return (x >= thr).astype(np.float32) / (1.0 - rate)
+
+
+def test_dropout_exact_vs_explicit_mask(rng):
+    """Fwd AND bwd must equal an explicitly-masked softmax with the same
+    keep mask (reference: fused softmax-dropout in fast_multihead_attn)."""
+    b, h, s, d = 1, 2, 64, 32
+    rate, seed = 0.3, 7
+    q, k, v = _qkv(rng, b, h, s, s, d, jnp.float32)
+    keep = jnp.stack([
+        jnp.stack([jnp.asarray(_np_keep(bi * h + hi, s, s, rate, seed))
+                   for hi in range(h)]) for bi in range(b)])
+
+    def ref_drop(q, k, v):
+        p = jax.nn.softmax(
+            jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d ** 0.5), -1) * keep
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    fused = lambda q, k, v: flash_attention(
+        q, k, v, dropout_rate=rate, dropout_seed=seed)
+    np.testing.assert_allclose(fused(q, k, v), ref_drop(q, k, v),
+                               atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda *a: (fused(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: (ref_drop(*a) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
+        np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
+
+
+def test_dropout_traced_seed_jit(rng):
+    """Seed is a traced scalar: varying it must not recompile or freeze."""
+    q, k, v = _qkv(rng, 1, 1, 32, 32, 16, jnp.float32)
+
+    @jax.jit
+    def run(seed):
+        return flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=seed)
+
+    a = run(jnp.int32(1))
+    b_ = run(jnp.int32(1))
+    c = run(jnp.int32(2))
+    assert jnp.array_equal(a, b_)
+    assert not jnp.array_equal(a, c)
+
+
+def test_fully_masked_rows_output_zero(rng):
+    """Rows with no live keys must output exactly 0 (and zero grads), not a
+    uniform average over padded keys — regression for the finite-fill
+    degenerate case."""
+    # causal cross-attention with q_len > kv_len: first rows see no keys
+    q, k, v = _qkv(rng, 1, 1, 64, 32, 16, jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    assert bool(jnp.all(out[:, :, :31] == 0.0))  # offset = kv-q = -32
+
+    # segment id present in q but absent in kv
+    sq = jnp.zeros((1, 64), jnp.int32).at[:, -8:].set(9)
+    sk_ids = jnp.zeros((1, 32), jnp.int32)
+    out = flash_attention(q, k, v, segment_ids=sq, kv_segment_ids=sk_ids)
+    ref = mha_reference(q, k, v, segment_ids=sq, kv_segment_ids=sk_ids)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    assert bool(jnp.all(out[:, :, -8:] == 0.0))
+    g = jax.grad(lambda v: (flash_attention(
+        q, k, v, segment_ids=sq, kv_segment_ids=sk_ids)[:, :, -8:] ** 2).sum())(v)
+    assert bool(jnp.all(g == 0.0))
+
+
+def test_long_sequence_no_cap(rng):
+    """The reference fmha caps seqlen at 512; this kernel must not."""
+    q, k, v = _qkv(rng, 1, 1, 2048, 2048, 64, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out.astype(jnp.float32),
+                               ref.astype(jnp.float32), atol=3e-2, rtol=3e-2)

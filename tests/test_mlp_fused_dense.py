@@ -1,0 +1,70 @@
+"""MLP and FusedDense modules vs composed reference ops.
+
+Mirrors tests/L0/run_mlp/test_mlp.py (MLP vs nn.Sequential) and
+tests/L0/run_fused_dense/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fused_dense import DenseNoBias, FusedDense, FusedDenseGeluDense
+from apex_tpu.mlp import MLP
+
+
+@pytest.mark.parametrize("activation", ["none", "relu", "sigmoid"])
+@pytest.mark.parametrize("use_bias", [True, False])
+def test_mlp_matches_sequential(rng, activation, use_bias):
+    sizes = (480, 1024, 1024, 512)
+    x = jnp.asarray(rng.standard_normal((16, sizes[0])), jnp.float32)
+    m = MLP(mlp_sizes=sizes, bias=use_bias, activation=activation)
+    variables = m.init(jax.random.PRNGKey(0), x)
+
+    def ref(x):
+        h = x
+        for i in range(len(sizes) - 1):
+            h = h @ variables["params"][f"weight_{i}"].T
+            if use_bias:
+                h = h + variables["params"][f"bias_{i}"]
+            if activation == "relu":
+                h = jax.nn.relu(h)
+            elif activation == "sigmoid":
+                h = jax.nn.sigmoid(h)
+        return h
+
+    np.testing.assert_allclose(m.apply(variables, x), ref(x), atol=1e-5,
+                               rtol=1e-5)
+    g = jax.grad(lambda x: (m.apply(variables, x) ** 2).sum())(x)
+    gr = jax.grad(lambda x: (ref(x) ** 2).sum())(x)
+    np.testing.assert_allclose(g, gr, atol=1e-4, rtol=1e-4)
+
+
+def test_mlp_input_width_checked(rng):
+    m = MLP(mlp_sizes=(8, 4))
+    with pytest.raises(AssertionError):
+        m.init(jax.random.PRNGKey(0), jnp.zeros((2, 9)))
+
+
+def test_fused_dense(rng):
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+    m = FusedDense(32, 16)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    ref = x @ variables["params"]["weight"].T + variables["params"]["bias"]
+    np.testing.assert_allclose(m.apply(variables, x), ref, atol=1e-6)
+
+    m2 = DenseNoBias(32, 16)
+    v2 = m2.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(m2.apply(v2, x),
+                               x @ v2["params"]["weight"].T, atol=1e-6)
+
+
+def test_fused_dense_gelu_dense(rng):
+    x = jnp.asarray(rng.standard_normal((8, 32)), jnp.float32)
+    m = FusedDenseGeluDense(32, 64, 32)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    p = variables["params"]
+    h = x @ p["weight1"].T + p["bias1"]
+    h = jax.nn.gelu(h, approximate=True)
+    ref = h @ p["weight2"].T + p["bias2"]
+    np.testing.assert_allclose(m.apply(variables, x), ref, atol=1e-6)

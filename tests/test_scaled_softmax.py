@@ -1,0 +1,89 @@
+"""Scaled-mask-softmax kernels + FusedScaleMaskSoftmax dispatch vs unfused.
+
+Mirrors tests/L0/run_transformer/test_fused_softmax.py (fused kernels vs the
+torch fallback path on the same inputs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.scaled_softmax import (
+    MASK_FILL,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+
+
+def _ref_masked(x, mask, scale):
+    s = jnp.where(mask, MASK_FILL, x.astype(jnp.float32) * scale)
+    return jax.nn.softmax(s, -1).astype(x.dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("scale", [1.0, 0.5])
+def test_scaled_masked_softmax(rng, dtype, scale):
+    b, h, sq, sk = 2, 3, 40, 100
+    x = jnp.asarray(rng.standard_normal((b, h, sq, sk)), dtype)
+    mask = jnp.asarray(rng.random((b, 1, sq, sk)) < 0.3)
+    y = scaled_masked_softmax(x, mask, scale)
+    ref = _ref_masked(x, mask, scale)
+    tol = 1e-6 if dtype == jnp.float32 else 1e-2
+    np.testing.assert_allclose(y.astype(jnp.float32),
+                               ref.astype(jnp.float32), atol=tol)
+
+
+def test_scaled_masked_softmax_grad(rng):
+    b, h, sq, sk = 1, 2, 24, 72
+    x = jnp.asarray(rng.standard_normal((b, h, sq, sk)), jnp.float32)
+    mask = jnp.asarray(rng.random((b, 1, sq, sk)) < 0.3)
+    g = jax.grad(lambda x: (scaled_masked_softmax(x, mask, 0.7) ** 2).sum())(x)
+    gr = jax.grad(lambda x: (_ref_masked(x, mask, 0.7) ** 2).sum())(x)
+    np.testing.assert_allclose(g, gr, atol=1e-6)
+
+
+def test_upper_triang(rng):
+    ab, s = 6, 33
+    x = jnp.asarray(rng.standard_normal((ab, s, s)), jnp.float32)
+    y = scaled_upper_triang_masked_softmax(x, 2.0)
+    tri = jnp.tril(jnp.ones((s, s), bool))
+    ref = jax.nn.softmax(jnp.where(tri, x * 2.0, MASK_FILL), -1)
+    np.testing.assert_allclose(y, ref, atol=1e-6)
+    g = jax.grad(lambda x: (scaled_upper_triang_masked_softmax(x, 2.0) ** 3).sum())(x)
+    gr = jax.grad(lambda x: (jax.nn.softmax(
+        jnp.where(tri, x * 2.0, MASK_FILL), -1) ** 3).sum())(x)
+    np.testing.assert_allclose(g, gr, atol=1e-6)
+
+
+def test_no_mask(rng):
+    x = jnp.asarray(rng.standard_normal((2, 2, 16, 130)), jnp.float32)
+    np.testing.assert_allclose(scaled_softmax(x, 1.3),
+                               jax.nn.softmax(x * 1.3, -1), atol=1e-6)
+
+
+class TestFusedScaleMaskSoftmax:
+    def test_padding_mask_dispatch(self, rng):
+        m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.padding,
+                                  scale=0.5)
+        x = jnp.asarray(rng.standard_normal((2, 2, 16, 48)), jnp.float32)
+        mask = jnp.asarray(rng.random((2, 1, 16, 48)) < 0.2)
+        fused = m(x, mask)
+        unfused = m.forward_torch_softmax(x, mask)
+        np.testing.assert_allclose(fused, unfused, atol=1e-6)
+
+    def test_causal_dispatch(self, rng):
+        m = FusedScaleMaskSoftmax(attn_mask_type=AttnMaskType.causal)
+        x = jnp.asarray(rng.standard_normal((2, 2, 24, 24)), jnp.float32)
+        fused = m(x)
+        unfused = m.forward_torch_softmax(x, None)
+        np.testing.assert_allclose(fused, unfused, atol=1e-6)
+
+    def test_reference_assertions(self):
+        with pytest.raises(RuntimeError):
+            FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+        with pytest.raises(RuntimeError):
+            FusedScaleMaskSoftmax(scale=2.0, softmax_in_fp32=False)
