@@ -1,0 +1,1 @@
+from apex_tpu.contrib.clip_grad.clip_grad import clip_grad_norm_  # noqa: F401

@@ -1,0 +1,20 @@
+"""Model zoo used by benchmarks, examples, and parity tests.
+
+Reference: the reference ships no model zoo proper — its models live in
+``examples/`` (ResNet-50 ImageNet: examples/imagenet/main_amp.py) and in
+test-only vendored Megatron models (apex/transformer/testing/standalone_bert.py,
+standalone_gpt.py). Here the same roles are played by first-class modules so the
+benchmarks (BASELINE.md configs) are reproducible from the library itself.
+"""
+
+from apex_tpu.models import bert  # noqa: F401
+from apex_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPreTraining,
+    bert_large_config,
+    bert_pretrain_loss,
+    bert_tiny_config,
+    make_pretrain_step,
+    param_partition_specs,
+    synthetic_batch,
+)

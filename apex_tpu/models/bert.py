@@ -1,0 +1,345 @@
+"""BERT for pretraining — the flagship benchmark model (BASELINE.md config #2).
+
+Reference: apex/transformer/testing/standalone_bert.py (test-only vendored
+Megatron BERT) and the MLPerf-BERT lineage of apex's kernels (fmha seqlen<=512,
+fast_layer_norm hidden sizes 768..1024, DistributedFusedLAMB). This module is
+the TPU-native restatement: batch-first [B, S] activations, flash attention
+(apex_tpu.ops.flash_attention subsumes fmhalib + fast_multihead_attn), Pallas
+FusedLayerNorm, XLA-fused GELU MLP (fused_dense_cuda analog), and the fused
+softmax-xentropy loss (xentropy_cuda analog) for MLM + NSP heads.
+
+Parallelism-ready: ``param_partition_specs`` returns Megatron-style
+PartitionSpecs over the ``model`` mesh axis (column-split QKV/FFN-in,
+row-split out-proj/FFN-out — the sharding ColumnParallelLinear /
+RowParallelLinear produce), so the same model runs pure-DP on one chip and
+TP x DP on a mesh with XLA inserting the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops import flash_attention, softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528          # 30522 rounded up to a lane multiple
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layernorm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16        # compute dtype (amp O1/O2 analog)
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def bert_large_config(**overrides) -> BertConfig:
+    return dataclasses.replace(BertConfig(), **overrides)
+
+
+def bert_tiny_config(**overrides) -> BertConfig:
+    """Toy config for unit tests / CPU-mesh dryruns."""
+    base = BertConfig(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        intermediate_size=256, max_position_embeddings=128,
+        hidden_dropout=0.0, attention_dropout=0.0, dtype=jnp.float32,
+    )
+    return dataclasses.replace(base, **overrides)
+
+
+class BertSelfAttention(nn.Module):
+    """Fused QKV -> flash attention -> out-proj (multihead_attn analog)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
+        cfg = self.config
+        e, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+        b, s, _ = x.shape
+        init = nn.initializers.normal(0.02)
+        qkv_w = self.param("qkv_weight", init, (e, 3 * e), cfg.param_dtype)
+        qkv_b = self.param("qkv_bias", nn.initializers.zeros, (3 * e,),
+                           cfg.param_dtype)
+        out_w = self.param("out_weight", init, (e, e), cfg.param_dtype)
+        out_b = self.param("out_bias", nn.initializers.zeros, (e,),
+                           cfg.param_dtype)
+
+        qkv = x @ qkv_w.astype(cfg.dtype) + qkv_b.astype(cfg.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_bhsd(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        rate = 0.0 if deterministic else cfg.attention_dropout
+        ctx = flash_attention(
+            to_bhsd(q), to_bhsd(k), to_bhsd(v), bias=attention_bias,
+            dropout_rate=rate, dropout_seed=dropout_seed,
+        )
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, e)
+        # out-proj stays in compute dtype; the bias add fuses into the GEMM
+        return ctx @ out_w.astype(cfg.dtype) + out_b.astype(cfg.dtype)
+
+
+class BertLayer(nn.Module):
+    """Post-LN encoder layer (original BERT / standalone_bert ordering)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
+        cfg = self.config
+        attn_out = BertSelfAttention(cfg, name="attention")(
+            x, attention_bias, deterministic=deterministic,
+            dropout_seed=dropout_seed)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            attn_out = nn.Dropout(cfg.hidden_dropout)(
+                attn_out, deterministic=False)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                           name="attention_norm")(x + attn_out)
+
+        init = nn.initializers.normal(0.02)
+        w1 = self.param("mlp_weight1", init,
+                        (cfg.hidden_size, cfg.intermediate_size),
+                        cfg.param_dtype)
+        b1 = self.param("mlp_bias1", nn.initializers.zeros,
+                        (cfg.intermediate_size,), cfg.param_dtype)
+        w2 = self.param("mlp_weight2", init,
+                        (cfg.intermediate_size, cfg.hidden_size),
+                        cfg.param_dtype)
+        b2 = self.param("mlp_bias2", nn.initializers.zeros,
+                        (cfg.hidden_size,), cfg.param_dtype)
+        hmid = jax.nn.gelu(x @ w1.astype(cfg.dtype) + b1.astype(cfg.dtype),
+                           approximate=True)
+        mlp_out = hmid @ w2.astype(cfg.dtype) + b2.astype(cfg.dtype)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            mlp_out = nn.Dropout(cfg.hidden_dropout)(
+                mlp_out, deterministic=False)
+        return FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                              name="mlp_norm")(x + mlp_out)
+
+
+class BertForPreTraining(nn.Module):
+    """Embeddings + encoder + MLM head + NSP head.
+
+    ``__call__(input_ids, token_type_ids, attention_mask)`` returns
+    ``(mlm_logits [B,S,V], nsp_logits [B,2])``. The MLM decoder is tied to the
+    word-embedding table (standard BERT; standalone_bert does the same via
+    Megatron's tied embeddings).
+    """
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 *, deterministic: bool = True, dropout_seed=0):
+        cfg = self.config
+        b, s = input_ids.shape
+        init = nn.initializers.normal(0.02)
+
+        word_emb = self.param("word_embeddings", init,
+                              (cfg.vocab_size, cfg.hidden_size),
+                              cfg.param_dtype)
+        pos_emb = self.param("position_embeddings", init,
+                             (cfg.max_position_embeddings, cfg.hidden_size),
+                             cfg.param_dtype)
+        type_emb = self.param("token_type_embeddings", init,
+                              (cfg.type_vocab_size, cfg.hidden_size),
+                              cfg.param_dtype)
+
+        x = jnp.take(word_emb, input_ids, axis=0)
+        x = x + pos_emb[None, :s, :]
+        if token_type_ids is not None:
+            x = x + jnp.take(type_emb, token_type_ids, axis=0)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                           name="embedding_norm")(x)
+        x = x.astype(cfg.dtype)
+        if not deterministic and cfg.hidden_dropout > 0.0:
+            x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=False)
+
+        # padding mask -> additive bias [B, 1, 1, S] (generic_scaled_masked_
+        # softmax analog; flash kernel adds it pre-softmax)
+        attention_bias = None
+        if attention_mask is not None:
+            attention_bias = jnp.where(
+                attention_mask[:, None, None, :].astype(bool), 0.0, -1e9
+            ).astype(jnp.float32)
+
+        for i in range(cfg.num_layers):
+            # decorrelate attention-dropout streams across (step, layer):
+            # plain seed+i would reuse step s layer i+1's mask at step s+1
+            # layer i (the counter-based keep-mask is a pure function of the
+            # seed)
+            layer_seed = (jnp.asarray(dropout_seed, jnp.int32)
+                          * jnp.int32(1000003) + i)
+            x = BertLayer(cfg, name=f"layer_{i}")(
+                x, attention_bias, deterministic=deterministic,
+                dropout_seed=layer_seed)
+
+        # MLM head: dense + gelu + LN + tied decode (BertLMPredictionHead)
+        mlm_w = self.param("mlm_dense_weight", init,
+                           (cfg.hidden_size, cfg.hidden_size), cfg.param_dtype)
+        mlm_b = self.param("mlm_dense_bias", nn.initializers.zeros,
+                           (cfg.hidden_size,), cfg.param_dtype)
+        mlm_out_b = self.param("mlm_output_bias", nn.initializers.zeros,
+                               (cfg.vocab_size,), cfg.param_dtype)
+        hmlm = jax.nn.gelu(x @ mlm_w.astype(cfg.dtype) + mlm_b.astype(cfg.dtype),
+                           approximate=True)
+        hmlm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                              name="mlm_norm")(hmlm).astype(cfg.dtype)
+        mlm_logits = hmlm @ word_emb.T.astype(cfg.dtype) + mlm_out_b.astype(cfg.dtype)
+
+        # NSP head over the [CLS] (position 0) vector
+        pool_w = self.param("pooler_weight", init,
+                            (cfg.hidden_size, cfg.hidden_size), cfg.param_dtype)
+        pool_b = self.param("pooler_bias", nn.initializers.zeros,
+                            (cfg.hidden_size,), cfg.param_dtype)
+        nsp_w = self.param("nsp_weight", init, (cfg.hidden_size, 2),
+                           cfg.param_dtype)
+        nsp_b = self.param("nsp_bias", nn.initializers.zeros, (2,),
+                           cfg.param_dtype)
+        pooled = jnp.tanh(x[:, 0, :] @ pool_w.astype(cfg.dtype)
+                          + pool_b.astype(cfg.dtype))
+        nsp_logits = pooled @ nsp_w.astype(cfg.dtype) + nsp_b.astype(cfg.dtype)
+        return mlm_logits, nsp_logits
+
+
+def bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+    """MLM + NSP loss via the fused xentropy kernel.
+
+    ``mlm_labels`` uses 0 (= [PAD]) for unpredicted positions — the fused
+    kernel's ``padding_idx`` semantics zero those rows (reference:
+    apex/contrib/xentropy label smoothing test uses the same convention).
+    """
+    v = mlm_logits.shape[-1]
+    per_tok = softmax_cross_entropy(
+        mlm_logits.reshape(-1, v).astype(jnp.float32),
+        mlm_labels.reshape(-1), padding_idx=0)
+    denom = jnp.maximum((mlm_labels.reshape(-1) != 0).sum(), 1)
+    mlm_loss = per_tok.sum() / denom
+    nsp_loss = softmax_cross_entropy(
+        nsp_logits.astype(jnp.float32), nsp_labels, padding_idx=-1).mean()
+    return mlm_loss + nsp_loss
+
+
+# =============================================================================
+# Parallelism: Megatron-style PartitionSpecs (SURVEY.md §2.4 TP column)
+# =============================================================================
+
+def param_partition_specs(params) -> Any:
+    """PartitionSpec pytree: TP over the ``model`` axis, Megatron layout.
+
+    Column-parallel (split output features): qkv_weight, mlp_weight1 —
+    ColumnParallelLinear's sharding. Row-parallel (split input features):
+    out_weight, mlp_weight2 — RowParallelLinear's. Vocab-parallel: word
+    embeddings split over vocab (VocabParallelEmbedding). Everything else
+    (norms, biases of row-parallel layers, pos/type embeddings) replicated.
+    XLA GSPMD then inserts exactly the collectives the reference's
+    mappings.py issues by hand.
+    """
+
+    def spec_for(path: str, x) -> P:
+        if "qkv_weight" in path:
+            return P(None, MODEL_AXIS)        # column: split 3*e outputs
+        if "qkv_bias" in path:
+            return P(MODEL_AXIS)
+        if "mlp_weight1" in path:
+            return P(None, MODEL_AXIS)        # column: split intermediate
+        if "mlp_bias1" in path:
+            return P(MODEL_AXIS)
+        if "out_weight" in path:
+            return P(MODEL_AXIS, None)        # row: split e inputs
+        if "mlp_weight2" in path:
+            return P(MODEL_AXIS, None)        # row: split intermediate inputs
+        if "word_embeddings" in path:
+            return P(MODEL_AXIS, None)        # vocab-parallel embedding
+        return P()
+
+    from apex_tpu.optimizers.common import path_name
+
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: spec_for(path_name(p), x), params)
+
+
+def synthetic_batch(rng, cfg: BertConfig, batch_size: int, seq_len: int,
+                    mlm_fraction: float = 0.15) -> Dict[str, jnp.ndarray]:
+    """Random pretraining batch (the benchmark uses synthetic data, like the
+    reference's tests/L1 synthetic-data mode)."""
+    ids = rng.integers(4, cfg.vocab_size, size=(batch_size, seq_len))
+    mlm_mask = rng.random((batch_size, seq_len)) < mlm_fraction
+    return {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "token_type_ids": jnp.asarray(
+            rng.integers(0, cfg.type_vocab_size, size=(batch_size, seq_len)),
+            jnp.int32),
+        "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
+        "mlm_labels": jnp.asarray(ids * mlm_mask, jnp.int32),
+        "nsp_labels": jnp.asarray(
+            rng.integers(0, 2, size=(batch_size,)), jnp.int32),
+    }
+
+
+def make_pretrain_step(model: BertForPreTraining, mesh=None,
+                       partition_params: bool = False):
+    """Build the jitted grad step: (params, batch, seed) -> (loss, grads).
+
+    DP comes from sharding the batch over ``data``; TP (optional) from
+    partitioning params over ``model`` via ``param_partition_specs``. The
+    optimizer step (FusedLAMB.step) is its own jitted+donated call — together
+    they are the full training step of BASELINE config #2.
+    """
+
+    def loss_fn(params, batch, seed):
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"], batch["token_type_ids"],
+            batch["attention_mask"],
+            deterministic=False, dropout_seed=seed,
+            rngs={"dropout": jax.random.fold_in(jax.random.PRNGKey(0), seed)},
+        )
+        return bert_pretrain_loss(mlm_logits, nsp_logits,
+                                  batch["mlm_labels"], batch["nsp_labels"])
+
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    if mesh is None:
+        return jax.jit(grad_fn)
+
+    from jax.sharding import NamedSharding
+
+    batch_spec = {
+        "input_ids": P(DATA_AXIS, CONTEXT_AXIS),
+        "token_type_ids": P(DATA_AXIS, CONTEXT_AXIS),
+        "attention_mask": P(DATA_AXIS, CONTEXT_AXIS),
+        "mlm_labels": P(DATA_AXIS, CONTEXT_AXIS),
+        "nsp_labels": P(DATA_AXIS),
+    }
+    batch_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def with_param_sharding(params):
+        specs = (param_partition_specs(params) if partition_params
+                 else jax.tree.map(lambda _: P(), params))
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs)
+
+    step = jax.jit(grad_fn, in_shardings=(None, batch_sh, None))
+    return step, with_param_sharding, batch_sh

@@ -40,8 +40,11 @@ _INTERPRET = _dispatch.interpret
 
 
 def _row_tile(n_cols: int, n_rows: int, bytes_per_el: int = 4) -> int:
-    """Pick a row-tile so x-tile + scratch stay well under VMEM (~16MB)."""
-    return _dispatch.row_tile(n_cols, n_rows, cap=512,
+    """Pick a row-tile so x-tile + scratch stay under the 16MB scoped-VMEM
+    limit: the bwd kernel holds ~8 fp32 tile-sized arrays (x, dy, xhat, dx,
+    partial dgamma/dbeta, temporaries), so cap tiles at 1MB each."""
+    return _dispatch.row_tile(n_cols, n_rows, cap=256,
+                              budget_bytes=1024 * 1024,
                               bytes_per_el=bytes_per_el)
 
 

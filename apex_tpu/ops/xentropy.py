@@ -33,7 +33,10 @@ _INTERPRET = _dispatch.interpret
 
 
 def _row_tile(vocab: int, rows: int) -> int:
-    return _dispatch.row_tile(vocab, rows, budget_bytes=4 * 1024 * 1024,
+    # budget sized so the ~5 fp32 intermediates the bwd kernel materializes
+    # (x cast, p, onehot match, grad, dx) stay under the default 16MB scoped
+    # VMEM limit at BERT/GPT vocab (~30-50k cols)
+    return _dispatch.row_tile(vocab, rows, budget_bytes=1024 * 1024,
                               cap=128)
 
 

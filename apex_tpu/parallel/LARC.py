@@ -1,0 +1,98 @@
+"""LARC — layer-wise adaptive rate clipping/scaling.
+
+Reference: apex/parallel/LARC.py:~40 — wraps any optimizer; before the inner
+``step()`` it rescales each param's grad by the local lr
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p||)
+
+clipped at the global lr (``clip=True``, LARC) or used directly
+(``clip=False``, LARS-style scaling). Params with zero norm pass through.
+
+Here the wrapper composes with the fused optimizers: per-tensor param/grad
+norms come from the flat-buffer segment-norm kernel pass the optimizer
+already owns (csrc/multi_tensor_l2norm analog), the grads are rescaled
+per-segment, and the inner fused step runs unchanged — all inside one jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flat_buffer, optim_kernels
+from apex_tpu.optimizers.common import FusedOptimizerBase
+
+
+class LARC:
+    """Wraps a FusedOptimizerBase (or any object with ``step(grads)``)."""
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self._jit_scale = None
+        # reference semantics: LARC folds wd into the scaled grad
+        # (p.grad += wd * p before the local-lr scale) and zeroes the inner
+        # optimizer's weight_decay during step() so it isn't applied twice
+        if isinstance(optimizer, FusedOptimizerBase):
+            if optimizer.wd_per_segment is not None:
+                self._wd = optimizer.wd_per_segment      # (num_tensors,) fp32
+                optimizer.wd_per_segment = None
+            else:
+                self._wd = float(optimizer.defaults.get("weight_decay", 0.0))
+            optimizer.defaults["weight_decay"] = 0.0
+
+    # attribute passthrough (the reference forwards state/param_groups too)
+    def __getattr__(self, name):
+        return getattr(self.optim, name)
+
+    def state_dict(self):
+        return self.optim.state_dict()
+
+    def load_state_dict(self, sd):
+        self.optim.load_state_dict(sd)
+
+    def _scale_grads_fused(self, grads):
+        """Per-tensor trust-ratio scaling on the flat buffer (one fused pass)."""
+        opt: FusedOptimizerBase = self.optim
+        spec = opt.spec
+
+        def _scale(g_tree, master, lr, wd):
+            g = flat_buffer.flatten(g_tree, spec)
+            # one fused pass: per-tensor ||g||^2 and ||p||^2 together
+            stats = optim_kernels.segment_stats(
+                g, opt.seg_rows, spec.num_tensors, b=master)
+            gn = jnp.sqrt(stats[optim_kernels.STAT_SUMSQ_A, :spec.num_tensors])
+            pn = jnp.sqrt(stats[optim_kernels.STAT_SUMSQ_B, :spec.num_tensors])
+            adaptive = self.trust_coefficient * pn / (gn + wd * pn + self.eps)
+            if self.clip:
+                # reference: local_lr capped so local_lr/global_lr <= 1
+                factor = jnp.minimum(adaptive / lr, 1.0)
+            else:
+                factor = adaptive
+            factor = jnp.where((pn > 0) & (gn > 0), factor, 1.0)
+            # reference LARC: grad <- local_lr * (grad + wd * p); the inner
+            # optimizer then steps with weight_decay = 0 (set in __init__)
+            wd_rows = (wd if jnp.ndim(wd) == 0 else wd[opt.seg_rows][:, None])
+            g = (g + wd_rows * master) * factor[opt.seg_rows][:, None]
+            return flat_buffer.unflatten(g, spec)
+
+        if self._jit_scale is None:
+            self._jit_scale = jax.jit(_scale)
+        lr = jnp.float32(self.optim.defaults.get("lr", 1e-3))
+        wd = jnp.asarray(self._wd, jnp.float32)
+        return self._jit_scale(grads, self.optim.master, lr, wd)
+
+    def step(self, grads, **kw):
+        if isinstance(self.optim, FusedOptimizerBase):
+            grads = self._scale_grads_fused(grads)
+        else:
+            grads = self._scale_grads_tree(grads)
+        return self.optim.step(grads, **kw)
+
+    def _scale_grads_tree(self, grads):
+        raise NotImplementedError(
+            "LARC requires a fused optimizer (FusedAdam/FusedSGD/...) — "
+            "the reference likewise wraps a torch.optim.Optimizer")

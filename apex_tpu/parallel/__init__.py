@@ -1,0 +1,21 @@
+"""Data-parallel utilities — reference: apex/parallel/*.
+
+- ``DistributedDataParallel`` (apex/parallel/distributed.py:~200): bucketed,
+  overlapped NCCL allreduce of grads. Under ``pjit`` over a sharded ``data``
+  axis the SPMD partitioner inserts (and the latency-hiding scheduler
+  overlaps) the gradient all-reduce, so the facade here keeps the API while
+  the mechanism is native; a manual-axes path is provided for ``shard_map``
+  training loops.
+- ``SyncBatchNorm`` (apex/parallel/optimized_sync_batchnorm.py + syncbn CUDA
+  ext): batch-norm stats psum'd across the ``data`` axis.
+- ``LARC`` (apex/parallel/LARC.py): layer-wise adaptive rate clipping wrapper.
+- ``convert_syncbn_model`` (apex/parallel/__init__.py:~20): recursive
+  BatchNorm -> SyncBatchNorm conversion.
+"""
+
+from apex_tpu.parallel.distributed import DistributedDataParallel  # noqa: F401
+from apex_tpu.parallel.LARC import LARC  # noqa: F401
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+)

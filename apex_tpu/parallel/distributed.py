@@ -1,0 +1,84 @@
+"""DistributedDataParallel facade.
+
+Reference: apex/parallel/distributed.py:~200 — wraps a module, broadcasts
+params rank0->all at construction, registers per-param grad hooks that bucket
+grads (``message_size`` bytes), flatten them (apex_C.flatten) and allreduce on
+a side stream overlapped with backward; ``delay_allreduce`` defers everything
+to the end of backward.
+
+On TPU every piece of that machinery is owned by XLA:
+
+- *bucketing/flattening* — the SPMD partitioner emits one fused all-reduce
+  per fusion group and sizes them itself;
+- *overlap* — the latency-hiding scheduler interleaves grad collectives with
+  remaining backward compute (the reference's side-stream trick);
+- *broadcast at init* — replicated param sharding IS the broadcast.
+
+So under ``pjit`` the wrapper only needs to (a) mark the batch as sharded over
+``data`` and (b) average the loss/grads over that axis — which autodiff of a
+``pmean`` loss already does. The explicit machinery survives in one place:
+``allreduce_gradients`` for manual ``shard_map`` loops, the moral equivalent
+of the reference's ``flat_dist_call``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.mesh import DATA_AXIS
+
+
+class DistributedDataParallel:
+    """API-parity wrapper over a flax module or apply-fn.
+
+    ``DistributedDataParallel(model)(params, *args)`` calls the model;
+    gradient synchronization happens in the caller's jitted step (pjit) or via
+    ``allreduce_gradients`` (shard_map). Ctor kwargs of the reference
+    (``message_size``, ``delay_allreduce``, ``allreduce_trigger_params``,
+    ``gradient_average``, ``retain_allreduce_buffers``, ...) are accepted and
+    recorded but have no TPU mechanism to drive — XLA decides bucketing and
+    overlap; they exist so reference training scripts port unchanged.
+    """
+
+    def __init__(self, module, message_size: int = 10_000_000,
+                 delay_allreduce: bool = False, shared_param: Optional[bool] = None,
+                 allreduce_trigger_params=None, retain_allreduce_buffers: bool = False,
+                 allreduce_always_fp32: bool = False, num_allreduce_streams: int = 1,
+                 allreduce_communicators=None, gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 axis_name: str = DATA_AXIS):
+        self.module = module
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_predivide_factor = gradient_predivide_factor
+        # recorded-only knobs (see class docstring)
+        self.message_size = message_size
+        self.delay_allreduce = delay_allreduce
+
+    def __call__(self, *args, **kwargs):
+        if hasattr(self.module, "apply"):
+            return self.module.apply(*args, **kwargs)
+        return self.module(*args, **kwargs)
+
+    forward = __call__
+
+    def allreduce_gradients(self, grads):
+        """Average a grad pytree over the data axis (shard_map loops only;
+        reference: allreduce_hook/allreduce_bucket + gradient_average)."""
+        import jax.numpy as jnp
+
+        def red(g):
+            g32 = g.astype(jnp.float32) if self.allreduce_always_fp32 else g
+            if self.gradient_predivide_factor != 1.0:
+                g32 = g32 / self.gradient_predivide_factor
+            out = lax.psum(g32, self.axis_name)
+            if self.gradient_average:
+                n = lax.axis_size(self.axis_name)
+                out = out / (n / self.gradient_predivide_factor)
+            return out.astype(g.dtype)
+
+        return jax.tree.map(red, grads)

@@ -1,0 +1,123 @@
+"""Flagship benchmark: BERT-Large pretraining step (BASELINE.md config #2).
+
+Runs the full training step — bf16 forward/backward with Pallas flash
+attention + FusedLayerNorm, fused softmax-xentropy loss, FusedLAMB flat-buffer
+optimizer — on the available device(s) and reports tokens/sec/chip and MFU.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = measured MFU / 0.45 (the BASELINE.json north-star MFU target).
+All diagnostics go to stderr.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# bf16 peak FLOPs/s per chip by device kind (public TPU specs)
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def peak_flops(device) -> float:
+    kind = device.device_kind.lower()
+    for token, f in PEAK_FLOPS:
+        if token in kind:
+            return f
+    log(f"unknown device kind {device.device_kind!r}; assuming v5e peak")
+    return 197e12
+
+
+def model_flops_per_token(cfg, seq_len: int) -> float:
+    """Matmul FLOPs per token, fwd+bwd (bwd = 2x fwd), BERT-Large shape."""
+    e, i, L, v = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                  cfg.vocab_size)
+    per_layer = 8 * e * e + 4 * seq_len * e + 4 * e * i
+    head = 2 * e * e + 2 * e * v
+    return 3.0 * (L * per_layer + head)
+
+
+def main():
+    from apex_tpu.models import (BertForPreTraining, bert_large_config,
+                                 make_pretrain_step, synthetic_batch)
+    from apex_tpu.optimizers import FusedLAMB
+
+    batch_size = int(os.environ.get("APEX_TPU_BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("APEX_TPU_BENCH_SEQ", "512"))
+    steps = int(os.environ.get("APEX_TPU_BENCH_STEPS", "10"))
+
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    log(f"devices: {n_chips} x {dev.device_kind} ({dev.platform})")
+
+    cfg = bert_large_config(max_position_embeddings=max(512, seq_len))
+    model = BertForPreTraining(cfg)
+    rng = np.random.default_rng(0)
+    batch = synthetic_batch(rng, cfg, batch_size, seq_len)
+
+    log("initializing BERT-Large params...")
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"], batch["attention_mask"])["params"]
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    log(f"params: {n_params/1e6:.1f}M")
+
+    step = make_pretrain_step(model)
+    opt = FusedLAMB(
+        params, lr=1e-4, weight_decay=0.01,
+        exclude_from_weight_decay=lambda n: "bias" in n or "norm" in n.lower())
+
+    def train_step(p, i):
+        loss, grads = step(p, batch, i)
+        return loss, opt.step(grads)
+
+    log("compiling + warmup...")
+    t0 = time.perf_counter()
+    loss, params = train_step(params, 0)
+    jax.block_until_ready(params)
+    log(f"first step (compile) {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}")
+    loss, params = train_step(params, 1)
+    jax.block_until_ready(params)
+
+    log(f"timing {steps} steps...")
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, params = train_step(params, 2 + i)
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch_size * seq_len
+    tok_per_sec_chip = tokens / dt / n_chips
+    flops = model_flops_per_token(cfg, seq_len) * tokens
+    mfu = flops / dt / (peak_flops(dev) * n_chips)
+    log(f"step {dt*1e3:.1f}ms  loss={float(loss):.3f}  "
+        f"tokens/s/chip={tok_per_sec_chip:.0f}  MFU={mfu*100:.1f}%")
+
+    print(json.dumps({
+        "metric": "bert_large_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,216 @@
+"""apex_tpu.parallel: SyncBatchNorm, DDP facade, LARC, clip_grad.
+
+Mirrors the reference suites: tests/distributed/synced_batchnorm (SyncBN vs
+BatchNorm on the gathered batch), ddp_race_condition_test's role (grad
+averaging correctness), tests/L0/run_amp/test_larc.py, and
+apex/contrib/test/clip_grad.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import DATA_AXIS
+
+
+# --- SyncBatchNorm -----------------------------------------------------------
+
+def test_syncbn_matches_batchnorm_on_gathered_batch(mesh8, rng):
+    """The canonical reference check (two_gpu_unit_test.py): SyncBN over N
+    shards == plain BN over the concatenated batch."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    x = rng.standard_normal((16, 6, 6, 8), dtype=np.float32)
+    bn = SyncBatchNorm(num_features=8, axis_name=DATA_AXIS)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+    # ground truth: local-only stats over the FULL batch
+    ref = SyncBatchNorm(num_features=8, axis_name=None)
+    y_ref, ref_state = ref.apply(variables, jnp.asarray(x),
+                                 mutable=["batch_stats"])
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh8,
+        in_specs=(P(), P(DATA_AXIS)), out_specs=(P(DATA_AXIS), P()))
+    def sharded(vars_, xs):
+        y, st = bn.apply(vars_, xs, mutable=["batch_stats"])
+        return y, st
+
+    y, st = sharded(variables, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(st["batch_stats"]["mean"]),
+        np.asarray(ref_state["batch_stats"]["mean"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(st["batch_stats"]["var"]),
+        np.asarray(ref_state["batch_stats"]["var"]), rtol=1e-5, atol=1e-6)
+
+
+def test_syncbn_backward_matches_gathered(mesh8, rng):
+    from apex_tpu.parallel import SyncBatchNorm
+
+    x = rng.standard_normal((16, 8), dtype=np.float32)
+    bn_sync = SyncBatchNorm(num_features=8, axis_name=DATA_AXIS,
+                            track_running_stats=False)
+    bn_local = SyncBatchNorm(num_features=8, axis_name=None,
+                             track_running_stats=False)
+    variables = bn_local.init(jax.random.PRNGKey(0), jnp.asarray(x))
+
+    def loss_ref(v, xs):
+        return jnp.sum(bn_local.apply(v, xs) ** 2)
+
+    g_ref = jax.grad(loss_ref)(variables, jnp.asarray(x))
+
+    @functools.partial(jax.shard_map, mesh=mesh8,
+                       in_specs=(P(), P(DATA_AXIS)), out_specs=P())
+    def sharded_grad(v, xs):
+        # the transpose of the replicated-param broadcast (pvary) already
+        # psums the per-shard cotangents — no explicit collective needed
+        return jax.grad(lambda vv: jnp.sum(bn_sync.apply(vv, xs) ** 2))(v)
+
+    g = sharded_grad(variables, jnp.asarray(x))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                                rtol=1e-4, atol=1e-4),
+        g, g_ref)
+
+
+def test_syncbn_running_average_inference(rng):
+    from apex_tpu.parallel import SyncBatchNorm
+
+    bn = SyncBatchNorm(num_features=4, axis_name=None)
+    x = jnp.asarray(rng.standard_normal((32, 4), dtype=np.float32)) * 3 + 1
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    _, st = bn.apply(variables, x, mutable=["batch_stats"])
+    y = bn.apply({**variables, **st}, x, use_running_average=True)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_convert_syncbn_model(rng):
+    """A real flax nn.BatchNorm field is rewritten to SyncBatchNorm and
+    produces the same (local) normalization (reference:
+    apex/parallel/__init__.py convert_syncbn_model walking named_children)."""
+    import flax.linen as nn
+
+    from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+    class Net(nn.Module):
+        bn: nn.Module
+
+        @nn.compact
+        def __call__(self, x):
+            return self.bn(x)
+
+    x = jnp.asarray(rng.standard_normal((16, 4), dtype=np.float32)) * 2 + 3
+    ref_net = Net(bn=nn.BatchNorm(use_running_average=False, momentum=0.9))
+    ref_vars = ref_net.init(jax.random.PRNGKey(0), x)
+    y_ref, _ = ref_net.apply(ref_vars, x, mutable=["batch_stats"])
+
+    net = convert_syncbn_model(ref_net)
+    assert isinstance(net.bn, SyncBatchNorm)
+    v = net.init(jax.random.PRNGKey(0), x)
+    y, st = net.apply(v, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+    # torch-momentum conversion: running mean moved by flax-momentum 0.9
+    # -> torch momentum 0.1 of the batch mean
+    np.testing.assert_allclose(np.asarray(st["batch_stats"]["bn"]["mean"]),
+                               0.1 * np.asarray(x).mean(0), rtol=1e-4)
+
+
+# --- DDP facade --------------------------------------------------------------
+
+def test_ddp_allreduce_gradients(mesh8, rng):
+    from apex_tpu.parallel import DistributedDataParallel
+
+    ddp = DistributedDataParallel(lambda x: x)
+    g_local = rng.standard_normal((8, 4), dtype=np.float32)
+
+    @functools.partial(jax.shard_map, mesh=mesh8,
+                       in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))
+    def avg(g):
+        return ddp.allreduce_gradients({"w": g})["w"]
+
+    out = avg(jnp.asarray(g_local))
+    expect = np.broadcast_to(g_local.reshape(8, 1, 4).mean(0), (8, 1, 4)
+                             ).reshape(8, 4)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_ddp_call_passthrough():
+    from apex_tpu.parallel import DistributedDataParallel
+
+    ddp = DistributedDataParallel(lambda x: x * 2, message_size=123,
+                                  delay_allreduce=True)
+    assert ddp(3) == 6
+    assert ddp.message_size == 123
+
+
+# --- LARC --------------------------------------------------------------------
+
+def test_larc_scales_large_grads(rng):
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import LARC
+
+    params = {"w": jnp.ones((64, 64)) * 0.1, "b": jnp.zeros((64,))}
+    opt = FusedSGD(params, lr=0.1, weight_decay=0.0)
+    larc = LARC(opt, trust_coefficient=0.02, clip=True)
+    grads = {"w": jnp.ones((64, 64)) * 100.0, "b": jnp.zeros((64,))}
+    new_params = larc.step(grads)
+    # without LARC: w - 0.1*100 = -9.99; with LARC the update is clipped to
+    # local_lr*g where local_lr = 0.02*||p||/||g|| << lr
+    delta = np.abs(np.asarray(new_params["w"]) - 0.1).max()
+    assert delta < 0.01, delta
+    # zero-norm grads pass through unscaled
+    np.testing.assert_allclose(np.asarray(new_params["b"]), 0.0)
+
+
+def test_larc_no_clip_is_lars(rng):
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.parallel import LARC
+
+    params = {"w": jnp.ones((8, 8))}
+    g = rng.standard_normal((8, 8), dtype=np.float32)
+    opt = FusedSGD(params, lr=1.0, weight_decay=0.0)
+    larc = LARC(opt, trust_coefficient=0.5, clip=False)
+    new_params = larc.step({"w": jnp.asarray(g)})
+    pn = np.linalg.norm(np.ones((8, 8)))
+    gn = np.linalg.norm(g)
+    expect = 1.0 - (0.5 * pn / (gn + 1e-8)) * g
+    np.testing.assert_allclose(np.asarray(new_params["w"]), expect, rtol=1e-4)
+
+
+# --- clip_grad ---------------------------------------------------------------
+
+def test_clip_grad_norm_matches_reference(rng):
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    grads = {"a": jnp.asarray(rng.standard_normal((33, 17), dtype=np.float32)),
+             "b": jnp.asarray(rng.standard_normal((129,), dtype=np.float32))}
+    flat = np.concatenate([np.asarray(g).ravel() for g in jax.tree.leaves(grads)])
+    expect_norm = np.linalg.norm(flat)
+
+    clipped, norm = clip_grad_norm_(grads, max_norm=1.0)
+    np.testing.assert_allclose(float(norm), expect_norm, rtol=1e-5)
+    scale = 1.0 / (expect_norm + 1e-6)
+    np.testing.assert_allclose(
+        np.asarray(clipped["a"]), np.asarray(grads["a"]) * scale, rtol=1e-5)
+
+    # under the max -> unchanged
+    clipped2, _ = clip_grad_norm_(grads, max_norm=1e9)
+    np.testing.assert_allclose(np.asarray(clipped2["b"]),
+                               np.asarray(grads["b"]), rtol=1e-6)
+
+
+def test_clip_grad_norm_inf(rng):
+    from apex_tpu.contrib.clip_grad import clip_grad_norm_
+
+    grads = {"a": jnp.asarray(rng.standard_normal((5, 5), dtype=np.float32))}
+    _, norm = clip_grad_norm_(grads, max_norm=1.0, norm_type=float("inf"))
+    np.testing.assert_allclose(float(norm),
+                               np.abs(np.asarray(grads["a"])).max(), rtol=1e-6)
