@@ -1,0 +1,148 @@
+"""Megatron-style tensor-parallel GPT (BASELINE.md config #4).
+
+Reference: apex/transformer/testing/standalone_gpt.py (test-only vendored
+Megatron GPT driving the TP layers) — here a first-class model: pre-LN
+decoder blocks whose QKV/out-proj and MLP are ColumnParallel/RowParallel
+linears, VocabParallelEmbedding + vocab-parallel cross entropy, causal
+Pallas flash attention on the LOCAL head shard (heads divide over the
+``model`` axis, the Megatron attention-head split).
+
+Runs inside ``shard_map`` with the ``model`` axis bound (TP>1) or plain
+(TP=1, collectives degrade to identity via the layers' axis guards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops import flash_attention
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_is_bound as _axis_bound,
+)
+from apex_tpu.transformer.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 50257 rounded to lane multiple
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    layernorm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tensor_parallel_size: int = 1    # static tp world for shard shapes
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt2_small_config(**overrides) -> GPTConfig:
+    return dataclasses.replace(GPTConfig(), **overrides)
+
+
+def gpt_tiny_config(**overrides) -> GPTConfig:
+    base = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128,
+                     dtype=jnp.float32)
+    return dataclasses.replace(base, **overrides)
+
+
+class ParallelDecoderBlock(nn.Module):
+    """Pre-LN block: LN -> TP attention -> residual -> LN -> TP MLP -> res."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        tp = cfg.tensor_parallel_size
+        e = cfg.hidden_size
+        h_local = divide(cfg.num_heads, tp)
+        d = cfg.head_dim
+        b, s, _ = x.shape
+
+        h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="input_norm")(x)
+        h = h.astype(cfg.dtype)
+        # QKV column-parallel: local output is the local heads' q,k,v
+        qkv = ColumnParallelLinear(
+            e, 3 * e, gather_output=False, world_size=tp,
+            params_dtype=cfg.param_dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_bhsd(t):
+            return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
+
+        ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
+        attn_out = RowParallelLinear(
+            e, e, input_is_parallel=True, world_size=tp,
+            params_dtype=cfg.param_dtype, name="out_proj")(ctx)
+        x = x + attn_out.astype(x.dtype)
+
+        h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
+        h = h.astype(cfg.dtype)
+        h = ColumnParallelLinear(
+            e, 4 * e, gather_output=False, world_size=tp,
+            params_dtype=cfg.param_dtype, name="mlp_in")(h)
+        h = jax.nn.gelu(h, approximate=True)
+        mlp_out = RowParallelLinear(
+            4 * e, e, input_is_parallel=True, world_size=tp,
+            params_dtype=cfg.param_dtype, name="mlp_out")(h)
+        return x + mlp_out.astype(x.dtype)
+
+
+class GPTModel(nn.Module):
+    """Decoder-only LM. ``__call__(input_ids)`` -> vocab-PARALLEL logits
+    [B, S, vocab/tp] (feed to ``vocab_parallel_cross_entropy``); the LM head
+    is tied to the vocab-parallel word embedding (Megatron tied embeddings,
+    reference: standalone_gpt / parallel_state._EMBEDDING_GROUP)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        b, s = input_ids.shape
+        emb = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, world_size=cfg.tensor_parallel_size,
+            params_dtype=cfg.param_dtype, name="word_embeddings")
+        x = emb(input_ids)
+        pos = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (cfg.max_position_embeddings, cfg.hidden_size),
+                         cfg.param_dtype)
+        x = (x + pos[None, :s, :]).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = ParallelDecoderBlock(cfg, name=f"layer_{i}")(x)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
+                           name="final_norm")(x)
+        # tied LM head: local logits against the LOCAL vocab shard
+        return emb.attend(x.astype(cfg.dtype))
+
+
+def gpt_loss(model: GPTModel, variables, input_ids, labels,
+             axis_name: str = MODEL_AXIS):
+    """Mean next-token loss from vocab-parallel logits."""
+    logits = model.apply(variables, input_ids)
+    if _axis_bound(axis_name):
+        per_tok = vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels, axis_name=axis_name)
+    else:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        per_tok = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return per_tok.mean()

@@ -27,6 +27,44 @@ def interpret() -> bool:
     return _backend() != "tpu"
 
 
+def pallas_call(kernel, *, out_shape, **kw):
+    """``pl.pallas_call`` that propagates varying-manual-axes (vma).
+
+    Inside ``shard_map(check_vma=True)`` a pallas_call must declare how its
+    outputs vary over mesh axes; the correct answer for our elementwise/
+    row-tiled kernels is "varies over the union of the inputs' axes". This
+    wrapper stamps that union onto every ShapeDtypeStruct in ``out_shape`` at
+    call time, so all ops work under both jit and manual shard_map without
+    per-site bookkeeping.
+    """
+    from jax.experimental import pallas as pl
+
+    from jax import lax
+
+    def call(*args):
+        vma = frozenset()
+        for a in jax.tree.leaves(args):
+            vma = vma | getattr(jax.typeof(a), "vma", frozenset())
+
+        def lift(a):
+            # align every input to the union vma (a replicated operand next
+            # to a varying one trips "varying manual axes must match" inside
+            # the kernel body)
+            missing = vma - getattr(jax.typeof(a), "vma", frozenset())
+            return lax.pcast(a, tuple(missing), to="varying") if missing else a
+
+        def stamp(s):
+            if isinstance(s, jax.ShapeDtypeStruct):
+                return jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
+            return s
+
+        args = jax.tree.map(lift, args)
+        os_ = jax.tree.map(stamp, out_shape)
+        return pl.pallas_call(kernel, out_shape=os_, **kw)(*args)
+
+    return call
+
+
 def round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 

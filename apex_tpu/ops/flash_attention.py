@@ -230,7 +230,7 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
                     q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk,
                     dropout_rate=dropout_rate)
 
-    o, lse = pl.pallas_call(
+    o, lse = _dispatch.pallas_call(
         fn,
         grid=(batch, heads, nq, nk),
         in_specs=in_specs,
@@ -461,7 +461,7 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                    kv_len=kv_len, bq=bq, bk=bk, nk=nk,
                    dropout_rate=dropout_rate)
 
-    dq = pl.pallas_call(
+    dq = _dispatch.pallas_call(
         dq_fn,
         grid=(batch, heads, nq, nk),
         in_specs=make_specs(lambda g: g[2], lambda g: g[3]),
@@ -486,7 +486,7 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                      kv_len=kv_len, bq=bq, bk=bk, nq=nq,
                      dropout_rate=dropout_rate)
 
-    dk, dv = pl.pallas_call(
+    dk, dv = _dispatch.pallas_call(
         dkdv_fn,
         grid=(batch, heads, nk, nq),
         in_specs=make_specs(lambda g: g[3], lambda g: g[2]),

@@ -108,7 +108,7 @@ def _ln_fwd(x2d, weight, bias, eps, rms):
         ]
         args = (x2d, weight.reshape(1, cols), bias.reshape(1, cols))
 
-    y, mean, rstd = pl.pallas_call(
+    y, mean, rstd = _dispatch.pallas_call(
         fn,
         grid=grid,
         in_specs=in_specs,
@@ -238,7 +238,7 @@ def _ln_bwd(dy2d, saved, weight, bias, eps, rms, memory_efficient):
                        affine=affine, rms=rms, from_y=memory_efficient,
                        n_rows=rows, tile=tile)
 
-    outs = pl.pallas_call(
+    outs = _dispatch.pallas_call(
         fn,
         grid=grid,
         in_specs=in_specs,

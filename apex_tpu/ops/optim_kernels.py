@@ -127,7 +127,7 @@ def segment_stats(a, seg_rows, num_segments: int, b: Optional[jax.Array] = None)
         _stats_kernel(a_ref, b_ref, seg_ref, out_ref,
                       s_pad=s_pad, total_rows=total_rows, blk=blk, with_b=with_b)
 
-    return pl.pallas_call(
+    return _dispatch.pallas_call(
         fn,
         grid=_grid(total_rows, blk),
         in_specs=in_specs,
@@ -242,7 +242,7 @@ def adam_update(g, p, m, v, *, beta1, beta2, eps, weight_decay, lr, step,
                      po, mo, vo, adam_w=adam_w_mode,
                      per_tensor_wd=per_tensor_wd, s_pad=s_pad)
 
-    return pl.pallas_call(
+    return _dispatch.pallas_call(
         fn,
         grid=_grid(total_rows, blk),
         in_specs=in_specs,
@@ -300,7 +300,7 @@ def sgd_update(g, p, m, *, lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
     ]).reshape(1, _SGD_HP)
     use_momentum = not (isinstance(momentum, (int, float)) and momentum == 0.0)
 
-    return pl.pallas_call(
+    return _dispatch.pallas_call(
         functools.partial(_sgd_kernel, use_momentum=use_momentum),
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(_SGD_HP)] + [_buf_spec(blk)] * 3,
@@ -418,7 +418,7 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
     wd_mat = jnp.zeros((_STAT_ROWS, s_pad), jnp.float32).at[0, :num_segments].set(wd_vec)
 
     seg2d = seg_rows.reshape(-1, 1)
-    u, m, v, stats = pl.pallas_call(
+    u, m, v, stats = _dispatch.pallas_call(
         functools.partial(_lamb_phase1_kernel, s_pad=s_pad, total_rows=total_rows, blk=blk),
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(_LAMB_HP)] + [_buf_spec(blk)] * 4 + [_seg_spec(blk)]
@@ -444,7 +444,7 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
     ratio_mat = jnp.zeros((_STAT_ROWS, s_pad), jnp.float32).at[0].set(ratio)
 
     hp2 = jnp.stack([jnp.asarray(lr, jnp.float32), noop_s]).reshape(1, 2)
-    p_new = pl.pallas_call(
+    p_new = _dispatch.pallas_call(
         functools.partial(_lamb_phase2_kernel, s_pad=s_pad, blk=blk),
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(2), _buf_spec(blk), _buf_spec(blk),
@@ -518,7 +518,7 @@ def novograd_update(g, p, m, v_per_tensor, seg_rows, num_segments, *, beta1, bet
         gs, noop_s,
     ]).reshape(1, _NVG_HP)
 
-    p_new, m_new = pl.pallas_call(
+    p_new, m_new = _dispatch.pallas_call(
         functools.partial(_novograd_kernel, s_pad=s_pad, blk=blk),
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(_NVG_HP), _buf_spec(blk), _buf_spec(blk), _buf_spec(blk),
@@ -546,7 +546,7 @@ def multi_tensor_scale(x, scale):
     total_rows = x.shape[0]
     blk = _row_block(total_rows)
     hp = jnp.asarray(scale, jnp.float32).reshape(1, 1)
-    return pl.pallas_call(
+    return _dispatch.pallas_call(
         _scale_kernel,
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(1), _buf_spec(blk)],

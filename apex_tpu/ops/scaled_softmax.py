@@ -93,7 +93,7 @@ def _softmax_fwd(x, mask, scale, causal):
         _fwd_kernel(x_ref, mask_ref, y_ref, scale=scale, causal=causal,
                     sq=sq, sk=sk, tile=tile)
 
-    y = pl.pallas_call(
+    y = _dispatch.pallas_call(
         fn,
         grid=(b, np_, nq),
         in_specs=in_specs,
@@ -116,7 +116,7 @@ def _softmax_bwd_impl(y, dy, scale):
     nq = yp.shape[2] // tile
     spec = pl.BlockSpec((1, 1, tile, sk_pad), lambda b, h, i: (b, h, i, 0),
                         memory_space=pltpu.VMEM)
-    dx = pl.pallas_call(
+    dx = _dispatch.pallas_call(
         functools.partial(_bwd_kernel, scale=scale),
         grid=(b, np_, nq),
         in_specs=[spec, spec],

@@ -92,7 +92,7 @@ def _xent_fwd_call(logits2d, labels, smoothing, padding_idx):
                           memory_space=pltpu.VMEM)
     s_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
 
-    loss, lse = pl.pallas_call(
+    loss, lse = _dispatch.pallas_call(
         functools.partial(_fwd_kernel, vocab=vocab, smoothing=smoothing,
                           padding_idx=padding_idx),
         grid=grid,
@@ -123,7 +123,7 @@ def _xent_bwd_call(logits2d, labels, lse, dy, smoothing, padding_idx):
     x_spec = pl.BlockSpec((tile, v_pad), lambda i: (i, 0),
                           memory_space=pltpu.VMEM)
     s_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    dx = pl.pallas_call(
+    dx = _dispatch.pallas_call(
         functools.partial(_bwd_kernel, vocab=vocab, smoothing=smoothing,
                           padding_idx=padding_idx),
         grid=grid,

@@ -4,6 +4,22 @@ Reference: apex/transformer/tensor_parallel/ — layers.py, mappings.py,
 cross_entropy.py, random.py, data.py, utils.py (SURVEY.md §2.4).
 """
 
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (  # noqa: F401
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data  # noqa: F401
+from apex_tpu.transformer.tensor_parallel.layers import (  # noqa: F401
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.random import (  # noqa: F401
+    checkpoint,
+    get_cuda_rng_tracker,
+    get_rng_state_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_seed,
+)
 from apex_tpu.transformer.tensor_parallel.mappings import (  # noqa: F401
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,

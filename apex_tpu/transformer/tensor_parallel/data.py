@@ -1,0 +1,45 @@
+"""Batch broadcast across the tensor-parallel group.
+
+Reference: apex/transformer/tensor_parallel/data.py:broadcast_data — TP rank 0
+loads the batch and torch-broadcasts each named tensor to the other TP ranks
+(they must not each read the dataloader).
+
+TPU design: in SPMD the input pipeline feeds every device coherently via
+sharding (a replicated-over-``model`` sharding IS the broadcast), so the
+common path is a no-op. The explicit collective survives for shard_map loops
+where each TP rank computed/loaded its own copy and rank 0's must win.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu import collectives as coll
+from apex_tpu.mesh import MODEL_AXIS
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, jax.Array], datatype=None,
+                   axis_name: str = MODEL_AXIS) -> Dict[str, jax.Array]:
+    """Return ``{k: rank-0's data[k]}`` for k in keys.
+
+    Matches the reference signature (``datatype`` kept for parity; JAX arrays
+    carry their dtype). Inside shard_map the values are replaced by TP rank
+    0's via collective broadcast; outside (axis unbound) the data is already
+    coherent and is returned as-is.
+    """
+    out = {}
+    for k in keys:
+        v = data[k]
+        if datatype is not None:
+            v = v.astype(datatype)
+        try:
+            lax.axis_size(axis_name)
+        except NameError:
+            out[k] = v
+            continue
+        out[k] = coll.broadcast(v, axis_name, src_index=0)
+    return out

@@ -33,24 +33,81 @@ def _split_along(x, axis_name, dim):
     return lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
 
 
+def axis_is_bound(axis_name) -> bool:
+    """True iff ``axis_name`` is bound (we are inside shard_map). Lets layers
+    trace outside shard_map (eager init, tp=1 use) with collectives reduced
+    to identity."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _ensure_varying(g, axis_name):
+    """Cotangents entering a custom-vjp backward may lack the axis in their
+    vma (notably under ``shard_map(check_vma=False)``, where cotangents come
+    in unmarked); variant->invariant collectives (psum/all_gather/
+    reduce_scatter) reject such inputs. pcast-to-varying is a semantic no-op
+    that restores the marking."""
+    if axis_name not in getattr(jax.typeof(g), "vma", frozenset()):
+        try:
+            return lax.pcast(g, axis_name, to="varying")
+        except NameError:
+            return g
+    return g
+
+
 # --- copy: identity fwd / all-reduce bwd -------------------------------------
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def copy_to_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
     """Reference: mappings.py:_CopyToModelParallelRegion (fwd identity,
-    bwd all-reduce). In JAX this is precisely ``lax.pvary``: it marks the
-    value as varying over the TP axis (identity on data) and its transpose
-    is ``psum`` — the exact fwd/bwd pair of the reference, with correct
-    varying-manual-axes accounting for free."""
-    return lax.pvary(x, axis_name)
+    bwd all-reduce). Forward is ``pcast(..., to='varying')`` — identity on
+    data, marks the value as varying over the TP axis; backward psums the
+    cotangent, exactly the reference's autograd pair. Explicit custom_vjp
+    (rather than relying on pvary's builtin transpose) so the backward also
+    works under ``check_vma=False``, where pvary's transpose receives an
+    unmarked cotangent and rejects it."""
+    return lax.pcast(x, axis_name, to="varying")
+
+
+def _copy_fwd(x, axis_name):
+    return lax.pcast(x, axis_name, to="varying"), None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(_ensure_varying(g, axis_name), axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
 
 
 # --- reduce: all-reduce fwd / identity bwd -----------------------------------
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_from_tensor_model_parallel_region(x, axis_name=MODEL_AXIS):
-    """Reference: mappings.py:_ReduceFromModelParallelRegion. ``lax.psum``'s
-    transpose is ``pvary`` (identity broadcast of the cotangent), matching
-    the reference's backward exactly."""
+    """Reference: mappings.py:_ReduceFromModelParallelRegion — all-reduce
+    forward, IDENTITY backward (each rank keeps the output cotangent).
+    Explicit custom_vjp: relying on ``lax.psum``'s built-in transpose is
+    wrong under ``check_vma=False``, where that transpose is itself a psum —
+    every rank independently seeds its loss, and the transpose-psum sums the
+    seeds, inflating all upstream gradients by the axis size per region
+    crossed (measured 4x/16x/64x at tp=4)."""
     return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    # identity per rank; pcast restores the 'varying' marking the primal
+    # input carried (semantic no-op)
+    return (_ensure_varying(g, axis_name),)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
 
 
 # --- scatter (last dim): split fwd / all-gather bwd --------------------------
@@ -66,6 +123,7 @@ def _scatter_fwd(x, axis_name):
 
 
 def _scatter_bwd(axis_name, _, g):
+    g = _ensure_varying(g, axis_name)
     return (coll.all_gather(g, axis_name, axis=g.ndim - 1),)
 
 
@@ -85,7 +143,7 @@ def _gather_fwd(x, axis_name):
 
 
 def _gather_bwd(axis_name, _, g):
-    return (_split_along(g, axis_name, g.ndim - 1),)
+    return (_split_along(_ensure_varying(g, axis_name), axis_name, g.ndim - 1),)
 
 
 gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
@@ -106,7 +164,7 @@ def _sp_scatter_fwd(x, axis_name):
 
 
 def _sp_scatter_bwd(axis_name, _, g):
-    return (coll.all_gather(g, axis_name, axis=0),)
+    return (coll.all_gather(_ensure_varying(g, axis_name), axis_name, axis=0),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
@@ -126,6 +184,7 @@ def _sp_gather_fwd(x, axis_name, tpog):
 
 
 def _sp_gather_bwd(axis_name, tpog, _, g):
+    g = _ensure_varying(g, axis_name)
     if tpog:
         return (coll.reduce_scatter(g, axis_name, axis=0),)
     return (_split_along(g, axis_name, 0),)
@@ -146,7 +205,7 @@ def _sp_rs_fwd(x, axis_name):
 
 
 def _sp_rs_bwd(axis_name, _, g):
-    return (coll.all_gather(g, axis_name, axis=0),)
+    return (coll.all_gather(_ensure_varying(g, axis_name), axis_name, axis=0),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

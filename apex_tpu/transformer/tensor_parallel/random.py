@@ -1,0 +1,133 @@
+"""Model-parallel RNG state management + activation checkpointing.
+
+Reference: apex/transformer/tensor_parallel/random.py:~50-300 —
+``CudaRNGStatesTracker`` keeps named CUDA RNG streams so dropout draws
+*differently* across TP ranks inside TP regions (stream seeded
+``seed + 2718 + tp_rank``) but *identically* outside them;
+``model_parallel_cuda_manual_seed`` wires the two streams;
+``checkpoint()``/``CheckpointFunction`` recompute activations in backward,
+restoring both RNG streams so recomputed dropout masks match.
+
+TPU design: JAX RNG is functional, so a "stream" is a key + a fold counter.
+The tracker hands out keys; the model-parallel stream folds in the TP axis
+index (``lax.axis_index``) when bound, reproducing per-rank decorrelation
+without any device state. ``checkpoint`` maps to ``jax.checkpoint`` — XLA
+replays the same functional keys during recompute BY CONSTRUCTION, which is
+the property the reference needs two saved CUDA states to get.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh import MODEL_AXIS
+
+# reference: _MODEL_PARALLEL_RNG_TRACKER_NAME
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Named functional RNG streams (reference: CudaRNGStatesTracker).
+
+    ``add(name, seed)`` registers a stream; ``fork(name)`` is a context
+    manager inside which ``get_key()`` returns fresh keys from that stream.
+    Streams registered as model-parallel fold the TP axis index into every
+    key so ranks decorrelate (the reference's per-rank seed offset).
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._seeds: Dict[str, int] = {}
+        self._counters: Dict[str, int] = {}
+        self._model_parallel: Dict[str, bool] = {}
+        self._active: list = []
+
+    def get_states(self):
+        """Checkpointable state (reference: get_states returns CUDA states)."""
+        return {"seeds": dict(self._seeds), "counters": dict(self._counters),
+                "model_parallel": dict(self._model_parallel)}
+
+    def set_states(self, states):
+        self._seeds = dict(states["seeds"])
+        self._counters = dict(states["counters"])
+        self._model_parallel = dict(states["model_parallel"])
+
+    def add(self, name: str, seed: int, model_parallel: bool = False):
+        if name in self._seeds:
+            raise RuntimeError(f"RNG stream {name} already exists")
+        self._seeds[name] = int(seed)
+        self._counters[name] = 0
+        self._model_parallel[name] = model_parallel
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        if name not in self._seeds:
+            raise RuntimeError(f"RNG stream {name} is not registered "
+                               "(call model_parallel_seed first)")
+        self._active.append(name)
+        try:
+            yield
+        finally:
+            self._active.pop()
+
+    def get_key(self, axis_name: str = MODEL_AXIS):
+        """Next key of the active (or default) stream."""
+        name = self._active[-1] if self._active else None
+        if name is None:
+            raise RuntimeError("get_key() called outside tracker.fork(...)")
+        key = jax.random.PRNGKey(self._seeds[name])
+        key = jax.random.fold_in(key, self._counters[name])
+        self._counters[name] += 1
+        if self._model_parallel.get(name):
+            try:
+                key = jax.random.fold_in(key, lax.axis_index(axis_name))
+            except NameError:
+                pass
+        return key
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """Reference: get_cuda_rng_tracker."""
+    return _TRACKER
+
+
+# torch-named alias for drop-in ports
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
+def model_parallel_seed(seed: int) -> None:
+    """Reference: model_parallel_cuda_manual_seed — default stream seeded
+    ``seed`` (same on all TP ranks), model-parallel stream ``seed + 2718``
+    with the rank folded in per key."""
+    _TRACKER.reset()
+    _TRACKER.add("default", seed, model_parallel=False)
+    _TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, seed + 2718,
+                 model_parallel=True)
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+def checkpoint(function, distribute_saved_activations: bool = False,
+               *args, **kwargs):
+    """Activation checkpointing (reference: random.py:checkpoint /
+    CheckpointFunction — recompute in backward with RNG streams restored).
+
+    ``jax.checkpoint`` replays the traced function in backward; functional
+    RNG keys are part of the trace, so recomputed dropout masks are
+    bit-identical without any state save/restore.
+    ``distribute_saved_activations`` (reference: shard the saved input over
+    TP ranks to save memory) has no explicit mechanism here — XLA SPMD keeps
+    residuals sharded per the activation shardings already.
+    """
+    return jax.checkpoint(function)(*args, **kwargs)
