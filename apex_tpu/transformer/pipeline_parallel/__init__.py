@@ -1,0 +1,29 @@
+"""Pipeline parallelism over the ``stage`` mesh axis.
+
+Reference: apex/transformer/pipeline_parallel/ — schedules/__init__.py
+(get_forward_backward_func dispatch), fwd_bwd_no_pipelining.py,
+fwd_bwd_pipelining_without_interleaving.py (1F1B), p2p_communication.py
+(NCCL batch_isend_irecv), microbatches.py (num-microbatch calculators).
+
+TPU design (SURVEY.md §3.5): the microbatch loop is a ``lax.scan`` INSIDE
+``shard_map``; activations/grads move between adjacent stages with
+``ppermute`` (XLA collective-permute over ICI) instead of NCCL P2P; the
+backward schedule comes from autodiff of the scanned forward (scan transpose
+= reverse-scan, ppermute transpose = inverse ppermute), so warmup/steady/
+cooldown and per-microbatch grad accumulation need no hand bookkeeping.
+``deallocate_output_tensor`` has no analog (XLA liveness); memory is managed
+with ``jax.checkpoint`` on the stage body.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.microbatches import (  # noqa: F401
+    ConstantNumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatchesCalculator,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    pipeline_apply,
+)
+from apex_tpu.transformer.pipeline_parallel import p2p_communication  # noqa: F401

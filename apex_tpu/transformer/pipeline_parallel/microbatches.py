@@ -1,0 +1,109 @@
+"""Number-of-microbatches bookkeeping.
+
+Reference: apex/transformer/pipeline_parallel/microbatches.py —
+``build_num_microbatches_calculator`` returning
+``ConstantNumMicroBatchesCalculator`` or
+``RampupBatchsizeNumMicroBatchesCalculator`` (linear global-batch ramp for
+BERT/GPT pretraining). Pure host-side arithmetic; ported semantics, not code.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        raise NotImplementedError
+
+
+class ConstantNumMicroBatchesCalculator(NumMicroBatchesCalculator):
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        per_step = micro_batch_size * data_parallel_size
+        if global_batch_size % per_step != 0:
+            raise RuntimeError(
+                f"global batch size ({global_batch_size}) is not divisible by"
+                f" micro batch size ({micro_batch_size}) times data parallel"
+                f" size ({data_parallel_size})")
+        self.num_micro_batches = global_batch_size // per_step
+        if self.num_micro_batches < 1:
+            raise RuntimeError("number of microbatches must be at least 1")
+        self.current_global_batch_size = global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class RampupBatchsizeNumMicroBatchesCalculator(NumMicroBatchesCalculator):
+    """Linear global-batch-size ramp: start -> global over ramp_samples."""
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 ramup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        if batch_size_increment <= 0 or start_batch_size <= 0:
+            raise RuntimeError("batch size and increment must be positive")
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        per_step = micro_batch_size * data_parallel_size
+        diff = global_batch_size - start_batch_size
+        if diff < 0 or diff % batch_size_increment != 0:
+            raise RuntimeError(
+                "global batch size must be start + k * increment")
+        if start_batch_size % per_step != 0 or batch_size_increment % per_step != 0:
+            raise RuntimeError(
+                "start batch size / increment must be divisible by micro "
+                "batch size * data parallel size")
+        # samples consumed per increment step of the ramp
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / (diff / batch_size_increment) if diff else 0)
+        self.update(0, False)
+
+    def update(self, consumed_samples: int, consistency_check: bool):
+        if consumed_samples > self.ramup_samples or self.rampup_samples_per_increment == 0:
+            bs = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            bs = min(self.global_batch_size,
+                     self.start_batch_size + steps * self.batch_size_increment)
+        per_step = self.micro_batch_size * self.data_parallel_size
+        if consistency_check and bs % per_step != 0:
+            raise RuntimeError(
+                f"current global batch size ({bs}) is not divisible by "
+                f"micro-batch-size ({self.micro_batch_size}) times "
+                f"data parallel size ({self.data_parallel_size})")
+        self.current_global_batch_size = bs
+        self.num_micro_batches = max(1, bs // per_step)
+
+
+def build_num_microbatches_calculator(
+        rank: int = 0, rampup_batch_size: Optional[Sequence[int]] = None,
+        global_batch_size: int = 1, micro_batch_size: int = 1,
+        data_parallel_size: int = 1) -> NumMicroBatchesCalculator:
+    """Reference signature (args come from Megatron-style global args)."""
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatchesCalculator(
+            global_batch_size, micro_batch_size, data_parallel_size)
+    if len(rampup_batch_size) != 3:
+        raise RuntimeError(
+            "rampup batch size must be: <start> <increment> <ramp samples>")
+    start, inc, samples = (int(v) for v in rampup_batch_size)
+    return RampupBatchsizeNumMicroBatchesCalculator(
+        start, inc, samples, global_batch_size, micro_batch_size,
+        data_parallel_size)

@@ -1,0 +1,64 @@
+"""Stage-to-stage activation/grad transfer.
+
+Reference: apex/transformer/pipeline_parallel/p2p_communication.py:~50-400 —
+``send_forward``/``recv_forward``/``send_backward``/``recv_backward`` and the
+fused ``send_forward_recv_backward`` variants over
+``torch.distributed.batch_isend_irecv`` / ``ring_exchange``.
+
+On TPU every transfer is ``lax.ppermute`` on the ``stage`` axis (XLA
+collective-permute, riding ICI between neighbor chips). "send" and "recv"
+collapse into one collective: what rank s sends forward IS what rank s+1
+receives, so each reference send/recv pair maps to a single shift. The fused
+send/recv combos are two independent shifts that XLA schedules concurrently.
+All functions must run inside shard_map with the stage axis bound.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.mesh import STAGE_AXIS
+
+
+def _shift(x, axis_name: str, offset: int, wrap: bool):
+    n = lax.axis_size(axis_name)
+    if wrap:
+        perm = [(i, (i + offset) % n) for i in range(n)]
+    else:
+        perm = [(i, i + offset) for i in range(n) if 0 <= i + offset < n]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward(x, axis_name: str = STAGE_AXIS, wrap: bool = False):
+    """Shift activations one stage downstream: rank s's value arrives at
+    s+1 (reference: send_forward on s + recv_forward on s+1). Ranks with no
+    upstream receive zeros (the reference's recv into a fresh buffer)."""
+    return _shift(x, axis_name, +1, wrap)
+
+
+def send_backward_recv_backward(g, axis_name: str = STAGE_AXIS, wrap: bool = False):
+    """Shift gradients one stage upstream (reference: send_backward +
+    recv_backward)."""
+    return _shift(g, axis_name, -1, wrap)
+
+
+# reference-named aliases: in SPMD the send and the recv are the same op
+send_forward = send_forward_recv_forward
+recv_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_backward = send_backward_recv_backward
+
+
+def send_forward_recv_backward(x, g, axis_name: str = STAGE_AXIS):
+    """Fused steady-state 1F1B exchange (reference:
+    send_forward_recv_backward): activations go downstream while grads come
+    back upstream; XLA overlaps the two permutes."""
+    return (_shift(x, axis_name, +1, False), _shift(g, axis_name, -1, False))
+
+
+def send_backward_recv_forward(g, x, axis_name: str = STAGE_AXIS):
+    """Fused counterpart of the above."""
+    return (_shift(g, axis_name, -1, False), _shift(x, axis_name, +1, False))

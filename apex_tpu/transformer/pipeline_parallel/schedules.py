@@ -1,0 +1,169 @@
+"""Pipeline schedules: scan-over-microbatches + ppermute.
+
+Reference: apex/transformer/pipeline_parallel/schedules/ —
+``get_forward_backward_func`` dispatching to ``forward_backward_no_pipelining``
+or the 1F1B schedules (fwd_bwd_pipelining_without_interleaving.py: warmup
+forwards -> steady 1F1B -> cooldown backwards, with hand-rolled P2P and
+``deallocate_output_tensor``).
+
+TPU restatement: the whole schedule is ONE differentiable program. Forward is
+``lax.scan`` over T = M + S - 1 ticks inside ``shard_map``; at each tick every
+stage runs its block on the activation that arrived, then the activations
+shift one stage downstream via ppermute. Autodiff of that program IS the
+pipelined backward: scan transposes to a reverse-time scan and ppermute to
+its inverse permute, so gradient ticks flow upstream exactly like the
+reference's cooldown/steady backward phases — no explicit warmup/steady/
+cooldown bookkeeping, and per-microbatch grad accumulation falls out of the
+scan transpose. Activation memory is bounded with ``jax.checkpoint`` around
+the stage body (the reference's deallocate_output_tensor + recompute).
+
+The stage function signature is functional (params explicit), so the
+reference's ``forward_step_func(batch, model) -> (output, loss_func)``
+callback becomes ``stage_fn(stage_params, x) -> y`` plus a terminal
+``loss_fn(y, microbatch_aux) -> scalar``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh import STAGE_AXIS
+from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_is_bound,
+    reduce_from_tensor_model_parallel_region as _allreduce,
+)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   axis_name: str = STAGE_AXIS,
+                   checkpoint_stage: bool = True):
+    """Run microbatches through the S-stage pipeline; returns last-stage
+    outputs per microbatch.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` — ONE stage's computation; every
+        stage must map the same activation shape to itself (the reference's
+        fixed ``tensor_shape`` contract in p2p_communication).
+      stage_params: THIS stage's parameter pytree (per-device, varying over
+        ``axis_name``).
+      microbatches: ``[M, ...]`` array of microbatch inputs (used by stage 0).
+      checkpoint_stage: recompute the stage body in backward
+        (deallocate_output_tensor analog).
+
+    Returns ``[M, ...]`` outputs, valid on the LAST stage (other stages hold
+    in-flight garbage, as with the reference where only the last stage sees
+    outputs).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    m = microbatches.shape[0]
+    t_total = m + n_stages - 1
+
+    body = stage_fn
+    if checkpoint_stage:
+        body = jax.checkpoint(stage_fn)
+
+    def tick(buf, t):
+        # stage 0 picks up microbatch t (clamped; beyond M it computes
+        # garbage that never reaches a valid output slot)
+        x0 = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+        x = jnp.where(s == 0, x0.astype(buf.dtype), buf)
+        y = body(stage_params, x)
+        return p2p.send_forward_recv_forward(y, axis_name), y
+
+    buf0 = jnp.zeros_like(
+        jax.eval_shape(lambda mb: stage_fn(stage_params, mb[0]), microbatches),
+    )
+    _, ys = lax.scan(tick, buf0, jnp.arange(t_total))
+    # last stage emits microbatch mb at tick mb + (S-1)
+    return ys[n_stages - 1:]
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn: Callable, loss_fn: Callable, stage_params, microbatches,
+        loss_aux=None, forward_only: bool = False,
+        axis_name: str = STAGE_AXIS, checkpoint_stage: bool = True):
+    """The 1F1B-equivalent schedule (reference:
+    fwd_bwd_pipelining_without_interleaving.py).
+
+    ``loss_fn(y, aux_m) -> scalar`` runs on the last stage per microbatch
+    (aux_m = ``loss_aux[m]``, e.g. labels). Returns
+    ``(mean_loss, stage_grads)`` — each device gets grads of ITS stage's
+    params, accumulated over microbatches, with the loss broadcast to every
+    stage (the reference reduces losses on the last stage only; here the
+    broadcast costs one scalar psum and spares the caller a special case).
+    With ``forward_only=True`` returns ``(mean_loss, None)``.
+    """
+    if not axis_is_bound(axis_name):
+        raise RuntimeError(
+            "pipeline schedules must run inside shard_map with the "
+            f"'{axis_name}' axis bound (reference: requires "
+            "parallel_state pipeline group)")
+    n_stages = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+
+    def mean_loss_of(params):
+        outs = pipeline_apply(stage_fn, params, microbatches,
+                              axis_name=axis_name,
+                              checkpoint_stage=checkpoint_stage)
+        if loss_aux is not None:
+            per_mb = jax.vmap(loss_fn)(outs, loss_aux)
+        else:
+            per_mb = jax.vmap(loss_fn)(outs)
+        local = jnp.where(s == n_stages - 1, per_mb.mean(), 0.0)
+        # identity-backward all-reduce: every stage sees the loss, backward
+        # seeds only the last stage's real output path
+        return _allreduce(local, axis_name)
+
+    if forward_only:
+        return mean_loss_of(stage_params), None
+    loss, grads = jax.value_and_grad(mean_loss_of)(stage_params)
+    return loss, grads
+
+
+def forward_backward_no_pipelining(
+        stage_fn: Callable, loss_fn: Callable, params, microbatches,
+        loss_aux=None, forward_only: bool = False, axis_name: str = STAGE_AXIS,
+        checkpoint_stage: bool = False):
+    """Reference: fwd_bwd_no_pipelining.py — sequential microbatch loop on a
+    single stage (pp=1), grads accumulated across microbatches. Here a scan
+    (the grad accumulation is the scan transpose)."""
+
+    def mean_loss_of(p):
+        def one(mb_and_aux):
+            if loss_aux is not None:
+                mb, aux = mb_and_aux
+                return loss_fn(stage_fn(p, mb), aux)
+            return loss_fn(stage_fn(p, mb_and_aux))
+
+        xs = (microbatches, loss_aux) if loss_aux is not None else microbatches
+        losses = jax.vmap(one)(xs) if not checkpoint_stage else \
+            jax.vmap(jax.checkpoint(one))(xs)
+        return losses.mean()
+
+    if forward_only:
+        return mean_loss_of(params), None
+    return jax.value_and_grad(mean_loss_of)(params)
+
+
+def get_forward_backward_func(
+        virtual_pipeline_model_parallel_size: Optional[int] = None,
+        pipeline_model_parallel_size: int = 1) -> Callable:
+    """Reference: schedules/__init__.py:get_forward_backward_func — dispatch
+    on (vpp, pp). Interleaved VPP is not yet implemented (reference optional
+    milestone; SURVEY.md §7 M8)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            raise NotImplementedError(
+                "interleaved (virtual) pipeline schedule is not implemented "
+                "yet; use virtual_pipeline_model_parallel_size=None")
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,197 @@
+"""Pipeline-parallel schedules vs single-device ground truth.
+
+Mirrors the reference tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py
+(toy MyModel through the schedules, compared against the unpipelined run) and
+test_microbatches.py — on the CPU mesh with the stage axis.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import STAGE_AXIS
+
+
+@pytest.fixture
+def pp4_mesh():
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(1, 4)
+
+
+D = 16
+
+
+def stage_fn(p, x):
+    """One toy stage: Linear + tanh, activation shape preserved."""
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def loss_fn(y, labels):
+    return jnp.mean((y - labels) ** 2)
+
+
+def make_params(rng, n_stages):
+    return {
+        "w": jnp.asarray(rng.standard_normal((n_stages, D, D), np.float32)) / np.sqrt(D),
+        "b": jnp.asarray(rng.standard_normal((n_stages, D), np.float32)) * 0.1,
+    }
+
+
+def reference_loss_and_grads(params4, microbatches, labels):
+    """Unpipelined: chain the 4 stages, mean loss over microbatches."""
+
+    def full_loss(p4):
+        def per_mb(mb, lb):
+            x = mb
+            for i in range(4):
+                x = stage_fn({"w": p4["w"][i], "b": p4["b"][i]}, x)
+            return loss_fn(x, lb)
+
+        return jax.vmap(per_mb)(microbatches, labels).mean()
+
+    return jax.value_and_grad(full_loss)(params4)
+
+
+def test_pipeline_matches_sequential(pp4_mesh, rng):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    m = 8
+    params4 = make_params(rng, 4)
+    mbs = jnp.asarray(rng.standard_normal((m, 4, D), np.float32))
+    labels = jnp.asarray(rng.standard_normal((m, 4, D), np.float32))
+
+    ref_loss, ref_grads = reference_loss_and_grads(params4, mbs, labels)
+
+    @functools.partial(
+        jax.shard_map, mesh=pp4_mesh,
+        in_specs=(P(STAGE_AXIS), P(), P()), out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        p = jax.tree.map(lambda t: t[0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, p, mb, loss_aux=lb)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None], grads)
+
+    losses, grads = run(params4, mbs, labels)
+    # every stage sees the same broadcast loss
+    np.testing.assert_allclose(np.asarray(losses), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    # stage s's grads == reference grads for stage s's slice
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        grads, ref_grads)
+
+
+def test_pipeline_forward_only(pp4_mesh, rng):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    m = 6
+    params4 = make_params(rng, 4)
+    mbs = jnp.asarray(rng.standard_normal((m, 2, D), np.float32))
+    labels = jnp.asarray(rng.standard_normal((m, 2, D), np.float32))
+    ref_loss, _ = reference_loss_and_grads(params4, mbs, labels)
+
+    @functools.partial(
+        jax.shard_map, mesh=pp4_mesh,
+        in_specs=(P(STAGE_AXIS), P(), P()), out_specs=P(STAGE_AXIS),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        p = jax.tree.map(lambda t: t[0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, p, mb, loss_aux=lb,
+                              forward_only=True)
+        assert grads is None
+        return loss.reshape(1)
+
+    losses = run(params4, mbs, labels)
+    np.testing.assert_allclose(np.asarray(losses), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_no_pipelining_schedule(rng):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_no_pipelining)
+
+    m = 4
+    params = {"w": jnp.asarray(rng.standard_normal((D, D), np.float32)),
+              "b": jnp.zeros((D,))}
+    mbs = jnp.asarray(rng.standard_normal((m, 2, D), np.float32))
+    labels = jnp.asarray(rng.standard_normal((m, 2, D), np.float32))
+
+    loss, grads = forward_backward_no_pipelining(
+        stage_fn, loss_fn, params, mbs, loss_aux=labels)
+
+    def ref(p):
+        return jax.vmap(
+            lambda mb, lb: loss_fn(stage_fn(p, mb), lb))(mbs, labels).mean()
+
+    ref_loss, ref_grads = jax.value_and_grad(ref)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), grads, ref_grads)
+
+
+def test_get_forward_backward_func_dispatch():
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_no_pipelining,
+        forward_backward_pipelining_without_interleaving,
+        get_forward_backward_func)
+
+    assert (get_forward_backward_func(None, 1)
+            is forward_backward_no_pipelining)
+    assert (get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving)
+    with pytest.raises(NotImplementedError):
+        get_forward_backward_func(2, 4)
+
+
+def test_microbatch_calculators():
+    from apex_tpu.transformer.pipeline_parallel import (
+        ConstantNumMicroBatchesCalculator,
+        RampupBatchsizeNumMicroBatchesCalculator,
+        build_num_microbatches_calculator)
+
+    c = build_num_microbatches_calculator(
+        global_batch_size=64, micro_batch_size=4, data_parallel_size=2)
+    assert isinstance(c, ConstantNumMicroBatchesCalculator)
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+
+    r = build_num_microbatches_calculator(
+        rampup_batch_size=[16, 16, 1000], global_batch_size=64,
+        micro_batch_size=4, data_parallel_size=2)
+    assert isinstance(r, RampupBatchsizeNumMicroBatchesCalculator)
+    assert r.get() == 2                      # start 16 / (4*2)
+    r.update(500, True)
+    # 1000 ramp samples / 3 increments = 333.3 per step; 500 -> 1 step
+    assert r.get_current_global_batch_size() == 32
+    r.update(2000, True)
+    assert r.get() == 8                      # fully ramped
+
+    with pytest.raises(RuntimeError):
+        ConstantNumMicroBatchesCalculator(63, 4, 2)
+
+
+def test_p2p_shift(pp4_mesh):
+    from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
+
+    x = jnp.arange(4.0).reshape(4, 1)
+
+    @functools.partial(jax.shard_map, mesh=pp4_mesh,
+                       in_specs=P(STAGE_AXIS), out_specs=P(STAGE_AXIS))
+    def fwd(v):
+        return p2p.send_forward_recv_forward(v)
+
+    @functools.partial(jax.shard_map, mesh=pp4_mesh,
+                       in_specs=P(STAGE_AXIS), out_specs=P(STAGE_AXIS))
+    def bwd(v):
+        return p2p.send_backward_recv_backward(v)
+
+    np.testing.assert_allclose(np.asarray(fwd(x)).ravel(), [0, 0, 1, 2])
+    np.testing.assert_allclose(np.asarray(bwd(x)).ravel(), [1, 2, 3, 0])
