@@ -28,6 +28,24 @@ from apex_tpu.ops import flat_buffer
 from apex_tpu.ops.flat_buffer import LANE, FlatSpec, build_spec
 
 
+def _agree_found_inf_across_model_parallel(found_inf):
+    """pmax the found-inf flag over every bound model-parallel mesh axis.
+
+    Reference: apex/transformer/amp/grad_scaler.py — GradScaler's found_inf
+    is all-reduced (MAX) over the model-parallel group so TP/PP ranks agree
+    on whether to skip the step. Outside shard_map this is the identity.
+    """
+    from jax import lax
+
+    from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS, STAGE_AXIS
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+
+    for ax in (MODEL_AXIS, STAGE_AXIS, CONTEXT_AXIS):
+        if axis_is_bound(ax):
+            found_inf = lax.pmax(found_inf, ax)
+    return found_inf
+
+
 def path_name(path) -> str:
     """'/'-joined key path for a pytree leaf (for wd-exclusion predicates)."""
     parts = []
@@ -138,7 +156,8 @@ class FusedOptimizerBase:
             scaler = self._amp_scaler
             out_dtypes = self._out_dtypes
 
-            def _pure(g_tree, master, state, step, hyper, gs, noop_, scaler_state):
+            def _pure(g_tree, master, state, step, hyper, gs, noop_,
+                      scaler_state, wd_seg):
                 g_flat = flat_buffer.flatten(g_tree, spec)
                 if scaler is not None:
                     # fused unscale + overflow skip (reference: scaler.py
@@ -149,6 +168,11 @@ class FusedOptimizerBase:
                         g_flat, seg_rows, spec.num_tensors
                     )
                     found_inf = 1.0 - finite.astype(jnp.float32)
+                    # model-parallel agreement: an inf on ONE tp/pp rank must
+                    # skip the step on ALL ranks or shards diverge (reference:
+                    # apex/transformer/amp/grad_scaler.py allreduces found_inf
+                    # over the model-parallel group)
+                    found_inf = _agree_found_inf_across_model_parallel(found_inf)
                     gs = gs / scaler_state.scale
                     noop_ = jnp.maximum(noop_, found_inf)
                     scaler_state = scaler.update(scaler_state, found_inf)
@@ -156,8 +180,13 @@ class FusedOptimizerBase:
                 # skips optimizer.step() entirely, so Adam bias correction
                 # sees only applied steps)
                 new_step = step + jnp.where(noop_ > 0.0, 0, 1).astype(step.dtype)
+                # wd_seg rides as a traced argument (NOT a closure constant):
+                # LARC temporarily nulls wd_per_segment around its inner step,
+                # and a baked-in value would survive the jit cache
                 new_master, new_state = self._update(
-                    g_flat, master, state, new_step, dict(hyper, grad_scale=gs, noop=noop_)
+                    g_flat, master, state, new_step,
+                    dict(hyper, grad_scale=gs, noop=noop_,
+                         wd_per_segment=wd_seg)
                 )
                 params = flat_buffer.unflatten(new_master, spec, dtypes=out_dtypes)
                 return params, new_master, new_state, new_step, scaler_state
@@ -171,7 +200,8 @@ class FusedOptimizerBase:
         noop_ = jnp.asarray(0.0 if noop is None else noop, jnp.float32)
         sstate = self._amp_scaler.state if self._amp_scaler is not None else None
         params, self.master, self.state, self.step_count, sstate = self._jit_step(
-            grads, self.master, self.state, self.step_count, hyper, gs, noop_, sstate
+            grads, self.master, self.state, self.step_count, hyper, gs, noop_,
+            sstate, self.wd_per_segment
         )
         if self._amp_scaler is not None:
             self._amp_scaler.state = sstate

@@ -29,7 +29,11 @@ class FusedAdam(FusedOptimizerBase):
                          exclude_from_weight_decay=exclude_from_weight_decay)
 
     def _update(self, g_flat, master, state, step, hyper):
-        wd = self.wd_per_segment if self.wd_per_segment is not None else hyper["weight_decay"]
+        # traced per-call (common.py passes it through the jit boundary so
+        # LARC's temporary None isn't defeated by the trace cache)
+        wd = hyper.get("wd_per_segment")
+        if wd is None:
+            wd = hyper["weight_decay"]
         p, m, v = optim_kernels.adam_update(
             g_flat, master, state["m"], state["v"],
             beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],

@@ -51,7 +51,9 @@ class FusedLAMB(FusedOptimizerBase):
         noop = jnp.zeros((), jnp.float32) if noop is None else noop
         noop = jnp.maximum(noop, 1.0 - finite.astype(jnp.float32))
 
-        wd = self.wd_per_segment if self.wd_per_segment is not None else hyper["weight_decay"]
+        wd = hyper.get("wd_per_segment")
+        if wd is None:
+            wd = hyper["weight_decay"]
         p, m, v = optim_kernels.lamb_update(
             g_flat, master, state["m"], state["v"],
             self.seg_rows, self.spec.num_tensors,

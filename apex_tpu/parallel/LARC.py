@@ -35,14 +35,15 @@ class LARC:
         self._jit_scale = None
         # reference semantics: LARC folds wd into the scaled grad
         # (p.grad += wd * p before the local-lr scale) and zeroes the inner
-        # optimizer's weight_decay during step() so it isn't applied twice
+        # optimizer's weight_decay AROUND each step() so it isn't applied
+        # twice — restored afterwards, so state_dict/defaults keep reporting
+        # the user's hyperparameters and discarding the wrapper leaves the
+        # optimizer unaltered
         if isinstance(optimizer, FusedOptimizerBase):
             if optimizer.wd_per_segment is not None:
                 self._wd = optimizer.wd_per_segment      # (num_tensors,) fp32
-                optimizer.wd_per_segment = None
             else:
                 self._wd = float(optimizer.defaults.get("weight_decay", 0.0))
-            optimizer.defaults["weight_decay"] = 0.0
 
     # attribute passthrough (the reference forwards state/param_groups too)
     def __getattr__(self, name):
@@ -88,8 +89,18 @@ class LARC:
     def step(self, grads, **kw):
         if isinstance(self.optim, FusedOptimizerBase):
             grads = self._scale_grads_fused(grads)
-        else:
-            grads = self._scale_grads_tree(grads)
+            # wd already folded into grads above; suppress it in the inner
+            # step only, restoring the recorded hyperparameters after
+            saved = (self.optim.defaults.get("weight_decay", 0.0),
+                     self.optim.wd_per_segment)
+            self.optim.defaults["weight_decay"] = 0.0
+            self.optim.wd_per_segment = None
+            try:
+                return self.optim.step(grads, **kw)
+            finally:
+                self.optim.defaults["weight_decay"] = saved[0]
+                self.optim.wd_per_segment = saved[1]
+        grads = self._scale_grads_tree(grads)
         return self.optim.step(grads, **kw)
 
     def _scale_grads_tree(self, grads):

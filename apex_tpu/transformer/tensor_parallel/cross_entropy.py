@@ -57,11 +57,14 @@ def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
     loss = lse - t_logit
     if label_smoothing > 0.0:
         # reference: smoothed loss mixes in the mean log-prob over the full
-        # vocab; sum of (shifted - lse) over local vocab, psum'd
+        # vocab, with the smoothing rescaled by vocab/(vocab-1) because the
+        # uniform mass excludes the target class; mean over a global-vocab
+        # sum of (shifted - lse), psum'd
         vocab = per * lax.axis_size(axis_name)
+        smoothing = label_smoothing * vocab / (vocab - 1)
         mean_logprob = (_allreduce(jnp.sum(shifted, axis=-1), axis_name)
                         / vocab - lse)
-        loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_logprob
+        loss = (1.0 - smoothing) * loss - smoothing * mean_logprob
     return loss
 
 

@@ -16,10 +16,12 @@ functional restatement of the reference's
 
 Reference knobs with no TPU mechanism (``no_async_tensor_model_parallel_
 allreduce`` — XLA's latency-hiding scheduler owns collective/compute overlap;
-``use_cpu_initialization``; ``params_dtype`` handled by ``param_dtype``;
-``gradient_accumulation_fusion`` — fp32 main-grad accumulation is the
-optimizer facade's flat fp32 master buffer) are accepted for API parity and
-recorded.
+``use_cpu_initialization``; ``params_dtype`` handled by ``param_dtype``)
+are accepted for API parity and recorded.
+``gradient_accumulation_fusion`` IS mechanized: it routes the GEMM through
+``fp32_wgrad_matmul`` (single fp32-accumulating wgrad GEMM) and pairs with
+``apex_tpu.optimizers.grad_accum.MainGradBuffer`` for the persistent fp32
+main-grad across microbatches.
 """
 
 from __future__ import annotations
@@ -39,6 +41,38 @@ from apex_tpu.transformer.utils import divide
 # public guard lives next to the collectives; kept under the old name for
 # intra-package use
 _axis_bound = mappings.axis_is_bound
+
+
+@jax.custom_vjp
+def fp32_wgrad_matmul(x, w):
+    """``y = x @ w.T`` (w torch-layout (out, in), fp32) whose backward
+    computes the weight grad as ONE fp32-accumulating GEMM from the 16-bit
+    operands — the ``gradient_accumulation_fusion`` mechanism (reference:
+    csrc/megatron/fused_weight_gradient_dense.cpp, wgrad GEMM accumulating
+    into a persistent fp32 ``main_grad``). On the MXU bf16xbf16->fp32 is the
+    native mode, so the fp32 wgrad costs nothing extra; the persistent
+    accumulation across microbatches is ``MainGradBuffer``
+    (apex_tpu/optimizers/grad_accum.py)."""
+    return x @ w.astype(x.dtype).T
+
+
+def _fp32_wgrad_fwd(x, w):
+    return fp32_wgrad_matmul(x, w), (x, w)
+
+
+def _fp32_wgrad_bwd(res, g):
+    x, w = res
+    dx = (g @ w.astype(g.dtype)).astype(x.dtype)
+    # collapse all leading (batch/seq) dims; fp32 accumulation on the MXU
+    g2 = g.reshape(-1, g.shape[-1])
+    x2 = x.reshape(-1, x.shape[-1])
+    dw = jax.lax.dot_general(
+        g2, x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return dx, dw.astype(w.dtype)
+
+
+fp32_wgrad_matmul.defvjp(_fp32_wgrad_fwd, _fp32_wgrad_bwd)
 
 
 def _shard_init(base_init: Callable, axis_name: str) -> Callable:
@@ -110,7 +144,10 @@ class ColumnParallelLinear(nn.Module):
             else:
                 x = mappings.copy_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        y = x @ w.astype(x.dtype).T
+        if self.gradient_accumulation_fusion:
+            y = fp32_wgrad_matmul(x, w)
+        else:
+            y = x @ w.astype(x.dtype).T
         bias_out = None
         if b is not None:
             if self.skip_bias_add:
@@ -182,7 +219,10 @@ class RowParallelLinear(nn.Module):
             if bound:
                 x = mappings.scatter_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        y = x @ w.astype(x.dtype).T
+        if self.gradient_accumulation_fusion:
+            y = fp32_wgrad_matmul(x, w)
+        else:
+            y = x @ w.astype(x.dtype).T
         if bound:
             if self.sequence_parallel_enabled:
                 y = mappings.reduce_scatter_to_sequence_parallel_region(
@@ -234,6 +274,15 @@ class VocabParallelEmbedding(nn.Module):
         w = self.weight
         per = w.shape[0]
         if not _axis_bound(self.axis_name):
+            if self._world() != 1 and not self.is_initializing():
+                # with a sharded table and no bound axis we'd silently return
+                # wrong embeddings for ids >= vocab/tp — refuse instead
+                # (during flax init only shapes matter, so the clamp path is
+                # allowed there: eval_shape/init run outside shard_map)
+                raise RuntimeError(
+                    "VocabParallelEmbedding with world_size>1 must run "
+                    f"inside shard_map with the '{self.axis_name}' axis "
+                    "bound (the table holds only a vocab shard)")
             return jnp.take(w, jnp.clip(input_ids, 0, per - 1), axis=0)
         rank = lax.axis_index(self.axis_name)
         start = rank * per

@@ -48,6 +48,7 @@ class RNGStatesTracker:
         self._counters: Dict[str, int] = {}
         self._model_parallel: Dict[str, bool] = {}
         self._active: list = []
+        self._step = None
 
     def get_states(self):
         """Checkpointable state (reference: get_states returns CUDA states)."""
@@ -77,14 +78,40 @@ class RNGStatesTracker:
         finally:
             self._active.pop()
 
-    def get_key(self, axis_name: str = MODEL_AXIS):
-        """Next key of the active (or default) stream."""
+    @contextlib.contextmanager
+    def with_step(self, step):
+        """Bind a (traced) training-step value folded into every key.
+
+        ``get_key``'s Python-side call counter distinguishes call *sites*
+        within one trace, but a jitted train step is traced ONCE and
+        re-executed — without a traced step value every executed step would
+        replay identical keys (and so identical dropout masks). Wrap the
+        jitted body in ``tracker.with_step(step)`` with ``step`` a traced
+        int to decorrelate steps (the analog of the reference's CUDA RNG
+        state advancing between steps).
+        """
+        prev, self._step = self._step, step
+        try:
+            yield
+        finally:
+            self._step = prev
+
+    def get_key(self, axis_name: str = MODEL_AXIS, step=None):
+        """Next key of the active (or default) stream.
+
+        ``step``: optional traced step value (overrides ``with_step``).
+        Inside a reused jitted step one of the two MUST be supplied — the
+        call counter alone is baked into the trace (see ``with_step``).
+        """
         name = self._active[-1] if self._active else None
         if name is None:
             raise RuntimeError("get_key() called outside tracker.fork(...)")
         key = jax.random.PRNGKey(self._seeds[name])
         key = jax.random.fold_in(key, self._counters[name])
         self._counters[name] += 1
+        step = step if step is not None else self._step
+        if step is not None:
+            key = jax.random.fold_in(key, step)
         if self._model_parallel.get(name):
             try:
                 key = jax.random.fold_in(key, lax.axis_index(axis_name))

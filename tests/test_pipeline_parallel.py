@@ -140,6 +140,7 @@ def test_no_pipelining_schedule(rng):
 def test_get_forward_backward_func_dispatch():
     from apex_tpu.transformer.pipeline_parallel import (
         forward_backward_no_pipelining,
+        forward_backward_pipelining_with_interleaving,
         forward_backward_pipelining_without_interleaving,
         get_forward_backward_func)
 
@@ -147,8 +148,8 @@ def test_get_forward_backward_func_dispatch():
             is forward_backward_no_pipelining)
     assert (get_forward_backward_func(None, 4)
             is forward_backward_pipelining_without_interleaving)
-    with pytest.raises(NotImplementedError):
-        get_forward_backward_func(2, 4)
+    assert (get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving)
 
 
 def test_microbatch_calculators():

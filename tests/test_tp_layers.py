@@ -226,10 +226,12 @@ def test_vocab_parallel_cross_entropy(tp4_mesh, rng, smoothing):
         return vocab_parallel_cross_entropy(lg, tg, smoothing)
 
     loss = run(logits, target)
-    # plain CE reference
+    # plain CE reference with the reference's smoothing rescale:
+    # smoothing' = smoothing * vocab/(vocab-1) (apex _VocabParallelCrossEntropy)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -np.asarray(jnp.take_along_axis(logp, target[:, None], axis=1))[:, 0]
-    ref = (1 - smoothing) * nll - smoothing * np.asarray(logp).mean(-1)
+    adj = smoothing * 32 / 31
+    ref = (1 - adj) * nll - adj * np.asarray(logp).mean(-1)
     np.testing.assert_allclose(np.asarray(loss), ref, rtol=1e-5, atol=1e-5)
 
 
