@@ -1,0 +1,20 @@
+"""apex.contrib.optimizers equivalents (reference:
+apex/contrib/optimizers/ — DistributedFusedAdam, DistributedFusedLAMB, plus
+legacy FP16_Optimizer/FusedSGD re-exports)."""
+
+from apex_tpu.contrib.optimizers.distributed import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    DistributedFusedOptimizerBase,
+)
+# legacy aliases the reference keeps in contrib.optimizers
+from apex_tpu.fp16_utils import FP16_Optimizer  # noqa: F401
+from apex_tpu.optimizers import FusedSGD  # noqa: F401
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "DistributedFusedOptimizerBase",
+    "FP16_Optimizer",
+    "FusedSGD",
+]

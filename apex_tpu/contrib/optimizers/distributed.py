@@ -1,0 +1,332 @@
+"""ZeRO-style distributed fused optimizers.
+
+Reference: apex/contrib/optimizers/distributed_fused_adam.py (~3k LoC:
+bucketed reduce-scatter of grads during backward, per-rank optimizer-state
+shards, `_pipeline_block_reductions` / `_pipeline_step` overlap, param
+all-gather) and distributed_fused_lamb.py (~1.5k, the MLPerf-BERT optimizer:
+two-stage LAMB kernels with an allreduce of per-tensor norms between stages,
+``clip_after_ar``).
+
+TPU restatement: the flat ``(rows, LANE)`` fp32 buffer (flat_buffer.py) is
+row-sharded over the ``data`` mesh axis — each rank owns ``rows/dp``
+contiguous rows of master params and optimizer state. One step is
+
+    grads -> flatten -> ``psum_scatter`` (the bucketed reduce-scatter)
+          -> fused Pallas update on the LOCAL shard
+          -> ``all_gather`` of the updated master rows -> unflatten.
+
+The reference's hand-rolled comm/compute overlap (_pipeline_block_reductions
+round-robining NCCL groups) is not re-implemented: XLA's latency-hiding
+scheduler overlaps the reduce-scatter/all-gather with neighboring compute,
+which is the TPU-native form of the same optimization. LAMB's cross-rank
+norm agreement maps to ``stats_psum_axis`` between the two kernel phases,
+and ``clip_after_ar`` clips on the globally-reduced grad norm (psum of
+per-shard partial sumsq) exactly like the reference.
+
+Two call surfaces:
+
+- ``step(grads)`` — facade parity with FusedAdam/FusedLAMB: runs its own
+  ``shard_map`` over the mesh; state stays physically sharded between steps.
+- ``shard_step(g_local, shard_state)`` — functional form for use INSIDE an
+  existing ``shard_map`` training step where each rank holds its own
+  (different) local grads; this is the true ZeRO data path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import mesh as mesh_lib
+from apex_tpu.mesh import DATA_AXIS
+from apex_tpu.ops import flat_buffer, optim_kernels
+from apex_tpu.ops.flat_buffer import LANE, FlatSpec, build_spec
+from apex_tpu.optimizers.common import path_name
+
+
+def _round_up(n: int, k: int) -> int:
+    return -(-n // k) * k
+
+
+class DistributedFusedOptimizerBase:
+    """Row-sharded flat-buffer optimizer state over the ``data`` mesh axis."""
+
+    STATE_BUFFERS: tuple = ()
+
+    def __init__(self, params, defaults: dict, *,
+                 mesh=None, dp_axis: str = DATA_AXIS,
+                 average_grads: bool = True,
+                 exclude_from_weight_decay: Optional[Callable[[str], bool]] = None):
+        self.mesh = mesh if mesh is not None else mesh_lib.get_global_mesh()
+        self.dp_axis = dp_axis
+        self.dp = int(self.mesh.shape[dp_axis])
+        self.average_grads = average_grads
+        self.defaults = dict(defaults)
+
+        self.spec: FlatSpec = build_spec(params)
+        self.padded_rows = _round_up(self.spec.total_rows, self.dp)
+        self.shard_rows = self.padded_rows // self.dp
+        # padding rows get a dummy segment (num_tensors) so their (zero)
+        # contributions never land in a real tensor's stats slot
+        seg = np.full(self.padded_rows, self.spec.num_tensors, np.int32)
+        seg[: self.spec.total_rows] = self.spec.segment_rows()
+        self._seg_global = jnp.asarray(seg)
+        self.num_segments = self.spec.num_tensors + (
+            1 if self.padded_rows > self.spec.total_rows else 0)
+
+        wd = float(self.defaults.get("weight_decay", 0.0))
+        if exclude_from_weight_decay is not None:
+            paths, _ = jax.tree_util.tree_flatten_with_path(params)
+            wd_list = [0.0 if exclude_from_weight_decay(path_name(p)) else wd
+                       for p, _ in paths]
+        else:
+            wd_list = [wd] * self.spec.num_tensors
+        if self.num_segments > self.spec.num_tensors:
+            wd_list = wd_list + [0.0]
+        self.wd_per_segment = jnp.asarray(wd_list, jnp.float32)
+
+        # physically row-sharded master + state (ZeRO partitioning)
+        shard = NamedSharding(self.mesh, P(dp_axis, None))
+        full = flat_buffer.flatten(params, self.spec)
+        pad = self.padded_rows - self.spec.total_rows
+        if pad:
+            full = jnp.concatenate([full, jnp.zeros((pad, LANE), jnp.float32)])
+        self.master = jax.device_put(full, shard)
+        self.state = {
+            name: jax.device_put(
+                jnp.zeros((self.padded_rows, LANE), jnp.float32), shard)
+            for name in self.STATE_BUFFERS
+        }
+        self.step_count = jnp.zeros((), jnp.int32)
+        self._amp_scaler = None
+        self._out_dtypes = None
+        self._jit_step = None
+
+    # -- torch-API parity shims ----------------------------------------------
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op (JAX grads are values)."""
+
+    @property
+    def param_groups(self):
+        return [dict(self.defaults, params=None)]
+
+    def attach_amp_scaler(self, scaler) -> None:
+        self._amp_scaler = scaler
+        self._jit_step = None
+
+    def set_output_dtypes(self, dtypes) -> None:
+        self._out_dtypes = list(dtypes)
+        self._jit_step = None
+
+    def state_dict(self):
+        return {"master": self.master, "state": dict(self.state),
+                "step": self.step_count, "defaults": dict(self.defaults)}
+
+    def load_state_dict(self, sd):
+        shard = NamedSharding(self.mesh, P(self.dp_axis, None))
+        self.master = jax.device_put(jnp.asarray(sd["master"]), shard)
+        self.state = {k: jax.device_put(jnp.asarray(v), shard)
+                      for k, v in sd["state"].items()}
+        self.step_count = jnp.asarray(sd["step"])
+        self.defaults.update(sd.get("defaults", {}))
+
+    # -- core ----------------------------------------------------------------
+    def _shard_update(self, g_shard, master_shard, state_shard, step, hyper,
+                      seg_local, gnorm, finite):
+        """Update THIS rank's rows. Implemented by subclasses."""
+        raise NotImplementedError
+
+    def _seg_local(self):
+        """Local slice of the row->segment map (traced rank index)."""
+        r = lax.axis_index(self.dp_axis)
+        return lax.dynamic_slice_in_dim(
+            self._seg_global, r * self.shard_rows, self.shard_rows)
+
+    def shard_step(self, g_tree, master_shard, state_shard, step, *,
+                   grad_scale=None, noop=None, scaler_state=None):
+        """One distributed step, called INSIDE shard_map (``dp_axis`` bound).
+
+        ``g_tree``: this rank's (unreduced) grad pytree — param shapes.
+        Returns ``(params_full, new_master_shard, new_state_shard, new_step,
+        new_scaler_state)``; params are all-gathered (replicated over dp).
+        """
+        spec = self.spec
+        g_flat = flat_buffer.flatten(g_tree, spec)
+        pad = self.padded_rows - spec.total_rows
+        if pad:
+            g_flat = jnp.concatenate(
+                [g_flat, jnp.zeros((pad, LANE), jnp.float32)])
+        # the ZeRO reduce-scatter (reference: _pipeline_block_reductions)
+        g_shard = lax.psum_scatter(g_flat, self.dp_axis,
+                                   scatter_dimension=0, tiled=True)
+        if self.average_grads:
+            g_shard = g_shard / self.dp
+
+        seg_local = self._seg_local()
+        # post-reduction global grad norm + found-inf, agreed across ranks
+        # (reference: clip_after_ar + the distributed noop_flag allreduce)
+        stats = optim_kernels.segment_stats(g_shard, seg_local,
+                                            self.num_segments)
+        stats = lax.psum(stats, self.dp_axis)
+        gnorm = jnp.sqrt(jnp.sum(stats[optim_kernels.STAT_SUMSQ_A]))
+        finite = jnp.sum(stats[optim_kernels.STAT_NONFINITE]) == 0.0
+
+        gs = jnp.float32(1.0) if grad_scale is None else jnp.asarray(
+            grad_scale, jnp.float32)
+        noop_ = jnp.zeros((), jnp.float32) if noop is None else jnp.asarray(
+            noop, jnp.float32)
+        scaler = self._amp_scaler
+        if scaler is not None and scaler_state is not None:
+            found_inf = 1.0 - finite.astype(jnp.float32)
+            gs = gs / scaler_state.scale
+            noop_ = jnp.maximum(noop_, found_inf)
+            scaler_state = scaler.update(scaler_state, found_inf)
+        else:
+            noop_ = jnp.maximum(noop_, 1.0 - finite.astype(jnp.float32))
+
+        hyper = {k: jnp.asarray(v, jnp.float32)
+                 for k, v in self.defaults.items()
+                 if isinstance(v, (int, float))}
+        hyper["grad_scale"] = gs
+        hyper["noop"] = noop_
+        new_step = step + jnp.where(noop_ > 0.0, 0, 1).astype(step.dtype)
+
+        new_master, new_state = self._shard_update(
+            g_shard, master_shard, state_shard, new_step, hyper, seg_local,
+            gnorm * gs, finite)
+
+        # param all-gather (reference: _pipeline_step's allgather of params)
+        full = lax.all_gather(new_master, self.dp_axis, axis=0, tiled=True)
+        if pad:
+            full = full[: spec.total_rows]
+        params = flat_buffer.unflatten(full, spec, dtypes=self._out_dtypes)
+        return params, new_master, new_state, new_step, scaler_state
+
+    def step(self, grads, grad_scale=None, noop=None):
+        """Facade step (outside shard_map): grads may be replicated or
+        dp-sharded; state stays physically row-sharded between calls."""
+        gdef = jax.tree.structure(grads)
+        if gdef != self.spec.treedef:
+            raise ValueError(
+                f"grad pytree structure {gdef} does not match the parameter "
+                f"structure this optimizer was built with ({self.spec.treedef})")
+        if self._jit_step is None:
+            def _pure(g_tree, master, state, step, gs, noop_, sstate):
+                def body(g_tree, master_s, state_s, step, gs, noop_, sstate):
+                    return self.shard_step(
+                        g_tree, master_s, state_s, step,
+                        grad_scale=gs, noop=noop_, scaler_state=sstate)
+
+                row_shard = P(self.dp_axis, None)
+                state_specs = {k: row_shard for k in state}
+                sstate_spec = None if sstate is None else jax.tree.map(
+                    lambda _: P(), sstate)
+                return jax.shard_map(
+                    body, mesh=self.mesh,
+                    in_specs=(P(), row_shard, state_specs, P(), P(), P(),
+                              sstate_spec),
+                    out_specs=(P(), row_shard, state_specs, P(), sstate_spec),
+                    check_vma=False,
+                )(g_tree, master, state, step, gs, noop_, sstate)
+
+            self._jit_step = jax.jit(_pure, donate_argnums=(1, 2))
+
+        gs = jnp.asarray(1.0 if grad_scale is None else grad_scale, jnp.float32)
+        noop_ = jnp.asarray(0.0 if noop is None else noop, jnp.float32)
+        sstate = self._amp_scaler.state if self._amp_scaler is not None else None
+        params, self.master, self.state, self.step_count, sstate = \
+            self._jit_step(grads, self.master, self.state, self.step_count,
+                           gs, noop_, sstate)
+        if self._amp_scaler is not None:
+            self._amp_scaler.state = sstate
+        return params
+
+
+class DistributedFusedAdam(DistributedFusedOptimizerBase):
+    """Reference: apex/contrib/optimizers/distributed_fused_adam.py —
+    FusedAdam with ZeRO state sharding over the data-parallel ranks."""
+
+    STATE_BUFFERS = ("m", "v")
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, max_grad_norm=0.0, amsgrad=False,
+                 mesh=None, dp_axis: str = DATA_AXIS, average_grads=True,
+                 exclude_from_weight_decay=None, **unused_reference_knobs):
+        if amsgrad:
+            raise RuntimeError(
+                "DistributedFusedAdam does not support AMSGrad.")
+        defaults = dict(lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+                        weight_decay=weight_decay, max_grad_norm=max_grad_norm)
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        super().__init__(params, defaults, mesh=mesh, dp_axis=dp_axis,
+                         average_grads=average_grads,
+                         exclude_from_weight_decay=exclude_from_weight_decay)
+
+    def _shard_update(self, g_shard, master_shard, state_shard, step, hyper,
+                      seg_local, gnorm, finite):
+        max_norm = hyper.get("max_grad_norm", jnp.float32(0.0))
+        clip = jnp.where((max_norm > 0.0) & (gnorm > max_norm),
+                         max_norm / gnorm, jnp.float32(1.0))
+        p, m, v = optim_kernels.adam_update(
+            g_shard, master_shard, state_shard["m"], state_shard["v"],
+            beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
+            weight_decay=self.wd_per_segment, lr=hyper["lr"], step=step,
+            grad_scale=hyper["grad_scale"] * clip, noop=hyper["noop"],
+            adam_w_mode=self.adam_w_mode, bias_correction=self.bias_correction,
+            seg_rows=seg_local, num_segments=self.num_segments)
+        return p, dict(m=m, v=v)
+
+
+class DistributedFusedLAMB(DistributedFusedOptimizerBase):
+    """Reference: apex/contrib/optimizers/distributed_fused_lamb.py — the
+    MLPerf-BERT LAMB: sharded state, per-tensor trust-ratio norms allreduced
+    between the two kernel stages, ``clip_after_ar``."""
+
+    STATE_BUFFERS = ("m", "v")
+
+    def __init__(self, params, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 max_grad_norm=1.0, adam_w_mode=True, grad_averaging=True,
+                 use_nvlamb=False, clip_after_ar=True,
+                 mesh=None, dp_axis: str = DATA_AXIS, average_grads=True,
+                 exclude_from_weight_decay=None, **unused_reference_knobs):
+        if not adam_w_mode:
+            raise NotImplementedError(
+                "DistributedFusedLAMB: only adam_w_mode=True (reference default).")
+        if not clip_after_ar:
+            raise NotImplementedError(
+                "clip_before_ar (clip_after_ar=False) is not implemented: on "
+                "TPU the reduce-scatter and the norm are one fused program, "
+                "so pre-reduction clipping has no latency to hide.")
+        defaults = dict(lr=lr, beta1=betas[0], beta2=betas[1], eps=eps,
+                        weight_decay=weight_decay, max_grad_norm=max_grad_norm)
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.use_nvlamb = use_nvlamb
+        super().__init__(params, defaults, mesh=mesh, dp_axis=dp_axis,
+                         average_grads=average_grads,
+                         exclude_from_weight_decay=exclude_from_weight_decay)
+
+    def _shard_update(self, g_shard, master_shard, state_shard, step, hyper,
+                      seg_local, gnorm, finite):
+        max_norm = hyper["max_grad_norm"]
+        clip = jnp.where((max_norm > 0.0) & (gnorm > max_norm),
+                         max_norm / gnorm, jnp.float32(1.0))
+        p, m, v = optim_kernels.lamb_update(
+            g_shard, master_shard, state_shard["m"], state_shard["v"],
+            seg_local, self.num_segments,
+            beta1=hyper["beta1"], beta2=hyper["beta2"], eps=hyper["eps"],
+            weight_decay=self.wd_per_segment, lr=hyper["lr"], step=step,
+            grad_scale=hyper["grad_scale"] * clip, noop=hyper["noop"],
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging, use_nvlamb=self.use_nvlamb,
+            stats_psum_axis=self.dp_axis)
+        return p, dict(m=m, v=v)
