@@ -25,12 +25,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.amp.policy import Policy, is_norm_param_name, make_policy
+from apex_tpu.amp.policy import (Policy, is_norm_param_name, make_policy,
+                                 resolve_compute_dtype)
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 from apex_tpu.optimizers.common import path_name as _path_name
 
 __all__ = ["initialize", "scale_loss", "master_params", "current_policy",
-           "state_dict", "load_state_dict", "Policy", "make_policy", "LossScaler"]
+           "state_dict", "load_state_dict", "Policy", "make_policy",
+           "LossScaler", "resolve_compute_dtype"]
 
 # module-level amp state (reference: apex/amp/_amp_state.py)
 _current_policy: Optional[Policy] = None

@@ -67,6 +67,22 @@ class Policy:
         )
 
 
+def resolve_compute_dtype(default):
+    """The dtype modules should compute in: the active amp Policy's compute
+    dtype if ``amp.initialize`` has been called, else ``default``.
+
+    This is the TPU seam replacing the reference's O1 monkey-patching
+    (apex/amp/amp.py:init patches torch functions to cast per-op): every
+    module calls this at trace time, so ``amp.initialize(opt_level="O1")``
+    flips compute dtypes without touching any config. Traces are re-built
+    after amp.initialize (amp-then-jit, the reference's required order).
+    """
+    from apex_tpu import amp as _amp
+
+    pol = _amp.current_policy()
+    return default if pol is None else pol.compute_dtype
+
+
 def make_policy(opt_level: str, half_dtype=jnp.bfloat16,
                 cast_model_type=None, keep_batchnorm_fp32=None,
                 master_weights=None, loss_scale=None) -> Policy:

@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.contrib.multihead_attn._core import attention_core, masks_to_bias
 from apex_tpu.ops.layer_norm import layer_norm as _layer_norm
 
@@ -78,8 +79,9 @@ class EncdecMultiheadAttn(nn.Module):
             x = _layer_norm(x, self.lyr_nrm_gamma_weights,
                             self.lyr_nrm_beta_weights, eps=1e-5)
 
-        q = x @ self.q_weight.T
-        kv = key @ self.kv_weight.T
+        dt = resolve_compute_dtype(x.dtype)  # amp O1 seam: GEMMs in half
+        q = x.astype(dt) @ self.q_weight.astype(dt).T
+        kv = key.astype(dt) @ self.kv_weight.astype(dt).T
         k, v = jnp.split(kv, 2, axis=-1)
 
         q = q.reshape(sq, b, h, d).transpose(1, 2, 0, 3)
@@ -91,7 +93,7 @@ class EncdecMultiheadAttn(nn.Module):
         ctx = attention_core(self, q, d, k, v, bias_, rate, self.impl)
 
         ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b, e)
-        out = ctx @ self.out_proj_weight.T
+        out = ctx @ self.out_proj_weight.astype(dt).T
         if self.include_norm_add:
             out = out + residual
         return out, None

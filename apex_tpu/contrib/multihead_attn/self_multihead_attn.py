@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.contrib.multihead_attn._core import attention_core, masks_to_bias
 from apex_tpu.ops.layer_norm import layer_norm as _layer_norm
 
@@ -98,16 +99,20 @@ class SelfMultiheadAttn(nn.Module):
             x = _layer_norm(x, self.lyr_nrm_gamma_weights,
                             self.lyr_nrm_beta_weights, eps=1e-5)
 
+        dt = resolve_compute_dtype(x.dtype)  # amp O1 seam: GEMMs in half
+        x = x.astype(dt)
         if self.separate_qkv_params:
-            q = x @ self.q_weight.T
-            k = x @ self.k_weight.T
-            v = x @ self.v_weight.T
+            q = x @ self.q_weight.astype(dt).T
+            k = x @ self.k_weight.astype(dt).T
+            v = x @ self.v_weight.astype(dt).T
             if self.bias:
-                q, k, v = q + self.q_bias, k + self.k_bias, v + self.v_bias
+                q = q + self.q_bias.astype(dt)
+                k = k + self.k_bias.astype(dt)
+                v = v + self.v_bias.astype(dt)
         else:
-            qkv = x @ self.qkv_weight.T
+            qkv = x @ self.qkv_weight.astype(dt).T
             if self.bias:
-                qkv = qkv + self.qkv_bias
+                qkv = qkv + self.qkv_bias.astype(dt)
             q, k, v = jnp.split(qkv, 3, axis=-1)
 
         # [sq, b, e] -> [b, h, sq, d]
@@ -121,9 +126,9 @@ class SelfMultiheadAttn(nn.Module):
 
         # [b, h, sq, d] -> [sq, b, e]
         ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b, e)
-        out = ctx @ self.out_proj_weight.T
+        out = ctx @ self.out_proj_weight.astype(dt).T
         if self.bias:
-            out = out + self.out_proj_bias
+            out = out + self.out_proj_bias.astype(dt)
         if self.include_norm_add:
             out = out + residual
         return out, None

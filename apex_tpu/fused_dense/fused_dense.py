@@ -17,6 +17,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import resolve_compute_dtype
+
 
 def _gelu(x):
     # cublasLt GELU epilogue uses the tanh approximation
@@ -24,10 +26,13 @@ def _gelu(x):
 
 
 def fused_dense_function(x, weight, bias=None):
-    """Reference: fused_dense_function / FusedDenseFunc."""
-    y = x @ weight.T
+    """Reference: fused_dense_function / FusedDenseFunc.
+
+    Consults the active amp policy (O1 analog: GEMMs compute in half)."""
+    dt = resolve_compute_dtype(x.dtype)
+    y = x.astype(dt) @ weight.astype(dt).T
     if bias is not None:
-        y = y + bias
+        y = y + bias.astype(dt)
     return y
 
 

@@ -18,6 +18,8 @@ from typing import Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import resolve_compute_dtype
+
 
 class MLP(nn.Module):
     """Drop-in for apex.mlp.MLP.
@@ -40,16 +42,17 @@ class MLP(nn.Module):
         sizes = list(self.mlp_sizes)
         assert x.shape[-1] == sizes[0], (
             f"input width {x.shape[-1]} != mlp_sizes[0] {sizes[0]}")
+        dt = resolve_compute_dtype(x.dtype)  # amp O1 seam: GEMMs in half
         for i in range(len(sizes) - 1):
             w = self.param(f"weight_{i}",
                            nn.initializers.variance_scaling(
                                1.0 / 3.0, "fan_in", "uniform"),
                            (sizes[i + 1], sizes[i]), self.param_dtype)
-            x = x @ w.T
+            x = x.astype(dt) @ w.astype(dt).T
             if self.bias:
                 b = self.param(f"bias_{i}", nn.initializers.zeros,
                                (sizes[i + 1],), self.param_dtype)
-                x = x + b
+                x = x + b.astype(dt)
             if self.activation == "relu":
                 x = nn.relu(x)
             elif self.activation == "sigmoid":

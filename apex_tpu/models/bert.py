@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import flash_attention, softmax_cross_entropy
@@ -72,6 +73,7 @@ class BertSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
         cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)  # amp O1 seam
         e, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
         b, s, _ = x.shape
         init = nn.initializers.normal(0.02)
@@ -82,7 +84,7 @@ class BertSelfAttention(nn.Module):
         out_b = self.param("out_bias", nn.initializers.zeros, (e,),
                            cfg.param_dtype)
 
-        qkv = x @ qkv_w.astype(cfg.dtype) + qkv_b.astype(cfg.dtype)
+        qkv = x @ qkv_w.astype(dt) + qkv_b.astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def to_bhsd(t):
@@ -95,7 +97,7 @@ class BertSelfAttention(nn.Module):
         )
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, e)
         # out-proj stays in compute dtype; the bias add fuses into the GEMM
-        return ctx @ out_w.astype(cfg.dtype) + out_b.astype(cfg.dtype)
+        return ctx @ out_w.astype(dt) + out_b.astype(dt)
 
 
 class BertLayer(nn.Module):
@@ -106,6 +108,7 @@ class BertLayer(nn.Module):
     @nn.compact
     def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
         cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
         attn_out = BertSelfAttention(cfg, name="attention")(
             x, attention_bias, deterministic=deterministic,
             dropout_seed=dropout_seed)
@@ -126,9 +129,9 @@ class BertLayer(nn.Module):
                         cfg.param_dtype)
         b2 = self.param("mlp_bias2", nn.initializers.zeros,
                         (cfg.hidden_size,), cfg.param_dtype)
-        hmid = jax.nn.gelu(x @ w1.astype(cfg.dtype) + b1.astype(cfg.dtype),
+        hmid = jax.nn.gelu(x @ w1.astype(dt) + b1.astype(dt),
                            approximate=True)
-        mlp_out = hmid @ w2.astype(cfg.dtype) + b2.astype(cfg.dtype)
+        mlp_out = hmid @ w2.astype(dt) + b2.astype(dt)
         if not deterministic and cfg.hidden_dropout > 0.0:
             mlp_out = nn.Dropout(cfg.hidden_dropout)(
                 mlp_out, deterministic=False)
@@ -151,6 +154,7 @@ class BertForPreTraining(nn.Module):
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  *, deterministic: bool = True, dropout_seed=0):
         cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
         init = nn.initializers.normal(0.02)
 
@@ -170,7 +174,7 @@ class BertForPreTraining(nn.Module):
             x = x + jnp.take(type_emb, token_type_ids, axis=0)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                            name="embedding_norm")(x)
-        x = x.astype(cfg.dtype)
+        x = x.astype(dt)
         if not deterministic and cfg.hidden_dropout > 0.0:
             x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=False)
 
@@ -200,11 +204,11 @@ class BertForPreTraining(nn.Module):
                            (cfg.hidden_size,), cfg.param_dtype)
         mlm_out_b = self.param("mlm_output_bias", nn.initializers.zeros,
                                (cfg.vocab_size,), cfg.param_dtype)
-        hmlm = jax.nn.gelu(x @ mlm_w.astype(cfg.dtype) + mlm_b.astype(cfg.dtype),
+        hmlm = jax.nn.gelu(x @ mlm_w.astype(dt) + mlm_b.astype(dt),
                            approximate=True)
         hmlm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
-                              name="mlm_norm")(hmlm).astype(cfg.dtype)
-        mlm_logits = hmlm @ word_emb.T.astype(cfg.dtype) + mlm_out_b.astype(cfg.dtype)
+                              name="mlm_norm")(hmlm).astype(dt)
+        mlm_logits = hmlm @ word_emb.T.astype(dt) + mlm_out_b.astype(dt)
 
         # NSP head over the [CLS] (position 0) vector
         pool_w = self.param("pooler_weight", init,
@@ -215,9 +219,9 @@ class BertForPreTraining(nn.Module):
                            cfg.param_dtype)
         nsp_b = self.param("nsp_bias", nn.initializers.zeros, (2,),
                            cfg.param_dtype)
-        pooled = jnp.tanh(x[:, 0, :] @ pool_w.astype(cfg.dtype)
-                          + pool_b.astype(cfg.dtype))
-        nsp_logits = pooled @ nsp_w.astype(cfg.dtype) + nsp_b.astype(cfg.dtype)
+        pooled = jnp.tanh(x[:, 0, :] @ pool_w.astype(dt)
+                          + pool_b.astype(dt))
+        nsp_logits = pooled @ nsp_w.astype(dt) + nsp_b.astype(dt)
         return mlm_logits, nsp_logits
 
 

@@ -20,6 +20,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import flash_attention
@@ -71,6 +72,7 @@ class ParallelDecoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)  # amp O1 seam
         tp = cfg.tensor_parallel_size
         e = cfg.hidden_size
         h_local = divide(cfg.num_heads, tp)
@@ -78,7 +80,7 @@ class ParallelDecoderBlock(nn.Module):
         b, s, _ = x.shape
 
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="input_norm")(x)
-        h = h.astype(cfg.dtype)
+        h = h.astype(dt)
         # QKV column-parallel: local output is the local heads' q,k,v
         qkv = ColumnParallelLinear(
             e, 3 * e, gather_output=False, world_size=tp,
@@ -96,7 +98,7 @@ class ParallelDecoderBlock(nn.Module):
         x = x + attn_out.astype(x.dtype)
 
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
-        h = h.astype(cfg.dtype)
+        h = h.astype(dt)
         h = ColumnParallelLinear(
             e, 4 * e, gather_output=False, world_size=tp,
             params_dtype=cfg.param_dtype, name="mlp_in")(h)
@@ -118,6 +120,7 @@ class GPTModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids):
         cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, world_size=cfg.tensor_parallel_size,
@@ -126,13 +129,13 @@ class GPTModel(nn.Module):
         pos = self.param("position_embeddings", nn.initializers.normal(0.02),
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          cfg.param_dtype)
-        x = (x + pos[None, :s, :]).astype(cfg.dtype)
+        x = (x + pos[None, :s, :]).astype(dt)
         for i in range(cfg.num_layers):
             x = ParallelDecoderBlock(cfg, name=f"layer_{i}")(x)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                            name="final_norm")(x)
         # tied LM head: local logits against the LOCAL vocab shard
-        return emb.attend(x.astype(cfg.dtype))
+        return emb.attend(x.astype(dt))
 
 
 def gpt_loss(model: GPTModel, variables, input_ids, labels,

@@ -1,0 +1,5 @@
+"""apex.transformer.amp equivalent."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

@@ -1,0 +1,58 @@
+"""Model-parallel-aware gradient scaler.
+
+Reference: apex/transformer/amp/grad_scaler.py — a ``torch.cuda.amp.GradScaler``
+subclass whose ``_unscale_grads_`` all-reduces (MAX) the found-inf flag over
+the model-parallel group, so TP/PP ranks agree on whether to skip a step.
+
+TPU restatement: the same agreement is ``lax.pmax`` of the found-inf scalar
+over every bound model-parallel mesh axis. The fused optimizers apply it
+automatically inside their jitted step
+(apex_tpu/optimizers/common.py:_agree_found_inf_across_model_parallel), so
+this class exists as (a) the API-parity surface, and (b) the functional
+helper for hand-rolled training loops.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS, STAGE_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+
+
+def agree_found_inf(found_inf,
+                    axes=(MODEL_AXIS, STAGE_AXIS, CONTEXT_AXIS)):
+    """pmax ``found_inf`` over every bound axis in ``axes`` (the reference's
+    torch.distributed.all_reduce(MAX, group=model_parallel_group))."""
+    for ax in axes:
+        if axis_is_bound(ax):
+            found_inf = lax.pmax(found_inf, ax)
+    return found_inf
+
+
+class GradScaler(LossScaler):
+    """Drop-in for apex.transformer.amp.GradScaler.
+
+    Same ctor surface as torch.cuda.amp.GradScaler; ``update(state,
+    found_inf)`` agrees the flag across model-parallel axes first.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 16, growth_factor: float = 2.0,
+                 backoff_factor: float = 0.5, growth_interval: int = 2000,
+                 enabled: bool = True):
+        if growth_factor != 1.0 / backoff_factor:
+            # the flat LossScaler uses one factor both ways; the reference's
+            # defaults (2.0, 0.5) satisfy this
+            raise NotImplementedError(
+                "GradScaler requires growth_factor == 1/backoff_factor")
+        super().__init__(loss_scale="dynamic" if enabled else 1.0,
+                         init_scale=init_scale, scale_factor=growth_factor,
+                         scale_window=growth_interval)
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        return super().update(state, agree_found_inf(found_inf))

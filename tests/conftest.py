@@ -35,6 +35,18 @@ def _fresh_parallel_state():
     parallel_state.destroy_model_parallel()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_amp_state():
+    """Reset the global amp policy/scalers between tests — modules consult
+    amp.current_policy() for compute dtypes, so leakage would silently flip
+    other tests' dtypes."""
+    yield
+    from apex_tpu import amp
+
+    amp._current_policy = None
+    amp._loss_scalers = []
+
+
 @pytest.fixture
 def mesh8():
     """data=8 mesh."""
