@@ -1,0 +1,146 @@
+"""Stage-partitioned GPT: pipeline parallelism on a REAL model, composed
+with tensor parallelism.
+
+Reference: apex/transformer/pipeline_parallel is exercised upstream through
+Megatron-style models whose layers are divided into contiguous per-stage
+blocks, with the embedding on the first stage, the tied LM head on the last,
+and the tied-embedding grads all-reduced between them over
+``parallel_state._EMBEDDING_GROUP``. This module restates that for the
+scan+ppermute schedules: the decoder blocks of ``apex_tpu.models.gpt`` are
+split into S stacks, the schedule's ``first_fn`` is the (vocab-parallel)
+embedding preprocess, and ``loss_fn`` is the final-norm + tied-head +
+vocab-parallel-CE postprocess.
+
+Tied embeddings: every stage's local tree carries the shared params (embed /
+pos / final norm); only stage 0 (embed) and the last stage (head) produce
+nonzero grads for them, so ``psum`` of the shared-grad subtree over the
+stage axis reproduces the reference's embedding all-reduce exactly —
+``psum_shared_grads`` below does this.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh import MODEL_AXIS, STAGE_AXIS
+from apex_tpu.models.gpt import GPTConfig, GPTModel, ParallelDecoderBlock
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.tensor_parallel import (
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+from apex_tpu.transformer.utils import divide
+
+
+def split_gpt_params_for_pipeline(params, n_stages: int, num_layers: int,
+                                  virtual_chunks: int = 1):
+    """Partition a GPTModel param tree into the pipeline layout.
+
+    Returns a pytree whose leaves are stacked ``[n_stages, ...]`` for use
+    with ``shard_map(in_specs=P(STAGE_AXIS))``:
+
+      {"blocks": [S, V, K, ...] per-stage chunk-stacked decoder blocks,
+       "shared": [S, ...] the embed/pos/final-norm params REPLICATED to
+                 every stage (tied-embedding layout)}
+
+    With ``virtual_chunks=V>1``, stage s's chunk v holds global layers of
+    virtual stage ``v*S + s`` (Megatron's round-robin VPP assignment).
+    """
+    chunk_layers = divide(num_layers, n_stages * virtual_chunks)
+
+    def stack_layers(idxs):
+        trees = [params[f"layer_{i}"] for i in idxs]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+    blocks = []
+    for s in range(n_stages):
+        chunks = []
+        for v in range(virtual_chunks):
+            vs = v * n_stages + s      # global virtual stage index
+            start = vs * chunk_layers
+            chunks.append(stack_layers(range(start, start + chunk_layers)))
+        blocks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *chunks))
+    blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+
+    shared = {
+        "word_embeddings": params["word_embeddings"],
+        "position_embeddings": params["position_embeddings"],
+        "final_norm": params["final_norm"],
+    }
+    shared = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_stages,) + x.shape), shared)
+    return {"blocks": blocks, "shared": shared}
+
+
+def merge_pipeline_grads_to_gpt(grads, n_stages: int, num_layers: int,
+                                virtual_chunks: int = 1):
+    """Inverse of ``split_gpt_params_for_pipeline`` for STACKED grad trees
+    (leaves ``[S, ...]``): reassembles a GPTModel-layout grad tree, summing
+    the shared-param grads over stages (the tied-embedding all-reduce)."""
+    chunk_layers = divide(num_layers, n_stages * virtual_chunks)
+    out = {}
+    for s in range(n_stages):
+        for v in range(virtual_chunks):
+            vs = v * n_stages + s
+            for k in range(chunk_layers):
+                out[f"layer_{vs * chunk_layers + k}"] = jax.tree.map(
+                    lambda t, s=s, v=v, k=k: t[s, v, k], grads["blocks"])
+    for name in ("word_embeddings", "position_embeddings", "final_norm"):
+        out[name] = jax.tree.map(lambda t: t.sum(0), grads["shared"][name])
+    return out
+
+
+def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
+    """(first_fn, stage_fn, loss_fn) for the pipeline schedules.
+
+    ``first_fn(local, ids)`` — vocab-parallel embed + positions (stage-0
+    preprocess); ``stage_fn(local, x)`` — this stage's decoder blocks via
+    ``lax.scan`` over the stacked block params; ``loss_fn(local, y, labels)``
+    — final norm + tied LM head + vocab-parallel CE (last-stage
+    postprocess). Use with ``loss_with_params=True``.
+
+    The ``local`` tree is one device's slice: ``{"blocks": [V?, K, ...],
+    "shared": {...}}`` (chunk axis present only under VPP).
+    """
+    tp = cfg.tensor_parallel_size
+    emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                 world_size=tp, params_dtype=cfg.param_dtype)
+    block = ParallelDecoderBlock(cfg)
+    norm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps)
+
+    def first_fn(local, ids):
+        sh = local["shared"]
+        x = emb.apply({"params": sh["word_embeddings"]}, ids)
+        s = ids.shape[-1]
+        x = x + sh["position_embeddings"][None, :s, :]
+        return x.astype(cfg.dtype)
+
+    def stage_fn(local, x):
+        def body(h, bp):
+            return block.apply({"params": bp}, h), None
+
+        h, _ = lax.scan(body, x, local["blocks"])
+        return h
+
+    def loss_fn(local, y, labels):
+        sh = local["shared"]
+        h = norm.apply({"params": sh["final_norm"]}, y)
+        logits = emb.apply({"params": sh["word_embeddings"]},
+                           h.astype(cfg.dtype),
+                           method=VocabParallelEmbedding.attend)
+        if axis_is_bound(MODEL_AXIS):
+            per_tok = vocab_parallel_cross_entropy(
+                logits.astype(jnp.float32), labels, axis_name=MODEL_AXIS)
+        else:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            per_tok = -jnp.take_along_axis(
+                logp, labels[..., None], axis=-1)[..., 0]
+        return per_tok.mean()
+
+    return first_fn, stage_fn, loss_fn

@@ -1,0 +1,217 @@
+"""Real-model pipeline: GPT stage-partitioned over ``stage`` composed with
+TP over ``model``, vs the single-device model (VERDICT round-1 item 4).
+
+Also covers the interleaved (VPP) schedule vs a sequential reference.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import MODEL_AXIS, STAGE_AXIS
+from apex_tpu.models.gpt import GPTModel, gpt_loss, gpt_tiny_config
+from apex_tpu.models.gpt_pipeline import (
+    make_gpt_pipeline_fns,
+    merge_pipeline_grads_to_gpt,
+    split_gpt_params_for_pipeline,
+)
+
+
+def _shard_tree(params1, params_tp_shape, rank, tp):
+    """Slice a tp=1 GPT param tree into rank's tp shard (see
+    tests/test_gpt_model.py; generalized over tp)."""
+
+    def slice_leaf(path, full, shard):
+        if full.shape == shard.shape:
+            return full
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "qkv" in name:
+            per = shard.shape[0] // 3
+            t = full.reshape(3, full.shape[0] // 3, *full.shape[1:])
+            return t[:, rank * per:(rank + 1) * per].reshape(shard.shape)
+        for ax in range(full.ndim):
+            if full.shape[ax] == shard.shape[ax] * tp:
+                size = shard.shape[ax]
+                idx = [slice(None)] * full.ndim
+                idx[ax] = slice(rank * size, (rank + 1) * size)
+                return full[tuple(idx)]
+        raise AssertionError(f"unsliceable {full.shape} -> {shard.shape}")
+
+    return jax.tree_util.tree_map_with_path(slice_leaf, params1,
+                                            params_tp_shape)
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+def test_gpt_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng, schedule):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+        forward_backward_pipelining_without_interleaving)
+
+    mesh = mesh_tp2_pp2_dp2
+    pp, tp = 2, 2
+    vpp = 2 if schedule == "interleaved" else 1
+    n_layers = 4
+    m, b, s = 4, 2, 8
+
+    cfg1 = gpt_tiny_config(tensor_parallel_size=1, num_layers=n_layers)
+    cfg2 = gpt_tiny_config(tensor_parallel_size=tp, num_layers=n_layers)
+
+    mbs = jnp.asarray(rng.integers(0, cfg1.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg1.vocab_size, (m, b, s)),
+                         jnp.int32)
+
+    # reference: single-device GPT, mean loss over microbatches
+    m1 = GPTModel(cfg1)
+    v1 = m1.init(jax.random.PRNGKey(0), mbs[0])["params"]
+
+    def ref_loss(p):
+        per = jax.vmap(lambda ii, ll: gpt_loss(
+            m1, {"params": p}, ii, ll, axis_name="unbound"))(mbs, labels)
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(v1)
+
+    # tp-slice the full tree per rank, then stage-partition each
+    m2 = GPTModel(cfg2)
+    v2_shape = jax.eval_shape(
+        lambda: m2.init(jax.random.PRNGKey(0), mbs[0]))["params"]
+    per_rank = []
+    for r in range(tp):
+        tp_tree = _shard_tree(v1, v2_shape, r, tp)
+        per_rank.append(split_gpt_params_for_pipeline(
+            tp_tree, pp, n_layers, virtual_chunks=vpp))
+    # stack [S, T, ...]: stage leading (P(STAGE_AXIS, MODEL_AXIS))
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs, axis=1), *per_rank)
+
+    first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg2)
+    if schedule == "interleaved":
+        fwd_bwd = forward_backward_pipelining_with_interleaving
+
+        def to_sched_tree(local):
+            # chunk axis must lead EVERY leaf: broadcast shared across V
+            return {"blocks": local["blocks"],
+                    "shared": jax.tree.map(
+                        lambda x: jnp.broadcast_to(x[None],
+                                                   (vpp,) + x.shape),
+                        local["shared"])}
+
+        def from_sched_tree(g):
+            return {"blocks": g["blocks"],
+                    "shared": jax.tree.map(lambda x: x.sum(0), g["shared"])}
+    else:
+        fwd_bwd = forward_backward_pipelining_without_interleaving
+
+        def to_sched_tree(local):
+            return {"blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
+                    "shared": local["shared"]}  # drop V=1 chunk axis
+
+        def from_sched_tree(g):
+            return {"blocks": jax.tree.map(lambda t: t[None], g["blocks"]),
+                    "shared": g["shared"]}
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS, MODEL_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS, MODEL_AXIS)),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        local = jax.tree.map(lambda t: t[0, 0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, to_sched_tree(local), mb,
+                              loss_aux=lb, first_fn=first_fn,
+                              loss_with_params=True)
+        grads = from_sched_tree(grads)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None, None], grads)
+
+    losses, grads = jax.jit(run)(stacked, mbs, labels)
+    np.testing.assert_allclose(np.asarray(losses), float(ref_l),
+                               rtol=2e-5, atol=2e-5)
+
+    # reassemble per-TP-rank GPT grad trees; shared grads psum over stages
+    for r in range(tp):
+        g_rank = jax.tree.map(lambda t, r=r: t[:, r], grads)
+        gpt_grads = merge_pipeline_grads_to_gpt(g_rank, pp, n_layers,
+                                                virtual_chunks=vpp)
+        ref_rank = _shard_tree(ref_g, v2_shape, r, tp)
+        replicated = jax.tree.map(lambda f, s: f.shape == s.shape,
+                                  ref_g, v2_shape)
+
+        def check(g_pp, g_ref, rep):
+            np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                                       rtol=5e-3, atol=1e-4)
+
+        jax.tree.map(check, gpt_grads, ref_rank, replicated)
+
+
+def test_interleaved_toy_matches_sequential(rng):
+    """VPP with V=2 chunks on pp=4: 8 virtual stages vs an 8-layer chain."""
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving as fwd_bwd)
+
+    mesh = parallel_state.initialize_model_parallel(1, 4)
+    S, V, D, m = 4, 2, 8, 6
+    # virtual stage v*S + s lives at [s, v] in the stacked layout
+    w_virt = rng.standard_normal((V * S, D, D)).astype(np.float32) / np.sqrt(D)
+    b_virt = (rng.standard_normal((V * S, D)) * 0.1).astype(np.float32)
+    w = np.zeros((S, V, D, D), np.float32)
+    bb = np.zeros((S, V, D), np.float32)
+    for v in range(V):
+        for s in range(S):
+            w[s, v] = w_virt[v * S + s]
+            bb[s, v] = b_virt[v * S + s]
+    params = {"w": jnp.asarray(w), "b": jnp.asarray(bb)}
+    mbs = jnp.asarray(rng.standard_normal((m, 2, D)), jnp.float32)
+    labels = jnp.asarray(rng.standard_normal((m, 2, D)), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y, lb):
+        return jnp.mean((y - lb) ** 2)
+
+    def ref(pw, pb):
+        def per_mb(mb, lb):
+            x = mb
+            for i in range(V * S):
+                x = jnp.tanh(x @ pw[i] + pb[i])
+            return jnp.mean((x - lb) ** 2)
+
+        return jax.vmap(per_mb)(mbs, labels).mean()
+
+    ref_l, (ref_gw, ref_gb) = jax.value_and_grad(ref, argnums=(0, 1))(
+        jnp.asarray(w_virt), jnp.asarray(b_virt))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        local = jax.tree.map(lambda t: t[0], p_stacked)  # [V, ...] chunks
+        loss, grads = fwd_bwd(stage_fn, loss_fn, local, mb, loss_aux=lb)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None], grads)
+
+    losses, grads = jax.jit(run)(params, mbs, labels)
+    np.testing.assert_allclose(np.asarray(losses), float(ref_l),
+                               rtol=1e-5, atol=1e-6)
+    gw, gb = np.asarray(grads["w"]), np.asarray(grads["b"])
+    for v in range(V):
+        for s in range(S):
+            np.testing.assert_allclose(gw[s, v], np.asarray(ref_gw)[v * S + s],
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(gb[s, v], np.asarray(ref_gb)[v * S + s],
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_get_forward_backward_func_interleaved_dispatch():
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+        get_forward_backward_func)
+
+    assert (get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving)
