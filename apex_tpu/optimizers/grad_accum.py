@@ -1,0 +1,60 @@
+"""Persistent fp32 main-grad accumulation across microbatches.
+
+Reference: csrc/megatron/fused_weight_gradient_dense.cpp — with
+``gradient_accumulation_fusion`` the TP linears' wgrad GEMM accumulates
+directly into each param's persistent fp32 ``main_grad`` buffer, so
+16-bit-per-microbatch rounding never touches the accumulated gradient.
+
+TPU split of the same mechanism:
+  1. the wgrad GEMM itself is fp32-accumulating
+     (``fp32_wgrad_matmul`` in tensor_parallel/layers.py — MXU-native), and
+  2. THIS buffer holds the across-microbatch fp32 sum in the optimizer's
+     flat ``(rows, LANE)`` master-grad layout, donated on every add (zero
+     reallocation — the "persistent buffer" property), feeding
+     ``FusedOptimizerBase.step`` via ``grads()`` (or directly via
+     ``step_flat`` consumers) with ``grad_scale=1/num_microbatches``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flat_buffer
+from apex_tpu.ops.flat_buffer import LANE, FlatSpec, build_spec
+
+
+class MainGradBuffer:
+    """fp32 grad accumulator in the fused optimizers' flat layout."""
+
+    def __init__(self, params_or_spec):
+        self.spec: FlatSpec = (params_or_spec
+                               if isinstance(params_or_spec, FlatSpec)
+                               else build_spec(params_or_spec))
+        self.buf = jnp.zeros((self.spec.total_rows, LANE), jnp.float32)
+        self._jit_add = jax.jit(
+            lambda buf, g: buf + flat_buffer.flatten(g, self.spec),
+            donate_argnums=(0,))
+        self.num_accumulated = 0
+
+    def accumulate(self, grads) -> None:
+        """buf += flatten(grads) — one fused donated add per microbatch."""
+        gdef = jax.tree.structure(grads)
+        if gdef != self.spec.treedef:
+            raise ValueError(
+                f"grad pytree {gdef} does not match the buffer's parameter "
+                f"structure {self.spec.treedef}")
+        self.buf = self._jit_add(self.buf, grads)
+        self.num_accumulated += 1
+
+    def grads(self, mean: bool = True):
+        """The accumulated grad pytree (fp32), optionally averaged."""
+        g = self.buf
+        if mean and self.num_accumulated > 1:
+            g = g / self.num_accumulated
+        fp32 = [jnp.float32] * self.spec.num_tensors
+        return flat_buffer.unflatten(g, self.spec, dtypes=fp32)
+
+    def zero(self) -> None:
+        self.buf = jnp.zeros_like(self.buf)
+        self.num_accumulated = 0

@@ -1,0 +1,109 @@
+"""gradient_accumulation_fusion: fp32 wgrad GEMM + persistent fp32
+main-grad buffer (VERDICT round-1 item 8; reference:
+csrc/megatron/fused_weight_gradient_dense.cpp)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import MODEL_AXIS
+
+
+def test_fp32_wgrad_matmul_matches_and_accumulates_fp32(rng):
+    from apex_tpu.transformer.tensor_parallel.layers import fp32_wgrad_matmul
+
+    x = jnp.asarray(rng.standard_normal((4, 8, 16)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+
+    y = fp32_wgrad_matmul(x, w)
+    y_ref = x @ w.astype(jnp.bfloat16).T
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y_ref, np.float32))
+
+    def loss(w):
+        return jnp.sum(fp32_wgrad_matmul(x, w).astype(jnp.float32) ** 2)
+
+    dw = jax.grad(loss)(w)
+    assert dw.dtype == jnp.float32
+    # reference value: fp32 computation throughout
+    xf = np.asarray(x, np.float32).reshape(-1, 16)
+    g = 2.0 * (xf @ np.asarray(w).T.astype(np.float32))
+    # forward ran in bf16, so g from bf16 y; recompute with bf16 fwd
+    yf = np.asarray(x @ w.astype(jnp.bfloat16).T, np.float32).reshape(-1, 32)
+    dw_ref = (2.0 * yf).T @ xf
+    np.testing.assert_allclose(np.asarray(dw), dw_ref, rtol=2e-2, atol=1e-2)
+
+
+def test_tp_linear_flag_no_longer_ignored(rng):
+    """With the flag on, grads must match the unfused path (numerics) while
+    the wgrad is computed by the fp32 custom vjp."""
+    from apex_tpu.transformer.tensor_parallel import ColumnParallelLinear
+
+    x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+    lin_off = ColumnParallelLinear(16, 32, world_size=1,
+                                   gradient_accumulation_fusion=False)
+    lin_on = ColumnParallelLinear(16, 32, world_size=1,
+                                  gradient_accumulation_fusion=True)
+    p = lin_off.init(jax.random.PRNGKey(0), x)
+
+    g_off = jax.grad(lambda v: jnp.sum(lin_off.apply(v, x) ** 2))(p)
+    g_on = jax.grad(lambda v: jnp.sum(lin_on.apply(v, x) ** 2))(p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5), g_off, g_on)
+
+
+def test_main_grad_buffer_fp32_accumulation(rng):
+    """Sum of bf16 microbatch grads accumulated in fp32 == fp32 sum (and
+    != the bf16 running sum when magnitudes differ)."""
+    from apex_tpu.optimizers.grad_accum import MainGradBuffer
+
+    params = {"w": jnp.zeros((64, 64), jnp.float32),
+              "b": jnp.zeros((100,), jnp.float32)}
+    buf = MainGradBuffer(params)
+    micro = []
+    for i in range(8):
+        g = {"w": jnp.asarray(rng.standard_normal((64, 64)) * 10.0 ** (-i),
+                              jnp.bfloat16),
+             "b": jnp.asarray(rng.standard_normal((100,)), jnp.bfloat16)}
+        micro.append(g)
+        buf.accumulate(g)
+
+    total = buf.grads(mean=False)
+    ref = {k: np.sum([np.asarray(g[k], np.float32) for g in micro], axis=0)
+           for k in params}
+    for k in params:
+        np.testing.assert_allclose(np.asarray(total[k]), ref[k],
+                                   rtol=1e-6, atol=1e-6)
+    mean = buf.grads(mean=True)
+    np.testing.assert_allclose(np.asarray(mean["w"]), ref["w"] / 8,
+                               rtol=1e-6, atol=1e-7)
+    buf.zero()
+    assert buf.num_accumulated == 0
+    assert float(jnp.abs(buf.buf).sum()) == 0.0
+
+
+def test_grad_accum_feeds_fused_optimizer(rng):
+    """End-to-end: accumulate microbatch grads, step FusedAdam on the mean —
+    matches stepping on the directly-computed mean grad."""
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.optimizers.grad_accum import MainGradBuffer
+
+    params = {"w": jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)}
+    micro = [{"w": jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)}
+             for _ in range(4)]
+    mean_g = {"w": jnp.stack([g["w"] for g in micro]).mean(0)}
+
+    opt_a = FusedAdam(params, lr=1e-2)
+    p_ref = opt_a.step(mean_g)
+
+    opt_b = FusedAdam(params, lr=1e-2)
+    buf = MainGradBuffer(params)
+    for g in micro:
+        buf.accumulate(g)
+    p_acc = opt_b.step(buf.grads())
+    np.testing.assert_allclose(np.asarray(p_acc["w"]), np.asarray(p_ref["w"]),
+                               rtol=1e-6, atol=1e-7)
