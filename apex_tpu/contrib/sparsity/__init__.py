@@ -1,0 +1,21 @@
+"""apex.contrib.sparsity equivalent (ASP 2:4 structured sparsity).
+
+Reference: apex/contrib/sparsity/ — asp.py, sparse_masklib.py,
+permutation_lib.py (+ CUDA permutation_search_kernels, here a jitted
+search). TPUs have no 2:4 sparse math units; this is the accuracy-workflow
+emulation SURVEY.md §7 M10 prescribes.
+"""
+
+from apex_tpu.contrib.sparsity.asp import ASP
+from apex_tpu.contrib.sparsity.sparse_masklib import (
+    create_mask,
+    magnitude_retained,
+    mn_1d_mask,
+)
+from apex_tpu.contrib.sparsity.permutation_lib import (
+    apply_permutation_and_mask,
+    search_permutation,
+)
+
+__all__ = ["ASP", "create_mask", "mn_1d_mask", "magnitude_retained",
+           "search_permutation", "apply_permutation_and_mask"]

@@ -1,0 +1,191 @@
+"""ASP — automatic sparsity (2:4 structured) workflow.
+
+Reference: apex/contrib/sparsity/asp.py:~50-300 — the ASP class:
+``init_model_for_pruning`` walks the model and registers mask buffers for
+prunable weights; ``init_optimizer_for_pruning`` monkey-patches
+``optimizer.step`` so weights (and grads) are re-masked around every step;
+``compute_sparse_masks`` fills the masks (magnitude 2:4, optional channel
+permutation); ``prune_trained_model`` = all three for the
+train → prune → fine-tune recipe.
+
+TPU restatement over parameter PYTREES: masks are a pytree mirroring the
+prunable leaves; the optimizer hook wraps ``FusedOptimizerBase.step`` (any
+object with ``step(grads)``) to mask grads going in and params coming out —
+one fused elementwise multiply each way, jitted. Conv weights are handled
+like linears along their input dim (reference's default whitelist is
+Linear/Conv2d with dims divisible by the pattern size).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity import sparse_masklib
+from apex_tpu.contrib.sparsity.permutation_lib import (
+    apply_permutation_and_mask,
+    search_permutation,
+)
+from apex_tpu.optimizers.common import path_name
+
+
+def _default_prunable(name: str, leaf) -> bool:
+    """Reference whitelist analog: 2d+ weights with both in/out dims
+    divisible by 4 (torch.nn.Linear/Conv weights), skipping embeddings,
+    norms and biases by name."""
+    if leaf.ndim < 2:
+        return False
+    n = name.lower()
+    if any(t in n for t in ("emb", "norm", "bias", "bn")):
+        return False
+    return leaf.shape[-1] % 4 == 0
+
+
+class ASP:
+    """Drop-in for apex.contrib.sparsity.ASP (classmethod API preserved)."""
+
+    __model_params = None          # prunable-leaf predicate results
+    __masks = None                 # pytree: bool mask or None per leaf
+    __pattern = "m4n2_1d"
+    __allow_recompute = False
+    __allow_permutation = False
+    __calculate_verbosity = 0
+    __optimizer = None
+    __orig_step = None
+
+    # -- reference API --------------------------------------------------------
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator: str = "m4n2_1d",
+                               verbosity: int = 3,
+                               whitelist=None,
+                               allowed_layer_names=None,
+                               disallowed_layer_names=(),
+                               allow_recompute_mask: bool = False,
+                               custom_layer_dict=None,
+                               allow_permutation: bool = False,
+                               prunable: Optional[Callable] = None):
+        """Register (all-ones) masks for every prunable leaf of ``params``.
+
+        ``prunable(name, leaf) -> bool`` overrides the default whitelist;
+        ``disallowed_layer_names`` are substrings excluded by name
+        (reference semantics). Returns the mask pytree.
+        """
+        pred = prunable or _default_prunable
+
+        def mk(path, leaf):
+            name = path_name(path)
+            if any(d in name for d in disallowed_layer_names):
+                return None
+            if allowed_layer_names is not None and not any(
+                    a in name for a in allowed_layer_names):
+                return None
+            if not pred(name, leaf):
+                return None
+            return jnp.ones(leaf.shape, jnp.bool_)
+
+        cls.__masks = jax.tree_util.tree_map_with_path(mk, params)
+        cls.__pattern = mask_calculator
+        cls.__allow_recompute = allow_recompute_mask
+        cls.__allow_permutation = allow_permutation
+        cls.__calculate_verbosity = verbosity
+        return cls.__masks
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer):
+        """Wrap ``optimizer.step`` to mask grads in and params out
+        (reference: monkey-patched ``__optimizer_step`` masking weights and
+        grads around the inner step)."""
+        if cls.__optimizer is not None:
+            raise RuntimeError(
+                "ASP.init_optimizer_for_pruning called twice (reference "
+                "raises the same)")
+        cls.__optimizer = optimizer
+        cls.__orig_step = optimizer.step
+
+        def masked_step(grads, *a, **kw):
+            grads = cls.apply_masks(grads)
+            params = cls.__orig_step(grads, *a, **kw)
+            return cls.apply_masks(params)
+
+        optimizer.step = masked_step
+        return optimizer
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        """Fill the registered masks from current magnitudes; returns
+        (masks, masked_params)."""
+        if cls.__masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+
+        def calc(mask, leaf):
+            if mask is None:
+                return None
+            flat2d = leaf.reshape(-1, leaf.shape[-1])
+            if cls.__allow_permutation:
+                perm, _ = search_permutation(jnp.abs(flat2d))
+                m = apply_permutation_and_mask(flat2d, perm)
+            else:
+                m = sparse_masklib.create_mask(flat2d, cls.__pattern)
+            return m.reshape(leaf.shape)
+
+        cls.__masks = jax.tree.map(calc, cls.__masks, params,
+                                   is_leaf=lambda x: x is None)
+        return cls.__masks, cls.apply_masks(params)
+
+    @classmethod
+    def prune_trained_model(cls, params, optimizer):
+        """The one-call recipe (reference: prune_trained_model)."""
+        cls.init_model_for_pruning(params)
+        cls.init_optimizer_for_pruning(optimizer)
+        _, masked = cls.compute_sparse_masks(params)
+        return masked, optimizer
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return cls.__masks is not None
+
+    @classmethod
+    def restore_pruned_weights(cls, params):
+        """Reference: restore_pruned_weights — drop masks (weights were
+        never destroyed here: masking is applied functionally)."""
+        cls.reset()
+        return params
+
+    # -- helpers --------------------------------------------------------------
+    @classmethod
+    def masks(cls):
+        return cls.__masks
+
+    @classmethod
+    def apply_masks(cls, tree):
+        """Elementwise mask of a param/grad pytree (None-masked leaves pass
+        through untouched)."""
+
+        def mul(mask, leaf):
+            if mask is None:
+                return leaf
+            return leaf * mask.astype(leaf.dtype)
+
+        return jax.tree.map(mul, cls.__masks, tree,
+                            is_leaf=lambda x: x is None)
+
+    @classmethod
+    def state_dict(cls):
+        """Mask buffers are checkpointable (reference saves them as
+        registered buffers)."""
+        return {"masks": cls.__masks, "pattern": cls.__pattern}
+
+    @classmethod
+    def load_state_dict(cls, sd):
+        cls.__masks = sd["masks"]
+        cls.__pattern = sd.get("pattern", "m4n2_1d")
+
+    @classmethod
+    def reset(cls):
+        if cls.__optimizer is not None and cls.__orig_step is not None:
+            cls.__optimizer.step = cls.__orig_step
+        cls.__masks = None
+        cls.__optimizer = None
+        cls.__orig_step = None

@@ -1,0 +1,112 @@
+"""Channel-permutation search for 2:4 sparsity.
+
+Reference: apex/contrib/sparsity/permutation_lib.py +
+permutation_search_kernels/ (CUDA kernels scoring channel permutations) —
+permuting a weight's INPUT channels before masking can keep more magnitude
+under the 2:4 constraint (the permutation is then folded into the previous
+layer, so the network function is unchanged).
+
+TPU restatement: a jitted greedy pair-swap search. Each sweep evaluates ALL
+O(C^2) adjacent-group column swaps in parallel (the objective is separable
+over groups of 4 columns, so a swap's delta only touches two groups —
+vectorized as a [C, C] delta matrix built from per-group retained-magnitude
+tables, matmul-heavy and MXU-friendly), applies the best swap, and repeats
+for a fixed number of sweeps under ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.sparsity.sparse_masklib import mn_1d_mask
+
+
+def _retained_per_group(w_abs: jax.Array) -> jax.Array:
+    """Sum of the top-2 |values| of each group of 4 columns: [rows, C/4] ->
+    summed over rows -> [C/4]."""
+    g = w_abs.reshape(w_abs.shape[0], -1, 4)
+    top2 = jnp.sort(g, axis=-1)[..., 2:]
+    return top2.sum(axis=(0, 2))
+
+
+def _score(w_abs: jax.Array) -> jax.Array:
+    return _retained_per_group(w_abs).sum()
+
+
+@functools.partial(jax.jit, static_argnames=("max_swaps",))
+def search_permutation(w: jax.Array, max_swaps: int = 64):
+    """Greedy column-swap search maximizing 2:4 retained magnitude.
+
+    ``w``: (rows, C) with C % 4 == 0. Returns (perm [C], score) such that
+    ``w[:, perm]`` retains at least as much magnitude as ``w`` under the
+    m4n2_1d mask (monotone improvement; stops early when no swap helps).
+    """
+    rows, c = w.shape
+    w_abs0 = jnp.abs(w)
+
+    def swap_delta_matrix(w_abs):
+        """delta[i, j] = score gain from swapping columns i and j."""
+        base = _retained_per_group(w_abs)  # [G]
+        gid = jnp.arange(c) // 4
+
+        # candidate score of group g with column slot s replaced by column j:
+        # build for all (slot, j) pairs — [C, C] table where entry (i, j) is
+        # the retained sum of i's group after i <- j's values
+        def group_with_replacement(i, j):
+            g = gid[i]
+            cols = lax.dynamic_slice_in_dim(w_abs, g * 4, 4, axis=1)
+            slot = i % 4
+            cols = lax.dynamic_update_slice_in_dim(
+                cols, w_abs[:, j][:, None], slot, axis=1)
+            top2 = jnp.sort(cols, axis=-1)[..., 2:]
+            return top2.sum()
+
+        idx = jnp.arange(c)
+        repl = jax.vmap(lambda i: jax.vmap(
+            lambda j: group_with_replacement(i, j))(idx))(idx)  # [C, C]
+        same_group = gid[:, None] == gid[None, :]
+        delta = (repl + repl.T
+                 - base[gid][:, None] - base[gid][None, :])
+        return jnp.where(same_group, -jnp.inf, delta)
+
+    def cond(state):
+        _, _, improved, it = state
+        return improved & (it < max_swaps)
+
+    def body(state):
+        perm, w_abs, _, it = state
+        delta = swap_delta_matrix(w_abs)
+        flat = jnp.argmax(delta)
+        i, j = flat // c, flat % c
+        gain = delta[i, j]
+        do = gain > 1e-7
+
+        def apply_swap(args):
+            perm, w_abs = args
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+            ci, cj = w_abs[:, i], w_abs[:, j]
+            w_abs = w_abs.at[:, i].set(cj).at[:, j].set(ci)
+            return perm, w_abs
+
+        perm, w_abs = lax.cond(do, apply_swap, lambda a: a, (perm, w_abs))
+        return perm, w_abs, do, it + 1
+
+    perm0 = jnp.arange(c)
+    perm, w_abs, _, _ = lax.while_loop(
+        cond, body, (perm0, w_abs0, jnp.bool_(True), jnp.int32(0)))
+    return perm, _score(w_abs)
+
+
+def apply_permutation_and_mask(w: jax.Array, perm: jax.Array):
+    """Permute input channels, mask 2:4, un-permute — the network-function-
+    preserving use (the reference folds the permutation into the upstream
+    layer instead; un-permuting keeps this a drop-in weight transform)."""
+    wp = w[:, perm]
+    mask_p = mn_1d_mask(wp)
+    inv = jnp.argsort(perm)
+    return mask_p[:, inv]

@@ -1,0 +1,66 @@
+"""2:4 (n:m) structured-sparsity mask computation.
+
+Reference: apex/contrib/sparsity/sparse_masklib.py — ``create_mask(tensor,
+pattern)`` with patterns like "m4n2_1d" (keep the 2 largest magnitudes of
+every 4 consecutive elements along the input dim) and "m4n2_2d_best".
+
+TPU note (SURVEY.md §7 M10): TPUs have no 2:4 sparse math units, so masks
+are an accuracy-workflow emulation — the masked weights train/evaluate
+exactly like on GPU, but there is no 2x math speedup to harvest. The mask
+math itself is vectorized jnp (sort-free top-k by pairwise comparison) and
+jit-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_1d_groups(flat: jax.Array, m: int, n: int) -> jax.Array:
+    """Keep the ``n`` largest |values| of every ``m`` consecutive elements.
+
+    flat: (..., k*m) -> bool mask, same shape. Ties break toward the
+    earlier element (stable argsort), matching the reference's topk.
+    """
+    groups = flat.reshape(*flat.shape[:-1], -1, m)
+    mag = jnp.abs(groups)
+    # rank[i] = how many elements strictly beat element i (ties: earlier
+    # index wins) — rank < n <=> kept
+    gt = (mag[..., None, :] > mag[..., :, None])
+    eq = (mag[..., None, :] == mag[..., :, None])
+    idx = jnp.arange(m)
+    earlier = idx[None, :] < idx[:, None]
+    rank = (gt | (eq & earlier)).sum(-1)
+    keep = rank < n
+    return keep.reshape(flat.shape)
+
+
+def mn_1d_mask(t: jax.Array, m: int = 4, n: int = 2) -> jax.Array:
+    """Pattern "m4n2_1d": groups along the LAST dim (the input/contraction
+    dim of a torch-layout (out, in) weight)."""
+    if t.shape[-1] % m != 0:
+        raise ValueError(
+            f"last dim {t.shape[-1]} not divisible by m={m} "
+            "(reference: tensors must be padded or excluded)")
+    return _mask_1d_groups(t, m, n)
+
+
+def create_mask(t: jax.Array, pattern: str = "m4n2_1d") -> jax.Array:
+    """bool mask with ``pattern`` sparsity (reference: create_mask).
+
+    Supported: "m4n2_1d" (the reference default for linears — its 2d
+    patterns exist only to feed the GPU sparse-MMA layout, which has no TPU
+    analog; SURVEY.md §7 M10 scopes ASP as accuracy-workflow emulation).
+    """
+    if pattern in ("m4n2_1d", "m4n2_1d_best"):
+        return mn_1d_mask(t, 4, 2)
+    raise ValueError(f"unsupported sparsity pattern {pattern!r} "
+                     "(supported: m4n2_1d)")
+
+
+def magnitude_retained(t: jax.Array, mask: jax.Array) -> jax.Array:
+    """Fraction of total |weight| magnitude the mask keeps (the permutation
+    search's objective)."""
+    a = jnp.abs(t)
+    return jnp.sum(a * mask) / jnp.maximum(jnp.sum(a), 1e-30)

@@ -1,0 +1,129 @@
+"""ASP 2:4 sparsity (BASELINE config #5; reference:
+apex/contrib/test/sparsity/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_asp():
+    yield
+    from apex_tpu.contrib.sparsity import ASP
+
+    ASP.reset()
+
+
+def _check_2_4(mask: np.ndarray):
+    g = mask.reshape(-1, 4)
+    np.testing.assert_array_equal(g.sum(-1), 2 * np.ones(g.shape[0]))
+
+
+def test_create_mask_is_2_4_and_keeps_top2(rng):
+    from apex_tpu.contrib.sparsity import create_mask
+
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    m = np.asarray(create_mask(w))
+    _check_2_4(m)
+    # kept entries are the 2 largest |values| of each group
+    wg = np.abs(np.asarray(w)).reshape(-1, 4)
+    mg = m.reshape(-1, 4)
+    for row_w, row_m in zip(wg, mg):
+        kept = np.sort(row_w[row_m])
+        dropped = row_w[~row_m]
+        assert kept.min() >= dropped.max() - 1e-7
+
+
+def test_masks_on_bert_param_tree():
+    """Masks verified 2:4 on a BERT param tree (VERDICT done-criterion)."""
+    from apex_tpu.contrib.sparsity import ASP
+    from apex_tpu.models import BertForPreTraining, bert_tiny_config
+
+    cfg = bert_tiny_config()
+    model = BertForPreTraining(cfg)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    ASP.init_model_for_pruning(params)
+    masks, masked = ASP.compute_sparse_masks(params)
+
+    n_pruned = 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(masks,
+                                                   is_leaf=lambda x: x is None)
+    for path, mask in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if mask is None:
+            continue
+        n_pruned += 1
+        _check_2_4(np.asarray(mask).reshape(-1, 4))
+        assert "emb" not in name.lower() and "norm" not in name.lower()
+    assert n_pruned >= 2 * cfg.num_layers  # at least qkv/out/mlp weights
+    # masked params actually zeroed
+    mw = np.asarray(masked["layer_0"]["attention"]["qkv_weight"])
+    assert (np.count_nonzero(mw.reshape(-1, 4), axis=1) <= 2).all()
+
+
+def test_masked_finetune_smoke(rng):
+    """prune_trained_model: optimizer hook keeps weights 2:4 through
+    fine-tune steps and the loss still decreases."""
+    from apex_tpu.contrib.sparsity import ASP
+    from apex_tpu.optimizers import FusedAdam
+
+    w_true = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)
+    y = x @ w_true.T
+
+    params = {"dense_weight": jnp.asarray(
+        rng.standard_normal((16, 16)) * 0.1, jnp.float32)}
+    opt = FusedAdam(params, lr=5e-2)
+    params, opt = ASP.prune_trained_model(params, opt)
+    _check_2_4(np.asarray(ASP.masks()["dense_weight"]).reshape(-1, 4))
+
+    def loss_fn(p):
+        return jnp.mean((x @ p["dense_weight"].T - y) ** 2)
+
+    losses = []
+    for _ in range(12):
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        params = opt.step(g)
+        losses.append(float(loss))
+        # sparsity enforced after every step
+        nz = np.count_nonzero(
+            np.asarray(params["dense_weight"]).reshape(-1, 4), axis=1)
+        assert (nz <= 2).all()
+    assert losses[-1] < losses[0]
+
+
+def test_permutation_search_improves_retention(rng):
+    from apex_tpu.contrib.sparsity import (apply_permutation_and_mask,
+                                           magnitude_retained, mn_1d_mask,
+                                           search_permutation)
+
+    # adversarial layout: large magnitudes clustered so plain 2:4 drops them
+    w = np.abs(rng.standard_normal((8, 16))).astype(np.float32) * 0.1
+    w[:, 0:4] *= 100.0   # one group holds all the big values
+    w = jnp.asarray(w)
+
+    base = float(magnitude_retained(w, mn_1d_mask(w)))
+    perm, _ = search_permutation(jnp.abs(w))
+    mask_p = apply_permutation_and_mask(w, perm)
+    after = float(magnitude_retained(w, mask_p))
+    # the returned mask is in ORIGINAL column order; 2:4 holds under the
+    # permuted grouping (the reference folds the permutation upstream)
+    _check_2_4(np.asarray(mask_p[:, np.asarray(perm)]).reshape(-1, 4))
+    assert after >= base - 1e-6
+    assert after > base + 0.01  # the adversarial case must actually improve
+
+
+def test_asp_state_dict_roundtrip(rng):
+    from apex_tpu.contrib.sparsity import ASP
+
+    params = {"w": jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)}
+    ASP.init_model_for_pruning(params)
+    ASP.compute_sparse_masks(params)
+    sd = ASP.state_dict()
+    ASP.reset()
+    ASP.load_state_dict(sd)
+    assert ASP.is_sparsity_enabled()
+    _check_2_4(np.asarray(ASP.masks()["w"]).reshape(-1, 4))
