@@ -1,0 +1,16 @@
+"""apex_tpu.utils — profiling + timing + checkpoint subsystems.
+
+SURVEY.md §5 marks tracing/profiling and mesh-aware checkpointing as
+"gaps to exceed" over the reference (which removed apex.pyprof and delegates
+checkpointing to torch.save in examples).
+"""
+
+from apex_tpu.utils.profiling import annotate, time_fn, trace
+from apex_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = ["annotate", "time_fn", "trace", "save_checkpoint",
+           "restore_checkpoint", "CheckpointManager"]
