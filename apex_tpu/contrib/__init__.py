@@ -1,7 +1,11 @@
 """apex_tpu.contrib — rebuild of apex.contrib (SURVEY.md §2.3).
 
-Subpackages import lazily:
-  multihead_attn, xentropy, clip_grad, optimizers (distributed/ZeRO),
-  sparsity (ASP), layer_norm, fmha, group_norm, focal_loss, index_mul_2d,
-  transducer.
+Subpackages (import explicitly, as with the reference's optional builds):
+  multihead_attn, xentropy, clip_grad, fmha,
+  optimizers (DistributedFusedAdam/LAMB — ZeRO),
+  sparsity (ASP 2:4), layer_norm (FastLayerNorm shim),
+  group_norm (NHWC GroupNorm+SiLU), groupbn (BatchNorm2d_NHWC),
+  focal_loss, index_mul_2d, transducer (RNN-T joint/loss),
+  peer_memory (1-D halo exchange over ppermute),
+  conv_bias_relu (XLA-fused conv epilogues).
 """

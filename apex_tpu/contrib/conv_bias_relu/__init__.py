@@ -1,0 +1,11 @@
+"""apex.contrib.conv_bias_relu equivalent."""
+
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)
+
+__all__ = ["ConvBias", "ConvBiasReLU", "ConvBiasMaskReLU",
+           "ConvFrozenScaleBiasReLU"]

@@ -1,0 +1,48 @@
+"""Fused Conv+Bias(+Mask)(+ReLU) functions.
+
+Reference: apex/contrib/conv_bias_relu/conv_bias_relu.py over a
+cudnn-frontend fused-op extension: ConvBias, ConvBiasReLU, ConvBiasMaskReLU,
+ConvFrozenScaleBiasReLU — NHWC convs with fused epilogues. On TPU, XLA
+fuses conv+bias+relu from the naive expression (the epilogue fusion IS the
+compiler's job here); these functions pin the NHWC layout and the
+reference's call signatures. All are differentiable (autodiff backward ==
+the reference's dgrad/wgrad/dbias fused kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _conv_nhwc(x, w, stride: int, padding: int):
+    """NHWC conv with HWIO weights, symmetric padding."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def ConvBias(x, weight, bias, padding: int = 0, stride: int = 1):
+    """conv + bias (reference: ConvBias_.apply)."""
+    return _conv_nhwc(x, weight, stride, padding) + bias
+
+
+def ConvBiasReLU(x, weight, bias, padding: int = 0, stride: int = 1):
+    """conv + bias + relu (reference: ConvBiasReLU_.apply)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding: int = 0,
+                     stride: int = 1):
+    """conv + bias + elementwise mask + relu (reference: ConvBiasMaskReLU_)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride) * mask)
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, padding: int = 0,
+                            stride: int = 1):
+    """conv, then frozen-BN-style scale*y + bias, then relu
+    (reference: ConvFrozenScaleBiasReLU_)."""
+    y = _conv_nhwc(x, weight, stride, padding)
+    return jax.nn.relu(y * scale + bias)

@@ -1,0 +1,11 @@
+"""apex.contrib.fmha equivalent.
+
+Reference: apex/contrib/fmha/fmha.py (``FMHAFun`` over ``fmhalib`` — fixed
+seqlen<=512 fp16 fused attention for MLPerf BERT, varlen via cu_seqlens).
+Subsumed by the Pallas flash-attention kernel (no seqlen cap, varlen via
+segment ids); this shim keeps the reference call surface.
+"""
+
+from apex_tpu.contrib.fmha.fmha import FMHAFun, fmha
+
+__all__ = ["FMHAFun", "fmha"]

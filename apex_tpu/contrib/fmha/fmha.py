@@ -1,0 +1,52 @@
+"""FMHA shim over the flash-attention kernel.
+
+Reference: apex/contrib/fmha/fmha.py — ``FMHAFun(qkv, cu_seqlens, ...)``
+takes PACKED varlen input: ``qkv`` [total_tokens, 3, H, D] with
+``cu_seqlens`` [B+1] prefix offsets. The Pallas flash kernel takes dense
+[B, H, S, D] with segment ids, so this shim unpacks cu_seqlens into a
+padded batch + segment mask, runs the kernel, and repacks — same contract,
+no 512-seqlen cap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flash_attention
+
+
+def fmha(qkv, cu_seqlens, max_s: int, *, is_training: bool = True,
+         dropout_rate: float = 0.0, dropout_seed: int = 0):
+    """Packed-varlen fused MHA. Returns [total_tokens, H, D]."""
+    total, three, h, d = qkv.shape
+    assert three == 3, qkv.shape
+    b = cu_seqlens.shape[0] - 1
+
+    # scatter packed tokens into a padded [B, max_s] layout
+    seq_of_token = jnp.searchsorted(cu_seqlens[1:], jnp.arange(total),
+                                    side="right")
+    pos_in_seq = jnp.arange(total) - cu_seqlens[seq_of_token]
+    padded = jnp.zeros((b, max_s, 3, h, d), qkv.dtype)
+    padded = padded.at[seq_of_token, pos_in_seq].set(qkv)
+
+    lens = cu_seqlens[1:] - cu_seqlens[:-1]                     # [B]
+    valid = jnp.arange(max_s)[None, :] < lens[:, None]          # [B, max_s]
+    segment_ids = jnp.where(valid, 1, 0).astype(jnp.int32)
+
+    q, k, v = (padded[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+    rate = dropout_rate if is_training else 0.0
+    ctx = flash_attention(q, k, v, segment_ids=segment_ids,
+                          dropout_rate=rate, dropout_seed=dropout_seed)
+    ctx = ctx.transpose(0, 2, 1, 3)                             # [B, S, H, D]
+    return ctx[seq_of_token, pos_in_seq]                        # repack
+
+
+class FMHAFun:
+    """Callable facade matching the reference's autograd-Function name."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, p_dropout, max_s, is_training,
+              zero_tensors=False):
+        return fmha(qkv, cu_seqlens, max_s, is_training=is_training,
+                    dropout_rate=p_dropout)

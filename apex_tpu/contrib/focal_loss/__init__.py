@@ -1,0 +1,8 @@
+"""apex.contrib.focal_loss equivalent."""
+
+from apex_tpu.contrib.focal_loss.focal_loss import (
+    focal_loss,
+    FocalLoss,
+)
+
+__all__ = ["focal_loss", "FocalLoss"]

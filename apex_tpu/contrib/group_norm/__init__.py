@@ -1,0 +1,5 @@
+"""apex.contrib.group_norm equivalent."""
+
+from apex_tpu.contrib.group_norm.group_norm import GroupNorm
+
+__all__ = ["GroupNorm"]

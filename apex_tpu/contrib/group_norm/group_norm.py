@@ -1,0 +1,46 @@
+"""GroupNorm module — drop-in for apex.contrib.group_norm.GroupNorm.
+
+Reference: apex/contrib/group_norm/group_norm.py — a torch.nn.GroupNorm
+drop-in over the NHWC CUDA kernels (apex/contrib/csrc/group_norm/), with
+``act="silu"`` fusing the activation (diffusion workloads). Input here is
+NHWC (the TPU-native layout; the reference's whole point was avoiding
+torch's NCHW default).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.group_norm import group_norm_nhwc
+
+
+class GroupNorm(nn.Module):
+    """``GroupNorm(num_groups, num_channels, eps, affine, act)``."""
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: Optional[str] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.shape[-1] != self.num_channels:
+            raise ValueError(
+                f"input channels {x.shape[-1]} != num_channels "
+                f"{self.num_channels} (NHWC expected)")
+        if self.affine:
+            w = self.param("weight", nn.initializers.ones,
+                           (self.num_channels,), self.param_dtype)
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.num_channels,), self.param_dtype)
+        else:
+            w = b = None
+        return group_norm_nhwc(x, w, b, self.num_groups, self.eps,
+                               self.act)
+
+    forward = __call__

@@ -1,0 +1,5 @@
+"""apex.contrib.groupbn equivalent (NHWC BatchNorm with fused add+ReLU)."""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC
+
+__all__ = ["BatchNorm2d_NHWC"]

@@ -1,0 +1,52 @@
+"""BatchNorm2d_NHWC — NHWC BN with fused residual-add + ReLU.
+
+Reference: apex/contrib/groupbn/batch_norm.py over the ``bnp`` extension
+(apex/contrib/csrc/groupbn/batch_norm.cu, batch_norm_add_relu.cu, ipc.cu —
+NHWC BN with fused add+ReLU and intra-node cudaIpc peer reduction for
+group BN). TPU restatement (SURVEY.md §2.2): the stats reduction is
+SyncBatchNorm's psum (the ``bn_group`` arg maps to a mesh axis), and the
+add+ReLU epilogue is expressed inline for XLA to fuse — the CUDA file's
+whole purpose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.mesh import DATA_AXIS
+from apex_tpu.parallel import SyncBatchNorm
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """Drop-in for apex.contrib.groupbn.BatchNorm2d_NHWC.
+
+    ``fuse_relu`` applies ReLU after the norm; call with ``z=`` to fuse the
+    residual add (reference: batch_norm_add_relu). ``bn_group`` > 1 syncs
+    stats over ``axis_name`` (the cudaIpc group analog).
+    """
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[Any] = DATA_AXIS
+    eps: float = 1e-5
+    momentum: float = 0.1
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: bool = False):
+        bn = SyncBatchNorm(
+            num_features=self.num_features, eps=self.eps,
+            momentum=self.momentum,
+            axis_name=self.axis_name if self.bn_group > 1 else None,
+            name="bn")
+        y = bn(x, use_running_average=use_running_average)
+        if z is not None:
+            y = y + z.astype(y.dtype)
+        if self.fuse_relu:
+            y = nn.relu(y)
+        return y
+
+    forward = __call__

@@ -1,0 +1,5 @@
+"""apex.contrib.index_mul_2d equivalent."""
+
+from apex_tpu.contrib.index_mul_2d.index_mul_2d import index_mul_2d
+
+__all__ = ["index_mul_2d"]

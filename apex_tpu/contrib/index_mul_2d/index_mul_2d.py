@@ -1,0 +1,18 @@
+"""index_mul_2d — fused gather-multiply(-scatter).
+
+Reference: apex/contrib/csrc/index_mul_2d/index_mul_2d_cuda.cu (~350 LoC) +
+apex/contrib/index_mul_2d/index_mul_2d.py: ``out[i] = in1[i] * in2[idx[i]]``
+for 2d tensors, fwd+bwd fused (fp16/fp32), used by OpenFold. On TPU the
+gather and the multiply fuse in XLA from the jnp expression; autodiff emits
+the same scatter-add backward the CUDA bwd hand-writes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1, in2, idx1):
+    """``in1[i, :] * in2[idx1[i], :]`` — the reference's signature
+    ``index_mul_2d(in1, in2, idx1)`` (in1 pre-gathered, in2 indexed)."""
+    return in1 * jnp.take(in2, idx1, axis=0)

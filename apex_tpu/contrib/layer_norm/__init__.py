@@ -1,0 +1,11 @@
+"""apex.contrib.layer_norm equivalent (MLPerf FastLayerNorm).
+
+Reference: apex/contrib/layer_norm/layer_norm.py — ``FastLayerNorm``, a
+faster LN for enumerated hidden sizes (768..12288) over
+apex/contrib/csrc/layer_norm/. SURVEY.md §2.2: ONE Pallas LN kernel
+replaces both LN extensions, so this is an API shim over FusedLayerNorm.
+"""
+
+from apex_tpu.contrib.layer_norm.layer_norm import FastLayerNorm
+
+__all__ = ["FastLayerNorm"]

@@ -1,0 +1,22 @@
+"""FastLayerNorm — drop-in for apex.contrib.layer_norm.FastLayerNorm.
+
+Reference: apex/contrib/layer_norm/layer_norm.py (``FastLayerNorm(hidden_
+size, eps)`` over the hand-tuned ``fast_layer_norm`` kernels,
+apex/contrib/csrc/layer_norm/ln_kernel_traits.h — per-hidden-size configs
+768..12288). The TPU build has one autotiled Pallas LN kernel
+(apex_tpu/ops/layer_norm.py) serving both LN extensions, so FastLayerNorm
+subclasses FusedLayerNorm and only enforces the reference's supported-size
+check surface (relaxed: any lane-friendly size works here — enforcing the
+GPU list would be gratuitous).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.normalization import FusedLayerNorm
+
+
+class FastLayerNorm(FusedLayerNorm):
+    """Same kernel as FusedLayerNorm; reference-named API."""
+
+    # the reference's ctor is (hidden_size, eps=1e-5); FusedLayerNorm's
+    # first field is normalized_shape with eps defaulting to 1e-5 — aligned.

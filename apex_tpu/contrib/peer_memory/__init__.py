@@ -1,0 +1,10 @@
+"""apex.contrib.peer_memory equivalent (halo exchange for spatial
+parallelism)."""
+
+from apex_tpu.contrib.peer_memory.peer_halo_exchanger_1d import (
+    PeerHaloExchanger1d,
+    halo_exchange_1d,
+)
+from apex_tpu.contrib.peer_memory.peer_memory import PeerMemoryPool
+
+__all__ = ["PeerHaloExchanger1d", "halo_exchange_1d", "PeerMemoryPool"]

@@ -1,0 +1,52 @@
+"""1-D halo exchange over a mesh axis.
+
+Reference: apex/contrib/peer_memory/peer_halo_exchanger_1d.py —
+``PeerHaloExchanger1d.__call__(y, half_halo)``: each rank holds a spatial
+slab of an NHWC activation split along H; it sends its top/bottom
+``half_halo`` rows to its neighbors via cudaIpc peer memory (or the
+nccl_p2p ring fallback) so convolutions see valid halos.
+
+TPU restatement: two ``ppermute`` shifts on the mesh axis (one up, one
+down) — XLA collective-permute over ICI neighbor links, which is exactly
+the physical transfer the cudaIpc path hand-built. Boundary ranks receive
+zeros (the reference leaves the padded border, zero-filled by the caller).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu import collectives as coll
+from apex_tpu.mesh import CONTEXT_AXIS
+
+
+def halo_exchange_1d(y, half_halo: int, axis_name: str = CONTEXT_AXIS,
+                     spatial_dim: int = 1):
+    """Concatenate neighbors' boundary rows around this rank's slab.
+
+    ``y``: local [..., H_local, ...] slab (``spatial_dim`` indexes H).
+    Returns the slab extended to H_local + 2*half_halo. Must run inside
+    shard_map with ``axis_name`` bound.
+    """
+    top = jnp.take(y, jnp.arange(half_halo), axis=spatial_dim)
+    h = y.shape[spatial_dim]
+    bottom = jnp.take(y, jnp.arange(h - half_halo, h), axis=spatial_dim)
+    # my bottom rows -> next rank's top halo; my top rows -> prev's bottom
+    from_prev = coll.shift_right(bottom, axis_name)   # recv prev's bottom
+    from_next = coll.shift_left(top, axis_name)       # recv next's top
+    return jnp.concatenate([from_prev, y, from_next], axis=spatial_dim)
+
+
+class PeerHaloExchanger1d:
+    """Drop-in for apex.contrib.peer_memory.PeerHaloExchanger1d."""
+
+    def __init__(self, ranks=None, rank_in_group=None, peer_pool=None,
+                 half_halo: int = 1, axis_name: str = CONTEXT_AXIS):
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+
+    def __call__(self, y, H_split: bool = True, explicit_nhwc: bool = True,
+                 numSM: int = 0, diagnostics: bool = False):
+        dim = 1 if H_split else 2
+        return halo_exchange_1d(y, self.half_halo, self.axis_name,
+                                spatial_dim=dim)

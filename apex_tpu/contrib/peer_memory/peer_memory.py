@@ -1,0 +1,29 @@
+"""PeerMemoryPool facade.
+
+Reference: apex/contrib/peer_memory/peer_memory.py — a cudaIpc-backed pool
+of peer-addressable buffers that the halo exchangers write through. XLA
+owns all device memory on TPU (SURVEY.md §2.2: "N/A on TPU — XLA owns
+buffers"), so the pool is a documented no-op facade kept so reference code
+that constructs one keeps running; the actual halo traffic is ppermute
+(see peer_halo_exchanger_1d.py).
+"""
+
+from __future__ import annotations
+
+
+class PeerMemoryPool:
+    """API placeholder: allocations are XLA's job on TPU."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks=None):
+        self.peer_ranks = peer_ranks
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last: bool,
+                              requires_grad: bool):
+        raise NotImplementedError(
+            "PeerMemoryPool.allocate_peer_tensors has no TPU analog — XLA "
+            "owns device buffers; use PeerHaloExchanger1d/halo_exchange_1d "
+            "(ppermute) directly")
+
+    def reset(self):
+        pass

@@ -1,0 +1,9 @@
+"""apex.contrib.transducer equivalent (RNN-T joint + loss)."""
+
+from apex_tpu.contrib.transducer.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_loss,
+)
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_loss"]

@@ -1,0 +1,275 @@
+"""Contrib long tail vs pure-jnp/torch-style references (VERDICT round-1
+item 10): GroupNorm NHWC, transducer loss, FastLayerNorm shim, focal loss,
+index_mul_2d, halo exchange, groupbn, conv_bias_relu, fmha varlen shim.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import CONTEXT_AXIS
+
+
+# ---------------------------------------------------------------- group_norm
+@pytest.mark.parametrize("act", [None, "silu"])
+@pytest.mark.parametrize("shape,groups", [
+    ((2, 4, 4, 256), 2),     # kernel path (cg=128)
+    ((2, 3, 5, 24), 4),      # fallback path (cg=6)
+])
+def test_group_norm_matches_reference(rng, act, shape, groups):
+    from apex_tpu.ops.group_norm import group_norm_nhwc, group_norm_reference
+
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    c = shape[-1]
+    w = jnp.asarray(rng.standard_normal((c,)) * 0.1 + 1.0, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((c,)) * 0.1, jnp.float32)
+
+    y = group_norm_nhwc(x, w, b, groups, 1e-5, act)
+    y_ref = group_norm_reference(x, w, b, groups, 1e-5, act)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # grads vs autodiff of the reference formulation
+    def loss_k(x, w, b):
+        return jnp.sum(group_norm_nhwc(x, w, b, groups, 1e-5, act) ** 2)
+
+    def loss_r(x, w, b):
+        return jnp.sum(group_norm_reference(x, w, b, groups, 1e-5, act) ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+    for a_, b_ in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_group_norm_module(rng):
+    from apex_tpu.contrib.group_norm import GroupNorm
+
+    x = jnp.asarray(rng.standard_normal((2, 4, 4, 32)), jnp.float32)
+    gn = GroupNorm(num_groups=4, num_channels=32, act="silu")
+    p = gn.init(jax.random.PRNGKey(0), x)
+    y = gn.apply(p, x)
+    assert y.shape == x.shape
+
+
+# ---------------------------------------------------------------- transducer
+def _transducer_loss_ref(log_probs, labels, T, U, blank=0):
+    """O(T*U) literal DP in numpy (the textbook RNN-T forward recursion)."""
+    lp = np.asarray(log_probs, np.float64)
+    alpha = np.full((T, U + 1), -np.inf)
+    alpha[0, 0] = 0.0
+    for t in range(T):
+        for u in range(U + 1):
+            cands = []
+            if t > 0:
+                cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+            if u > 0:
+                cands.append(alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]])
+            if cands:
+                alpha[t, u] = np.logaddexp.reduce(cands)
+    return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+
+def test_transducer_loss_matches_dp(rng):
+    from apex_tpu.contrib.transducer import transducer_loss
+
+    b, t, u, v = 3, 6, 4, 8
+    logits = rng.standard_normal((b, t, u + 1, v)).astype(np.float32)
+    log_probs = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    labels = rng.integers(1, v, (b, u)).astype(np.int32)
+    f_len = np.array([6, 5, 4], np.int32)
+    y_len = np.array([4, 3, 2], np.int32)
+
+    out = transducer_loss(log_probs, jnp.asarray(labels),
+                          jnp.asarray(f_len), jnp.asarray(y_len))
+    for i in range(b):
+        ref = _transducer_loss_ref(np.asarray(log_probs)[i], labels[i],
+                                   int(f_len[i]), int(y_len[i]))
+        np.testing.assert_allclose(float(out[i]), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_transducer_loss_differentiable(rng):
+    from apex_tpu.contrib.transducer import TransducerLoss
+
+    b, t, u, v = 2, 5, 3, 6
+    x = jnp.asarray(rng.standard_normal((b, t, u + 1, v)), jnp.float32)
+    labels = jnp.asarray(rng.integers(1, v, (b, u)), jnp.int32)
+    f_len = jnp.asarray([t, t - 1], jnp.int32)
+    y_len = jnp.asarray([u, u - 1], jnp.int32)
+    crit = TransducerLoss()
+
+    g = jax.grad(lambda x: crit(x, labels, f_len, y_len).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    # grad wrt a position beyond every valid (t,u) diagonal must be zero
+    assert float(jnp.abs(g[1, t - 1, u, :]).sum()) == 0.0
+
+
+def test_transducer_joint(rng):
+    from apex_tpu.contrib.transducer import TransducerJoint
+
+    f = jnp.asarray(rng.standard_normal((2, 5, 8)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((2, 3, 8)), jnp.float32)
+    out = TransducerJoint(relu=True)(f, g)
+    assert out.shape == (2, 5, 3, 8)
+    ref = jax.nn.relu(f[:, :, None, :] + g[:, None, :, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+
+# ---------------------------------------------------------------- layer_norm
+def test_fast_layer_norm_shim(rng):
+    from apex_tpu.contrib.layer_norm import FastLayerNorm
+    from apex_tpu.normalization import FusedLayerNorm
+
+    x = jnp.asarray(rng.standard_normal((4, 768)), jnp.float32)
+    fast = FastLayerNorm(768)
+    fused = FusedLayerNorm(768)
+    p = fast.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_array_equal(np.asarray(fast.apply(p, x)),
+                                  np.asarray(fused.apply(p, x)))
+
+
+# ---------------------------------------------------------------- focal loss
+def test_focal_loss_matches_reference(rng):
+    from apex_tpu.contrib.focal_loss import focal_loss
+
+    n, c = 64, 8
+    logits = jnp.asarray(rng.standard_normal((n, c)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, c + 1, (n,)), jnp.int32)
+
+    out = focal_loss(logits, targets, c, alpha=0.25, gamma=2.0)
+
+    # literal numpy reference
+    x = np.asarray(logits, np.float64)
+    t = np.zeros((n, c))
+    for i, ti in enumerate(np.asarray(targets)):
+        if ti > 0:
+            t[i, ti - 1] = 1.0
+    p = 1 / (1 + np.exp(-x))
+    bce = np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))
+    pt = p * t + (1 - p) * (1 - t)
+    at = 0.25 * t + 0.75 * (1 - t)
+    ref = (at * (1 - pt) ** 2.0 * bce).sum()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+    # differentiable
+    g = jax.grad(lambda l: focal_loss(l, targets, c))(logits)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# -------------------------------------------------------------- index_mul_2d
+def test_index_mul_2d(rng):
+    from apex_tpu.contrib.index_mul_2d import index_mul_2d
+
+    in1 = jnp.asarray(rng.standard_normal((10, 7)), jnp.float32)
+    in2 = jnp.asarray(rng.standard_normal((5, 7)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, 5, (10,)), jnp.int32)
+    out = index_mul_2d(in1, in2, idx)
+    ref = np.asarray(in1) * np.asarray(in2)[np.asarray(idx)]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+    # backward: scatter-add into in2
+    g2 = jax.grad(lambda a: index_mul_2d(in1, a, idx).sum())(in2)
+    ref_g2 = np.zeros((5, 7), np.float32)
+    np.add.at(ref_g2, np.asarray(idx), np.asarray(in1))
+    np.testing.assert_allclose(np.asarray(g2), ref_g2, rtol=1e-5)
+
+
+# ------------------------------------------------------------- halo exchange
+def test_halo_exchange_1d(rng):
+    from apex_tpu.contrib.peer_memory import halo_exchange_1d
+    from apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(
+        1, 1, context_parallel_size_=8)
+    # global image [1, 32, 4, 2] split along H over 8 ranks -> slabs of 4
+    full = jnp.asarray(rng.standard_normal((1, 32, 4, 2)), jnp.float32)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(None, CONTEXT_AXIS), out_specs=P(None, CONTEXT_AXIS))
+    def run(x):
+        return halo_exchange_1d(x, 1, CONTEXT_AXIS, spatial_dim=1)
+
+    out = run(full)  # [1, 8*(4+2), 4, 2]
+    out = np.asarray(out).reshape(1, 8, 6, 4, 2)
+    fullv = np.asarray(full).reshape(1, 8, 4, 4, 2)
+    for r in range(8):
+        np.testing.assert_array_equal(out[:, r, 1:5], fullv[:, r])
+        if r > 0:
+            np.testing.assert_array_equal(out[:, r, 0], fullv[:, r - 1, -1])
+        else:
+            np.testing.assert_array_equal(out[:, r, 0], 0 * out[:, r, 0])
+        if r < 7:
+            np.testing.assert_array_equal(out[:, r, 5], fullv[:, r + 1, 0])
+        else:
+            np.testing.assert_array_equal(out[:, r, 5], 0 * out[:, r, 5])
+
+
+# ------------------------------------------------------------------- groupbn
+def test_groupbn_nhwc_add_relu(rng):
+    from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+
+    x = jnp.asarray(rng.standard_normal((4, 4, 4, 16)), jnp.float32)
+    z = jnp.asarray(rng.standard_normal((4, 4, 4, 16)), jnp.float32)
+    bn = BatchNorm2d_NHWC(16, fuse_relu=True)
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    y, _ = bn.apply(variables, x, z=z, mutable=["batch_stats"])
+    assert (np.asarray(y) >= 0).all()
+    # matches manual BN + add + relu
+    xm = np.asarray(x, np.float64)
+    mean = xm.mean(axis=(0, 1, 2))
+    var = xm.var(axis=(0, 1, 2))
+    ref = (xm - mean) / np.sqrt(var + 1e-5) + np.asarray(z)
+    np.testing.assert_allclose(np.asarray(y), np.maximum(ref, 0),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ------------------------------------------------------------ conv_bias_relu
+def test_conv_bias_relu_family(rng):
+    from apex_tpu.contrib.conv_bias_relu import (ConvBias, ConvBiasMaskReLU,
+                                                 ConvBiasReLU)
+
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 3, 3, 4)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((4,)) * 0.1, jnp.float32)
+
+    y = ConvBias(x, w, b, padding=1)
+    assert y.shape == (2, 8, 8, 4)
+    yr = ConvBiasReLU(x, w, b, padding=1)
+    np.testing.assert_allclose(np.asarray(yr),
+                               np.maximum(np.asarray(y), 0), rtol=1e-6)
+    mask = jnp.asarray(rng.integers(0, 2, (2, 8, 8, 4)), jnp.float32)
+    ym = ConvBiasMaskReLU(x, w, b, mask, padding=1)
+    np.testing.assert_allclose(np.asarray(ym),
+                               np.maximum(np.asarray(y) * np.asarray(mask), 0),
+                               rtol=1e-6)
+    g = jax.grad(lambda w: ConvBiasReLU(x, w, b, padding=1).sum())(w)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+# ---------------------------------------------------------------- fmha shim
+def test_fmha_varlen_matches_dense(rng):
+    from apex_tpu.contrib.fmha import fmha
+    from apex_tpu.ops import flash_attention
+
+    h, d = 2, 32
+    lens = [5, 9, 3]
+    total = sum(lens)
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    qkv = jnp.asarray(rng.standard_normal((total, 3, h, d)), jnp.float32)
+
+    out = fmha(qkv, cu, max_s=16, is_training=False)
+    assert out.shape == (total, h, d)
+
+    # per-sequence dense attention reference
+    off = 0
+    for L in lens:
+        seq = qkv[off:off + L]
+        q, k, v = (seq[:, i].transpose(1, 0, 2)[None] for i in range(3))
+        ref = flash_attention(q, k, v)[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(np.asarray(out[off:off + L]),
+                                   np.asarray(ref), rtol=2e-3, atol=2e-3)
+        off += L
