@@ -379,7 +379,8 @@ def _lamb_phase2_kernel(hp_ref, u_ref, p_ref, ratio_ref, seg_ref, p_out, *, s_pa
 
 def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
                 weight_decay, lr, step, grad_scale=None, noop=None,
-                bias_correction=True, grad_averaging=True, use_nvlamb=False):
+                bias_correction=True, grad_averaging=True, use_nvlamb=False,
+                stats_psum_axis=None):
     """Fused LAMB step: phase-1 kernel (direction + per-tensor norms on the
     MXU) then phase-2 kernel (trust-ratio apply). Mirrors the two-stage
     structure of csrc/multi_tensor_lamb.cu.
@@ -389,6 +390,12 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
 
     Trust ratio: ||p|| / ||u|| where defined; 1.0 otherwise (and for tensors
     excluded unless use_nvlamb — reference semantics).
+
+    ``stats_psum_axis``: when the flat buffers are ROW-SHARDS of a larger
+    buffer (ZeRO: DistributedFusedLAMB), per-tensor ||p||/||u|| partials must
+    be summed across shard ranks between the phases — the analog of the
+    reference's allreduce between multi_tensor_lamb_stage_1 and _stage_2
+    (apex/contrib/optimizers/distributed_fused_lamb.py).
     """
     total_rows = p.shape[0]
     blk = _row_block(total_rows)
@@ -431,6 +438,8 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
         interpret=_INTERPRET(),
     )(hp1, g, p, m, v, seg2d, wd_mat)
 
+    if stats_psum_axis is not None:
+        stats = lax.psum(stats, stats_psum_axis)
     p_norm = jnp.sqrt(stats[0])  # (s_pad,)
     u_norm = jnp.sqrt(stats[1])
     # reference trust-ratio rule (multi_tensor_lamb.cu): ratio = ||p||/||u||
