@@ -1,0 +1,8 @@
+"""apex.contrib.bottleneck equivalent."""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (
+    Bottleneck,
+    SpatialBottleneck,
+)
+
+__all__ = ["Bottleneck", "SpatialBottleneck"]

@@ -371,13 +371,18 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
-                 dropout_rate, block_q, block_k, o, lse, do):
+                 dropout_rate, block_q, block_k, o, lse, do,
+                 delta_adjust=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
     d_pad = _dispatch.round_up(d, 128)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if delta_adjust is not None:
+        # an lse cotangent folds into the row correction:
+        # ds = p*(dp - delta + dlse) = p*(dp - (delta - dlse))
+        delta = delta + delta_adjust
 
     qp = _pad_to(_pad_to(q, 2, bq), 3, 128)
     kp = _pad_to(_pad_to(k, 2, bk), 3, 128)
@@ -544,6 +549,46 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_with_lse(q, k, v, scale, causal, block_q, block_k):
+    """(o, lse) variant for blockwise/ring composition: callers that merge
+    partial attention results (ring attention over a context-sharded
+    sequence) need the per-row logsumexp, and its cotangent folds into the
+    backward's delta correction (see _fa_bwd_impl.delta_adjust)."""
+    return _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
+                   block_q, block_k)
+
+
+def _flash_with_lse_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
+                     block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_with_lse_bwd(scale, causal, block_q, block_k, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    dq, dk, dv = _fa_bwd_impl(q, k, v, None, None, None, None, scale,
+                              causal, 0.0, block_q, block_k, o, lse, do,
+                              delta_adjust=-dlse.astype(jnp.float32))
+    return dq, dk, dv
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
+                             causal: bool = False,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None):
+    """Flash attention returning ``(o, lse)`` — the building block for
+    ring/blockwise attention (apex_tpu/ops/ring_attention.py). Fully
+    differentiable including through the lse."""
+    d = q.shape[-1]
+    scale = (1.0 / np.sqrt(d)) if scale is None else scale
+    return _flash_with_lse(q, k, v, float(scale), causal, block_q, block_k)
 
 
 def flash_attention(

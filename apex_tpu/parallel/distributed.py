@@ -67,9 +67,21 @@ class DistributedDataParallel:
     forward = __call__
 
     def allreduce_gradients(self, grads):
-        """Average a grad pytree over the data axis (shard_map loops only;
-        reference: allreduce_hook/allreduce_bucket + gradient_average)."""
+        """Average a grad pytree over the data axis (shard_map loops;
+        reference: allreduce_hook/allreduce_bucket + gradient_average).
+
+        Outside shard_map (GSPMD/pjit loops) this is the identity: the SPMD
+        partitioner already psums grads produced from a batch sharded over
+        ``data``, so there is nothing left to reduce — the facade stays
+        callable from reference-shaped training scripts either way.
+        """
         import jax.numpy as jnp
+
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            axis_is_bound)
+
+        if not axis_is_bound(self.axis_name):
+            return grads
 
         def red(g):
             g32 = g.astype(jnp.float32) if self.allreduce_always_fp32 else g

@@ -1,0 +1,140 @@
+"""Extended flagship-model coverage (VERDICT round-1 weaknesses 7+8):
+BERT through amp.initialize and the DDP facade, bench shapes (seq 512),
+and a GPT-2-small trace-level validation at real size.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.models import (BertForPreTraining, bert_tiny_config,
+                             make_pretrain_step, synthetic_batch)
+from apex_tpu.optimizers import FusedLAMB
+
+
+def test_bert_through_amp_initialize_o2(rng):
+    """amp O2: params cast to bf16 (norms fp32), optimizer returns cast
+    params, training still converges."""
+    cfg = bert_tiny_config()
+    model = BertForPreTraining(cfg)
+    batch = synthetic_batch(rng, cfg, 4, 32)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"],
+                        batch["attention_mask"])["params"]
+    opt = FusedLAMB(params, lr=1e-3)
+    params, opt = amp.initialize(params, opt, opt_level="O2")
+
+    # O2 property: non-norm floats are bf16, norm params stay fp32
+    from apex_tpu.amp.policy import is_norm_param_name
+    from apex_tpu.optimizers.common import path_name
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for p, leaf in flat:
+        name = path_name(p)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        if is_norm_param_name(name):
+            assert leaf.dtype == jnp.float32, name
+        else:
+            assert leaf.dtype == jnp.bfloat16, name
+
+    step = make_pretrain_step(model)
+    losses = []
+    for i in range(4):
+        loss, grads = step(params, batch, i)
+        params = opt.step(grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # optimizer hands back the policy dtypes every step
+    assert params["layer_0"]["attention"]["qkv_weight"].dtype == jnp.bfloat16
+
+
+def test_bert_through_ddp_facade(rng):
+    """The reference integration: DDP(module) + allreduce_gradients in the
+    loop (examples/simple/distributed pattern) on the flagship model."""
+    from apex_tpu.parallel import DistributedDataParallel
+    from apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(1, 1)
+    cfg = bert_tiny_config()
+    model = BertForPreTraining(cfg)
+    ddp = DistributedDataParallel(model, message_size=10_000_000)
+    batch = synthetic_batch(rng, cfg, 8, 16)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"],
+                        batch["attention_mask"])["params"]
+    step, place, batch_sh = make_pretrain_step(model, mesh=mesh)
+    params = place(params)
+    batch = jax.tree.map(jax.device_put, batch, batch_sh)
+    opt = FusedLAMB(params, lr=1e-3)
+    with mesh:
+        losses = []
+        for i in range(3):
+            loss, grads = step(params, batch, i)
+            grads = ddp.allreduce_gradients(grads)
+            params = opt.step(grads)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_seq512_bench_shape_forward(rng):
+    """Tiny width but BENCH sequence length: validates the seq-512 mask /
+    position plumbing the benchmark runs (interpret-mode on CPU)."""
+    cfg = bert_tiny_config(max_position_embeddings=512)
+    model = BertForPreTraining(cfg)
+    batch = synthetic_batch(rng, cfg, 1, 512)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"],
+                        batch["attention_mask"])["params"]
+    mlm, nsp = model.apply({"params": params}, batch["input_ids"],
+                           batch["token_type_ids"], batch["attention_mask"])
+    assert mlm.shape == (1, 512, cfg.vocab_size)
+    assert np.isfinite(np.asarray(mlm, np.float32)).all()
+
+
+def test_gpt2_small_traces_at_real_size():
+    """GPT-2-small (12L/768H/50304V) traced + lowered at real size with
+    tp=4 shard shapes — catches shape/divisibility bugs that toy configs
+    hide, without paying a CPU compile."""
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+
+    cfg = gpt2_small_config(tensor_parallel_size=4)
+    model = GPTModel(cfg)
+    ids = jnp.zeros((1, 1024), jnp.int32)
+    var_shape = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), ids))
+    p = var_shape["params"]
+    # Megatron shard shapes at tp=4
+    assert p["word_embeddings"]["weight"].shape == (50304 // 4, 768)
+    assert p["layer_0"]["qkv"]["weight"].shape == (3 * 768 // 4, 768)
+    assert p["layer_0"]["mlp_in"]["weight"].shape == (4 * 768 // 4, 768)
+    assert p["layer_0"]["mlp_out"]["weight"].shape == (768, 4 * 768 // 4)
+    n_params = sum(np.prod(x.shape) for x in jax.tree.leaves(p))
+    assert 25e6 < n_params < 50e6  # one tp=4 shard of ~124M
+
+    # abstract forward under a real tp=4 mesh (eval_shape of the shard_map
+    # program: traces all collectives, compiles nothing)
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.mesh import MODEL_AXIS
+    from apex_tpu.transformer import parallel_state
+
+    mesh = parallel_state.initialize_model_parallel(4)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P()), out_specs=P(None, None, MODEL_AXIS),
+        check_vma=False)
+    def fwd(v, ids):
+        return model.apply(v, ids)
+
+    # per-rank param shapes stack over the model axis for the global view
+    global_vars = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), var_shape)
+    out_shape = jax.eval_shape(fwd, global_vars,
+                               jax.ShapeDtypeStruct((1, 1024), jnp.int32))
+    assert out_shape.shape == (1, 1024, 50304)

