@@ -30,9 +30,9 @@ from apex_tpu.amp.policy import (Policy, is_norm_param_name, make_policy,
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 from apex_tpu.optimizers.common import path_name as _path_name
 
-__all__ = ["initialize", "scale_loss", "master_params", "current_policy",
-           "state_dict", "load_state_dict", "Policy", "make_policy",
-           "LossScaler", "resolve_compute_dtype"]
+__all__ = ["initialize", "scale_loss", "unscale_and_combine", "master_params",
+           "current_policy", "state_dict", "load_state_dict", "Policy",
+           "make_policy", "LossScaler", "resolve_compute_dtype"]
 
 # module-level amp state (reference: apex/amp/_amp_state.py)
 _current_policy: Optional[Policy] = None
@@ -56,8 +56,11 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
     ``models`` is a parameter pytree (or list of pytrees); returns the
     policy-cast pytree(s) and the optimizer(s) with a LossScaler attached.
     With multiple losses AND multiple optimizers, scaler i is attached to
-    optimizer i (the DCGAN pattern: one loss per optimizer). A single
-    optimizer driven by several dynamically-scaled losses is not supported.
+    optimizer i (the DCGAN pattern: one loss per loss_id=i optimizer). A
+    single optimizer driven by several dynamically-scaled losses (reference:
+    handle.py scale_loss(loss, opt, loss_id=i) with num_losses > 1) keeps
+    one independent scaler per loss; combine the per-loss grads with
+    ``amp.unscale_and_combine`` and pass its noop flag to ``step``.
     """
     global _current_policy, _loss_scalers
     if not enabled:
@@ -94,23 +97,30 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                    max_loss_scale=max_loss_scale)
         for _ in range(num_losses)
     ]
+    _combine_cache.clear()
 
     if optimizers is not None:
         single_opt = not isinstance(optimizers, (list, tuple))
         opt_list = [optimizers] if single_opt else list(optimizers)
         if num_losses > 1 and len(opt_list) not in (1, num_losses):
             raise ValueError("num_losses must be 1 or match the optimizer count")
-        if num_losses > 1 and len(opt_list) == 1 and _loss_scalers[0].dynamic:
-            raise NotImplementedError(
-                "one optimizer driven by multiple dynamically-scaled losses is "
-                "not supported; use one optimizer per loss (DCGAN pattern)")
+        # Multi-loss on ONE optimizer with DYNAMIC scaling: the per-loss
+        # unscale happens in amp.unscale_and_combine (each loss's scale
+        # diverges), so no scaler is fused into the step — it receives
+        # pre-unscaled grads plus the union found-inf noop flag. With a
+        # STATIC scale every loss shares one value, so the fused in-step
+        # unscale remains correct (and remains attached — pre-round-3
+        # behavior; such callers must NOT also use unscale_and_combine).
+        multi_loss_dynamic_single_opt = (num_losses > 1 and len(opt_list) == 1
+                                         and _loss_scalers[0].dynamic)
         for i, opt in enumerate(opt_list):
             scaler = _loss_scalers[min(i, num_losses - 1)]
             # skip the no-op scaler entirely: static scale 1.0 needs neither
             # an unscale nor a found-inf pass (saves a full grad-buffer read
             # per step and keeps inf grads loud instead of silently skipping)
-            if hasattr(opt, "attach_amp_scaler") and (
-                    scaler.dynamic or float(scaler.state.scale) != 1.0):
+            if (hasattr(opt, "attach_amp_scaler")
+                    and not multi_loss_dynamic_single_opt
+                    and (scaler.dynamic or float(scaler.state.scale) != 1.0)):
                 opt.attach_amp_scaler(scaler)
             # O2/O3: the optimizer must hand back params in the cast dtypes
             if hasattr(opt, "set_output_dtypes") and policy.param_dtype != jnp.float32:
@@ -136,6 +146,80 @@ def scale_loss(loss, optimizers=None, loss_id=0, model=None, delay_unscale=False
         return
     scaler = _loss_scalers[loss_id]
     yield scaler.scale_loss(loss)
+
+
+# jit cache for unscale_and_combine: keyed by (loss ids, grad tree structure)
+_combine_cache: dict = {}
+
+
+def unscale_and_combine(grads_list, loss_ids=None):
+    """Combine per-loss scaled grads for ONE optimizer (reference:
+    apex/amp/handle.py scale_loss(..., loss_id=i) with num_losses > 1 —
+    each ctx exit unscales that loss's grads by ITS scaler and accumulates
+    into param.grad; optimizer.step skips if ANY loss overflowed, and each
+    scaler's scale updates independently).
+
+    Args:
+      grads_list: per-loss grad pytrees, each of the SCALED loss ``i`` (as
+        produced by ``jax.grad`` of the ``scale_loss(..., loss_id=i)``
+        value).
+      loss_ids: which scaler each entry belongs to (default: 0..N-1).
+
+    Returns ``(grads, noop)``: the summed unscaled grads and the union
+    found-inf flag — pass both to ``optimizer.step(grads, noop=noop)``.
+    Updates each involved scaler's state (halve on its own overflow, grow on
+    its own clean streak), so scalers diverge per loss exactly like the
+    reference's per-loss LossScaler instances.
+    """
+    ids = tuple(loss_ids) if loss_ids is not None else tuple(
+        range(len(grads_list)))
+    if len(ids) != len(grads_list):
+        raise ValueError("loss_ids must match grads_list length")
+    scalers = tuple(_loss_scalers[i] for i in ids)
+    if not any(s.dynamic for s in scalers):
+        # with a STATIC loss_scale, initialize() fused the (single, shared)
+        # scale into optimizer.step — unscaling here too would shrink every
+        # update by the scale a second time
+        raise RuntimeError(
+            "unscale_and_combine is for dynamically-scaled multi-loss "
+            "training; with a static loss_scale the unscale is fused into "
+            "optimizer.step, so sum the raw scaled grads and call step "
+            "directly")
+    treedef = jax.tree.structure(grads_list[0])
+    # key on the scalers' STATIC behavior (growth params), not identity:
+    # every distinct configuration compiles once, re-initialize() with the
+    # same config reuses the entry (the closure's stale scaler objects only
+    # contribute these same statics — states ride in as arguments), and the
+    # cache stays bounded by distinct configurations
+    statics = tuple((s._scale_factor, s._scale_window, s._min_scale,
+                     s._max_scale) for s in scalers)
+    key = (ids, str(treedef), statics)
+    if key not in _combine_cache:
+        def _pure(g_list, states):
+            total = None
+            noop = jnp.zeros((), jnp.float32)
+            new_states = []
+            for g, st, sc in zip(g_list, states, scalers):
+                nonfinite = sum(
+                    jnp.sum(~jnp.isfinite(leaf.astype(jnp.float32)))
+                    for leaf in jax.tree.leaves(g))
+                found = (nonfinite > 0).astype(jnp.float32)
+                inv = (1.0 / st.scale)
+                g_un = jax.tree.map(
+                    lambda x: x * inv.astype(x.dtype), g)
+                total = (g_un if total is None
+                         else jax.tree.map(jnp.add, total, g_un))
+                noop = jnp.maximum(noop, found)
+                new_states.append(sc.update(st, found))
+            return total, new_states, noop
+
+        _combine_cache[key] = jax.jit(_pure)
+
+    states = [s.state for s in scalers]
+    total, new_states, noop = _combine_cache[key](list(grads_list), states)
+    for s, ns in zip(scalers, new_states):
+        s.state = ns
+    return total, noop
 
 
 def master_params(optimizer):

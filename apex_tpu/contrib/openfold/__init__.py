@@ -1,0 +1,58 @@
+"""openfold analog — reference: apex/contrib/openfold_triton/.
+
+The reference package is pure-Triton AlphaFold kernels with two public
+compute entry points (SURVEY.md §2.3 niche row): a LayerNorm tuned for
+OpenFold's small-last-dim shapes and a fused attention core (softmax over
+per-head bias + gating epilogue handled in Python around it). On TPU both
+map directly onto kernels this library already ships — this module is the
+explicit mapping so OpenFold-style callers have a named import:
+
+- ``layer_norm`` -> apex_tpu.ops.layer_norm (Pallas fwd+bwd, fp32 accum);
+  OpenFold's [*, N_res, N_res, c_z]-style shapes flatten to rows like any
+  other LN input, so no small-shape special case is needed.
+- ``attention_core(q, k, v, bias1, bias2)`` -> the flash-attention kernel
+  with additive bias (the Triton kernel's mask/pair biases sum into one
+  additive term; softmax/AV fusion comes from the kernel itself).
+
+The rest of the reference package (CUDA-graph/SWA training-loop helpers,
+DAP process groups) is training-harness code outside this library's
+kernel-parity scope — see docs/contrib.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flash_attention
+from apex_tpu.ops import layer_norm as _fused_layer_norm
+
+__all__ = ["layer_norm", "attention_core"]
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    """OpenFold LayerNormSmallShapeOptImpl analog (normalize over the last
+    dim with affine): one Pallas kernel serves every hidden size."""
+    return _fused_layer_norm(x, weight, bias, eps=eps)
+
+
+def attention_core(q, k, v, bias1: Optional[jax.Array] = None,
+                   bias2: Optional[jax.Array] = None, *,
+                   scale: Optional[float] = None):
+    """Fused attention core: softmax(scale*q@k^T + bias1 + bias2) @ v.
+
+    q/k/v: [batch, heads, seq, dim] (callers with OpenFold's extra leading
+    dims flatten them into batch). bias1/bias2 broadcast over
+    [batch, heads, q, k] — the reference kernel's mask bias and triangle/
+    pair bias; they are summed into the flash kernel's additive-bias slot.
+    """
+    bias = None
+    if bias1 is not None and bias2 is not None:
+        bias = (bias1.astype(jnp.float32) + bias2.astype(jnp.float32))
+    elif bias1 is not None:
+        bias = bias1
+    elif bias2 is not None:
+        bias = bias2
+    return flash_attention(q, k, v, bias=bias, scale=scale)

@@ -19,11 +19,12 @@ from typing import Any
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
-from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops import flash_attention
+from apex_tpu.ops import flash_attention, ring_attention
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -47,6 +48,11 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     tensor_parallel_size: int = 1    # static tp world for shard shapes
+    # context parallelism is an explicit OPT-IN: the ``context`` axis being
+    # bound only proves the mesh has it, not that the caller sharded the
+    # sequence over it (a replicated sequence under a cp>1 mesh would get
+    # wrong position offsets and double-counted ring keys)
+    context_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -90,7 +96,15 @@ class ParallelDecoderBlock(nn.Module):
         def to_bhsd(t):
             return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
 
-        ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal=True)
+        # context parallelism (beyond reference): with the sequence sharded
+        # over ``context``, K/V ring-rotate between devices instead of any
+        # device materializing the full sequence (ops/ring_attention.py)
+        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+            ctx = ring_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                 axis_name=CONTEXT_AXIS, causal=True)
+        else:
+            ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                  causal=True)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, input_is_parallel=True, world_size=tp,
@@ -129,7 +143,21 @@ class GPTModel(nn.Module):
         pos = self.param("position_embeddings", nn.initializers.normal(0.02),
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          cfg.param_dtype)
-        x = (x + pos[None, :s, :]).astype(dt)
+        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+            # sequence sharded over ``context``: local chunk i covers global
+            # positions [i*s, (i+1)*s)
+            cp = lax.axis_size(CONTEXT_AXIS)
+            if cp * s > cfg.max_position_embeddings:
+                # dynamic_slice would CLAMP an out-of-range start and
+                # silently reuse positions on late ranks
+                raise ValueError(
+                    f"global sequence cp*s = {cp}*{s} exceeds "
+                    f"max_position_embeddings={cfg.max_position_embeddings}")
+            off = lax.axis_index(CONTEXT_AXIS) * s
+            pos_s = lax.dynamic_slice_in_dim(pos, off, s)
+        else:
+            pos_s = pos[:s]
+        x = (x + pos_s[None, :, :]).astype(dt)
         for i in range(cfg.num_layers):
             x = ParallelDecoderBlock(cfg, name=f"layer_{i}")(x)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
@@ -148,4 +176,9 @@ def gpt_loss(model: GPTModel, variables, input_ids, labels,
     else:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         per_tok = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return per_tok.mean()
+    loss = per_tok.mean()
+    if model.config.context_parallel and _axis_bound(CONTEXT_AXIS):
+        # sequence sharded over ``context``: local means combine to the
+        # global token mean (equal chunk sizes)
+        loss = lax.pmean(loss, CONTEXT_AXIS)
+    return loss

@@ -6,7 +6,12 @@ code paths are unit-testable on CPU). Reference mapping in SURVEY.md §2.2.
 """
 
 from apex_tpu.ops.layer_norm import layer_norm, rms_norm  # noqa: F401
-from apex_tpu.ops.flash_attention import flash_attention, mha_reference  # noqa: F401
+from apex_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_with_lse,
+    mha_reference,
+)
+from apex_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from apex_tpu.ops.scaled_softmax import (  # noqa: F401
     scaled_masked_softmax,
     scaled_softmax,

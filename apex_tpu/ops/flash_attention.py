@@ -587,7 +587,7 @@ def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
     ring/blockwise attention (apex_tpu/ops/ring_attention.py). Fully
     differentiable including through the lse."""
     d = q.shape[-1]
-    scale = (1.0 / np.sqrt(d)) if scale is None else scale
+    scale = (1.0 / (d ** 0.5)) if scale is None else scale
     return _flash_with_lse(q, k, v, float(scale), causal, block_q, block_k)
 
 

@@ -144,9 +144,110 @@ def _gn_fwd_vjp(x, weight, bias, num_groups, eps, act):
     return y, (x, weight, bias, saved)
 
 
+def _gn_bwd_kernel(x_ref, dy_ref, w_ref, b_ref, mean_ref, rstd_ref,
+                   dx_ref, dwp_ref, dbp_ref, *, act, affine, m):
+    """One (n, g) slab in a single VMEM pass: silu grad, dw/db partials,
+    the two group reductions, and dx — the Pallas answer to the reference's
+    group_norm_nhwc_bwd kernels (one-pass vs XLA's ~30 tensor sweeps for
+    the jnp formulation, measured via cost_analysis; docs/normalization.md)."""
+    x = x_ref[0].astype(jnp.float32)
+    dy = dy_ref[0].astype(jnp.float32)
+    mean = mean_ref[0, 0]
+    rstd = rstd_ref[0, 0]
+    xhat = (x - mean) * rstd
+    if act == "silu":
+        wv = w_ref[0].astype(jnp.float32) if affine else 1.0
+        bv = b_ref[0].astype(jnp.float32) if affine else 0.0
+        y_pre = xhat * wv + bv
+        sig = jax.nn.sigmoid(y_pre)
+        dy = dy * (sig * (1.0 + y_pre * (1.0 - sig)))
+    dwp_ref[0] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dbp_ref[0] = jnp.sum(dy, axis=0, keepdims=True)
+    dyw = dy * w_ref[0].astype(jnp.float32) if affine else dy
+    sum_dy = jnp.sum(dyw)
+    sum_dy_xhat = jnp.sum(dyw * xhat)
+    dx_ref[0] = (rstd * (dyw - sum_dy / m - xhat * sum_dy_xhat / m)
+                 ).astype(dx_ref.dtype)
+
+
+def _bwd_kernel_eligible(hw: int, cg: int) -> bool:
+    # three live fp32 slabs (x, dy, dx) must fit VMEM alongside temps
+    return cg % 128 == 0 and hw % 8 == 0 and hw * cg * 4 <= 2 * 1024 * 1024
+
+
 def _gn_bwd(num_groups, eps, act, res, dy):
+    x, weight, bias, saved = res
+    n, h, w_, c = x.shape
+    g = num_groups
+    cg = c // g
+    hw = h * w_
+    affine = weight is not None
+
+    if saved is None or not _bwd_kernel_eligible(hw, cg):
+        return _gn_bwd_jnp(num_groups, eps, act, res, dy)
+
+    mean, rstd = saved
+    x_slab = x.reshape(n, hw, g, cg).transpose(0, 2, 1, 3).reshape(
+        n * g, hw, cg)
+    dy_slab = dy.reshape(n, hw, g, cg).transpose(0, 2, 1, 3).reshape(
+        n * g, hw, cg)
+    if affine:
+        w_slab = jnp.tile(weight.reshape(1, g, 1, cg), (n, 1, 1, 1)
+                          ).reshape(n * g, 1, cg)
+        b_slab = jnp.tile(bias.reshape(1, g, 1, cg), (n, 1, 1, 1)
+                          ).reshape(n * g, 1, cg)
+    else:
+        w_slab = jnp.zeros((n * g, 1, cg), x.dtype)
+        b_slab = jnp.zeros((n * g, 1, cg), x.dtype)
+
+    dx_slab, dwp, dbp = _dispatch.pallas_call(
+        functools.partial(_gn_bwd_kernel, act=act or None, affine=affine,
+                          m=float(hw * cg)),
+        grid=(n * g,),
+        in_specs=[
+            pl.BlockSpec((1, hw, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hw, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hw, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cg), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n * g, hw, cg), x.dtype),
+            jax.ShapeDtypeStruct((n * g, 1, cg), jnp.float32),
+            jax.ShapeDtypeStruct((n * g, 1, cg), jnp.float32),
+        ],
+        interpret=_INTERPRET(),
+    )(x_slab, dy_slab, w_slab, b_slab,
+      mean.reshape(n * g, 1), rstd.reshape(n * g, 1))
+
+    dx = dx_slab.reshape(n, g, hw, cg).transpose(0, 2, 1, 3).reshape(
+        n, h, w_, c)
+    if affine:
+        # cross-sample accumulation of the per-slab partials ([n*g, cg])
+        dw = dwp.reshape(n, g * cg).sum(axis=0).astype(weight.dtype)
+        db = dbp.reshape(n, g * cg).sum(axis=0).astype(bias.dtype)
+    else:
+        dw = db = None
+    return dx, dw, db
+
+
+def _gn_bwd_jnp(num_groups, eps, act, res, dy):
     """Standard GroupNorm gradient (the reference's bwd kernels compute the
-    same two per-group reductions); SiLU grad folded in first."""
+    same two per-group reductions); SiLU grad folded in first. Fallback for
+    non-lane-aligned / oversized slabs and for the jnp-forward path."""
     x, weight, bias, saved = res
     n, h, w_, c = x.shape
     g = num_groups

@@ -1,0 +1,125 @@
+"""Ring attention over the ``context`` mesh axis — sequence/context
+parallelism beyond the reference.
+
+The reference's only sequence-length play is Megatron sequence parallelism
+(apex/transformer/tensor_parallel/layers.py ``sequence_parallel_enabled``);
+attention itself is never sharded over sequence, and its fmha kernel caps
+seqlen at 512 (SURVEY.md §5 long-context row). This module removes that
+ceiling: the sequence is sharded over the ``context`` axis, each device holds
+a [B, H, S/cp, D] chunk of q/k/v, and K/V chunks rotate around the ring via
+``lax.ppermute`` (ICI neighbor hops) while each device accumulates its
+queries' attention over every chunk with an online logsumexp merge — the
+blockwise/ring-attention formulation (Liu et al.), built on the flash
+kernel's ``(o, lse)`` output (apex_tpu/ops/flash_attention.py
+``flash_attention_with_lse``).
+
+Differentiability: each partial is a ``custom_vjp`` flash call (including the
+lse cotangent, which folds into the backward's delta correction) and the
+merge is plain jnp — so ``jax.grad`` through the scan + ppermute yields the
+exact ring backward (grads ride the reverse ring automatically via
+ppermute's transpose) with no hand-written outer VJP.
+
+Causal load note: chunks are laid out in sequence order, so rotation step 0
+is exactly the causal diagonal for every device (a *static* branch) and later
+steps are all-or-nothing (device i attends chunk j iff j < i). Devices late
+in the ring discard more work — the classic ring-attention imbalance;
+zigzag/striped layouts could fix it but complicate the story, and the wasted
+kernels are uniform SPMD work that XLA overlaps with the permutes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.mesh import CONTEXT_AXIS
+from apex_tpu.ops.flash_attention import flash_attention_with_lse
+
+
+def _rotate(x, axis_name, cp):
+    """Shift chunks one step around the ring: device i -> i+1 (mod cp)."""
+    return lax.ppermute(x, axis_name, [(i, (i + 1) % cp) for i in range(cp)])
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Numerically-stable combine of two normalized partial attentions.
+
+    Given o_i = softmax_i @ v over key-subset i with row logsumexp lse_i,
+    the exact combined result is a convex combination weighted by
+    exp(lse_i - lse_tot). Rows where a partial saw no live keys carry
+    lse = -inf and drop out with weight 0.
+    """
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    w1 = jnp.where(jnp.isneginf(lse1), 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(jnp.isneginf(lse2), 0.0, jnp.exp(lse2 - m_safe))
+    den = w1 + w2
+    den_safe = jnp.where(den == 0.0, 1.0, den)
+    o = (w1[..., None] * o1 + w2[..., None] * o2) / den_safe[..., None]
+    lse = jnp.where(den == 0.0, -jnp.inf, m_safe + jnp.log(den_safe))
+    return o, lse
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """Flash attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map`` (or ``pjit``-manual) with the
+    sequence dimension of q/k/v sharded IN ORDER over ``axis_name``:
+    device i holds tokens [i*S_loc, (i+1)*S_loc).
+
+    Args:
+      q, k, v: local chunks [B, H, S_loc, D] (self-attention ring: q and kv
+        share the sequence sharding; cross-attention rings are out of scope).
+      causal: global causal masking across the full (unsharded) sequence.
+      scale: softmax scale, default 1/sqrt(D).
+
+    Returns the local output chunk [B, H, S_loc, D] in q.dtype — numerically
+    identical (up to fp accumulation order) to single-device
+    ``flash_attention`` on the gathered sequence.
+    """
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError(
+            f"ring self-attention needs equal q/k/v chunk shapes, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
+    d = q.shape[-1]
+    scale = (1.0 / (d ** 0.5)) if scale is None else float(scale)
+    cp = lax.psum(1, axis_name)  # static axis size inside shard_map
+    idx = lax.axis_index(axis_name)
+
+    # step 0: own chunk — for causal layouts this IS the diagonal block
+    o0, lse0 = flash_attention_with_lse(
+        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    o, lse = o0.astype(jnp.float32), lse0
+    if cp == 1:
+        return o0
+
+    kc, vc = _rotate(k, axis_name, cp), _rotate(v, axis_name, cp)
+
+    def body(carry, r):
+        kc, vc, o, lse = carry
+        # at step r device idx holds chunk j = (idx - r) mod cp
+        o_r, lse_r = flash_attention_with_lse(
+            q, kc, vc, scale=scale, causal=False,
+            block_q=block_q, block_k=block_k)
+        if causal:
+            # include iff source chunk j is strictly before ours (j < idx
+            # ⇔ r <= idx); excluded partials get weight exp(-inf) = 0
+            lse_r = jnp.where(r <= idx, lse_r, -jnp.inf)
+        o, lse = _merge(o, lse, o_r.astype(jnp.float32), lse_r)
+        return (_rotate(kc, axis_name, cp), _rotate(vc, axis_name, cp),
+                o, lse), None
+
+    (_, _, o, lse), _ = lax.scan(body, (kc, vc, o, lse), jnp.arange(1, cp))
+    return o.astype(q.dtype)

@@ -107,13 +107,155 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     return ys[n_stages - 1:]
 
 
+def _fwd_bwd_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
+                  microbatches, loss_aux, axis_name: str,
+                  first_fn: Optional[Callable], loss_with_params: bool):
+    """True 1F1B: explicit interleaved forward/backward ticks, O(S) memory.
+
+    Reference semantics (fwd_bwd_pipelining_without_interleaving.py: warmup
+    fwds -> steady 1F1B -> cooldown bwds) restated as ONE lock-step scan:
+    at global tick t, stage s forwards microbatch ``t - s`` and backwards
+    microbatch ``t - 2(S-1) + s`` (each when in range). Activations shift
+    downstream and cotangents upstream by one ppermute per tick, exactly the
+    reference's send_forward / send_backward pairing; warmup and cooldown
+    are simply the ticks where one of the two slots is out of range (the
+    per-device ``lax.cond``/``switch`` skips the dead work, reproducing the
+    1F1B bubble shape). Total ticks: M + 2(S-1) — the reference 1F1B's
+    fill+steady+drain length.
+
+    Memory: this function never differentiates through the tick scan —
+    gradients are produced INSIDE each tick by re-linearizing the stage from
+    a saved input (``jax.vjp`` on the spot = the reference's
+    deallocate_output_tensor + recompute discipline). The only O(>1)
+    activation state is a ``[2(S-1)+1, act]`` ring buffer of in-flight stage
+    inputs in the scan carry — stage s holds at most 2(S-1)-2s+1 live
+    entries (the lock-step analog of 1F1B's "stage s keeps S-s activation
+    sets") — so peak activation memory is O(S), independent of M
+    (tests/test_pipeline_memory.py asserts this against the XLA-reported
+    peak at M=8 vs M=32).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    if n_stages < 2:
+        raise RuntimeError("1F1B schedule needs >= 2 stages; use "
+                           "forward_backward_no_pipelining for pp=1")
+    m_count = _mb_count(microbatches)
+    entry = first_fn if first_fn is not None else (lambda p, mb: mb)
+    ring_depth = 2 * (n_stages - 1) + 1
+    t_total = m_count + 2 * (n_stages - 1)
+
+    # traced-but-DCE'd activation shape probe (see pipeline_apply)
+    x0_probe = entry(stage_params, _index_mb(microbatches, 0, m_count))
+    act_shape, act_dtype = x0_probe.shape, x0_probe.dtype
+
+    zero_dp = jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), stage_params)
+    zero_dx = jnp.zeros(act_shape, act_dtype)
+
+    def head_loss(p, y, aux):
+        if loss_with_params:
+            return (loss_fn(p, y, aux) if loss_aux is not None
+                    else loss_fn(p, y))
+        return loss_fn(y, aux) if loss_aux is not None else loss_fn(y)
+
+    # backward branches — uniform signature (x_saved, dy, mb_raw, aux) ->
+    # (dparams, dx, loss). Which one runs is a per-device runtime switch.
+    def bwd_dead(x_saved, dy, mb_raw, aux):
+        return zero_dp, zero_dx, jnp.zeros((), jnp.float32)
+
+    def bwd_first(x_saved, dy, mb_raw, aux):
+        # stage 0 recomputes through the embedding/preprocess so entry's
+        # param grads flow; its input cotangent has nowhere to go
+        y, vjp = jax.vjp(lambda p: stage_fn(p, entry(p, mb_raw)), stage_params)
+        (dp,) = vjp(dy.astype(y.dtype))
+        return dp, zero_dx, jnp.zeros((), jnp.float32)
+
+    def bwd_mid(x_saved, dy, mb_raw, aux):
+        y, vjp = jax.vjp(stage_fn, stage_params, x_saved)
+        dp, dx = vjp(dy.astype(y.dtype))
+        return dp, dx.astype(act_dtype), jnp.zeros((), jnp.float32)
+
+    def bwd_last(x_saved, dy, mb_raw, aux):
+        # fwd + loss head + bwd in one vjp, seeded by the scalar loss
+        def f(p, x):
+            return head_loss(p, stage_fn(p, x), aux)
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1))(
+            stage_params, x_saved)
+        dp, dx = grads
+        return dp, dx.astype(act_dtype), loss.astype(jnp.float32)
+
+    def tick(carry, t):
+        ring, buf_f, buf_b, gacc, lacc = carry
+
+        # ---- forward slot: microbatch t - s ----
+        m_f = t - s
+        fwd_live = (m_f >= 0) & (m_f < m_count)
+        mb_f = _index_mb(microbatches, m_f, m_count)
+        # only stage 0 runs the embedding/preprocess (cond, not where: the
+        # other S-1 stages must not pay the gather every tick)
+        x_in = lax.cond(
+            fwd_live & (s == 0),
+            lambda: entry(stage_params, mb_f).astype(act_dtype),
+            lambda: buf_f)
+        slot_f = jnp.mod(m_f, ring_depth)
+        ring = lax.cond(fwd_live,
+                        lambda r: lax.dynamic_update_index_in_dim(
+                            r, x_in, slot_f, 0),
+                        lambda r: r, ring)
+        # the last stage consumes its own forward inside bwd_last's vjp —
+        # computing y there too would double its work
+        y = lax.cond(fwd_live & (s < n_stages - 1),
+                     lambda x: stage_fn(stage_params, x).astype(act_dtype),
+                     lambda x: zero_dx, x_in)
+
+        # ---- backward slot: microbatch t - 2(S-1) + s ----
+        m_b = t - 2 * (n_stages - 1) + s
+        bwd_live = (m_b >= 0) & (m_b < m_count)
+        x_saved = lax.dynamic_index_in_dim(
+            ring, jnp.mod(m_b, ring_depth), 0, keepdims=False)
+        mb_b = _index_mb(microbatches, m_b, m_count)
+        aux_b = (_index_mb(loss_aux, m_b, m_count)
+                 if loss_aux is not None else jnp.zeros(()))
+        branch = jnp.where(
+            bwd_live,
+            jnp.where(s == 0, 1, jnp.where(s == n_stages - 1, 3, 2)),
+            0)
+        dp, dx, lval = lax.switch(branch, (bwd_dead, bwd_first, bwd_mid,
+                                           bwd_last),
+                                  x_saved, buf_b, mb_b, aux_b)
+        gacc = jax.tree.map(jnp.add, gacc, dp)
+        lacc = lacc + lval
+
+        # ---- one downstream + one upstream shift per tick (reference:
+        # send_forward / send_backward of the steady 1F1B loop) ----
+        buf_f = p2p.send_forward_recv_forward(y, axis_name)
+        buf_b = p2p.send_backward_recv_backward(dx, axis_name)
+        return (ring, buf_f, buf_b, gacc, lacc), None
+
+    carry0 = (
+        jnp.zeros((ring_depth,) + tuple(act_shape), act_dtype),
+        jnp.zeros(act_shape, act_dtype),
+        jnp.zeros(act_shape, act_dtype),
+        zero_dp,
+        jnp.zeros((), jnp.float32),
+    )
+    (ring, buf_f, buf_b, gacc, lacc), _ = lax.scan(
+        tick, carry0, jnp.arange(t_total))
+    # only the last stage accumulated loss; psum broadcasts it (reference
+    # reduces losses on the last stage — the broadcast spares callers a
+    # special case, same contract as the autodiff formulation)
+    mean_loss = lax.psum(lacc, axis_name) / m_count
+    grads = jax.tree.map(lambda g: g / m_count, gacc)
+    return mean_loss, grads
+
+
 def forward_backward_pipelining_without_interleaving(
         stage_fn: Callable, loss_fn: Callable, stage_params, microbatches,
         loss_aux=None, forward_only: bool = False,
         axis_name: str = STAGE_AXIS, checkpoint_stage: bool = True,
         first_fn: Optional[Callable] = None,
-        loss_with_params: bool = False):
-    """The 1F1B-equivalent schedule (reference:
+        loss_with_params: bool = False,
+        implementation: str = "1f1b"):
+    """The 1F1B schedule (reference:
     fwd_bwd_pipelining_without_interleaving.py).
 
     ``loss_fn(y, aux_m) -> scalar`` runs on the last stage per microbatch
@@ -123,18 +265,23 @@ def forward_backward_pipelining_without_interleaving(
     ``first_fn(stage_params, mb)`` is the stage-0 preprocess (embedding).
     Returns ``(mean_loss, stage_grads)`` — each device gets grads of ITS
     stage's params, accumulated over microbatches, with the loss broadcast to
-    every stage (the reference reduces losses on the last stage only; here
-    the broadcast costs one scalar psum and spares the caller a special
-    case). With ``forward_only=True`` returns ``(mean_loss, None)``.
+    every stage. With ``forward_only=True`` returns ``(mean_loss, None)``.
 
-    Memory note (round-1 verdict follow-up): the scan carries one saved
-    residual set per tick (O(M + S) ticks), whereas the reference's 1F1B
-    bounds in-flight activations to ~S by interleaving backward into the
-    steady state. ``checkpoint_stage=True`` (default) rematerializes the
-    stage body in backward, so the per-tick residual is just the stage
-    INPUT — O(M) stage-inputs retained vs 1F1B's O(S) full activation sets,
-    trading one extra forward of FLOPs (the standard TPU
-    recompute-vs-memory trade; jax.checkpoint policies can refine it).
+    ``implementation`` selects the gradient formulation:
+
+    - ``"1f1b"`` (default): explicit interleaved fwd/bwd ticks with O(S)
+      activation memory — the reference's warmup/steady/cooldown memory
+      contract (see ``_fwd_bwd_1f1b``).
+    - ``"autodiff"``: differentiate through the forward scan. Simpler
+      program, but retains one stage-input residual per tick — O(M)
+      activation memory; fine for small microbatch counts, kept as the
+      cross-check oracle (tests assert the two implementations agree).
+
+    ``checkpoint_stage`` applies to ``forward_only`` and the ``"autodiff"``
+    path only: the 1F1B implementation ALWAYS rematerializes the stage from
+    its saved input in backward (that recompute discipline is what bounds
+    its memory — the reference's deallocate_output_tensor contract), so the
+    flag has no effect there.
     """
     if not axis_is_bound(axis_name):
         raise RuntimeError(
@@ -162,6 +309,11 @@ def forward_backward_pipelining_without_interleaving(
 
     if forward_only:
         return mean_loss_of(stage_params), None
+    if implementation == "1f1b":
+        return _fwd_bwd_1f1b(stage_fn, loss_fn, stage_params, microbatches,
+                             loss_aux, axis_name, first_fn, loss_with_params)
+    if implementation != "autodiff":
+        raise ValueError(f"unknown implementation {implementation!r}")
     loss, grads = jax.value_and_grad(mean_loss_of)(stage_params)
     return loss, grads
 

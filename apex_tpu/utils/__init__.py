@@ -11,6 +11,9 @@ from apex_tpu.utils.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from apex_tpu.utils import metrics
+from apex_tpu.utils.metrics import AverageMeter, StepTimer
 
 __all__ = ["annotate", "time_fn", "trace", "save_checkpoint",
-           "restore_checkpoint", "CheckpointManager"]
+           "restore_checkpoint", "CheckpointManager", "metrics",
+           "AverageMeter", "StepTimer"]

@@ -1,0 +1,123 @@
+"""Scalar metrics registry + meters (SURVEY.md §5 metrics row).
+
+The reference has no metrics subsystem — its only observability is the
+``AverageMeter`` stdout meter inside examples (examples/imagenet/
+main_amp.py:~420) and amp's ``maybe_print``. This module is the prescribed
+"small metrics.py (host-callback scalars), already beyond reference":
+
+- ``AverageMeter`` — exact analog of the example's meter (val/avg/sum/count).
+- ``record(name, value)`` — usable INSIDE jitted/sharded code: a
+  ``jax.debug.callback`` ships the scalar to the host registry when the step
+  actually executes (so recording does not force a sync; values arrive in
+  execution order).
+- ``get``/``mean``/``summary``/``clear`` — host-side registry access. Call
+  ``jax.effects_barrier()`` (or block on step outputs) before reading if you
+  need every in-flight step's values.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List
+
+import jax
+
+__all__ = ["AverageMeter", "record", "get", "mean", "summary", "clear",
+           "StepTimer"]
+
+_REGISTRY: Dict[str, List[float]] = collections.defaultdict(list)
+
+
+class AverageMeter:
+    """Reference: examples/imagenet/main_amp.py AverageMeter — running
+    val/sum/count/avg."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val, n: int = 1):
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
+
+
+def _append(name: str, value) -> None:
+    _REGISTRY[name].append(float(value))
+
+
+def record(name: str, value) -> None:
+    """Record a scalar from anywhere — including inside jit/shard_map.
+
+    ``name`` must be a static Python string; ``value`` may be a traced
+    scalar (a host callback delivers it at execution time) or a plain
+    number (recorded immediately).
+    """
+    if isinstance(value, (int, float)):
+        _append(name, value)
+        return
+    jax.debug.callback(lambda v, _n=name: _append(_n, v), value)
+
+
+def get(name: str) -> List[float]:
+    return list(_REGISTRY.get(name, []))
+
+
+def mean(name: str) -> float:
+    vals = _REGISTRY.get(name)
+    if not vals:
+        raise KeyError(f"no recorded values for metric {name!r}")
+    return sum(vals) / len(vals)
+
+
+def summary() -> Dict[str, dict]:
+    """{name: {count, mean, last}} for every recorded metric."""
+    return {
+        name: {"count": len(v), "mean": sum(v) / len(v), "last": v[-1]}
+        for name, v in _REGISTRY.items() if v
+    }
+
+
+def clear(name: str = None) -> None:
+    if name is None:
+        _REGISTRY.clear()
+    else:
+        _REGISTRY.pop(name, None)
+
+
+class StepTimer:
+    """Wall-clock step meter with device-sync discipline (the examples'
+    ``torch.cuda.synchronize()``-before-timing analog): ``observe`` blocks on
+    the step's outputs so the recorded time covers real device work."""
+
+    def __init__(self, name: str = "step_time_ms"):
+        self.name = name
+        self.meter = AverageMeter(name)
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def observe(self, outputs=None):
+        if self._t0 is None:
+            raise RuntimeError("StepTimer.observe() before start()")
+        if outputs is not None:
+            jax.block_until_ready(outputs)
+        dt_ms = (time.perf_counter() - self._t0) * 1e3
+        self.meter.update(dt_ms)
+        _append(self.name, dt_ms)
+        self._t0 = None
+        return dt_ms

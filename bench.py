@@ -9,10 +9,27 @@ step vs naive per-param jitted optax-style update). On ANY failure the line
 is {"metric": ..., "value": 0, "unit": ..., "vs_baseline": 0, "error": "..."}
 — never a bare stack trace (round-1 lesson: BENCH_r01 recorded a crash and
 no number). All diagnostics go to stderr.
+
+Hardening history:
+- round 1: one-shot jax.devices() died on transient UNAVAILABLE → watchdog
+  subprocess probe + retry before in-process init.
+- round 2: probe succeeded, then the FIRST COMPILE died on a transient
+  `remote_compile: Connection refused` — so now the whole build+compile+time
+  block is also retried with backoff, re-probing the tunnel between attempts
+  (the compile server is a separate endpoint from the device tunnel; both
+  flake independently).
+
+Multi-device honesty: the train step is sharded over a `data` mesh of ALL
+local devices (batch split over the mesh, params/opt-state replicated), so
+dividing by n_chips measures genuinely-parallel throughput. On today's
+1-chip env this is the identity; `APEX_TPU_BENCH_PLATFORM=cpu` with
+`XLA_FLAGS=--xla_force_host_platform_device_count=8` exercises the 8-way
+sharded path (tests/test_bench_smoke.py).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 import traceback
@@ -49,56 +66,51 @@ def peak_flops(device) -> float:
     for token, f in PEAK_FLOPS:
         if token in kind:
             return f
+    if device.platform == "cpu":
+        return 1e12  # arbitrary: MFU meaningless on CPU smoke runs
     log(f"unknown device kind {device.device_kind!r}; assuming v5e peak")
     return 197e12
 
 
-def init_backend(retries: int, wait_s: float):
-    """jax.devices() with retries and a hang watchdog.
-
-    Round-1 lessons: (a) a one-shot jax.devices() call died on a transient
-    UNAVAILABLE; (b) the axon plugin's register() sets jax.config
-    jax_platforms at interpreter start, so the JAX_PLATFORMS *env var* is
-    ignored — only jax.config.update can override; (c) when the TPU tunnel
-    is down, the PJRT client claim BLOCKS FOREVER inside a C call that
-    Python cannot interrupt. So: probe backend init in a subprocess with a
-    hard timeout first, and only init in-process once the probe succeeds.
-    """
-    platform = os.environ.get("APEX_TPU_BENCH_PLATFORM")
-    init_timeout = int(os.environ.get("APEX_TPU_BENCH_INIT_TIMEOUT", "420"))
-
-    import subprocess
-
+def _probe_once(platform, timeout_s: int):
+    """Run jax.devices() in a subprocess with a hard timeout (the PJRT claim
+    blocks forever in C when the tunnel is down — uninterruptible in-process)."""
     probe_src = (
         "import os, jax\n"
         + (f"jax.config.update('jax_platforms', {platform!r})\n"
            if platform else "")
         + "ds = jax.devices()\n"
         "print('PROBE_OK', len(ds), ds[0].device_kind, ds[0].platform)\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe_src],
+                           capture_output=True, text=True, timeout=timeout_s)
+        if "PROBE_OK" in r.stdout:
+            return True, r.stdout.strip().splitlines()[-1]
+        return False, f"probe rc={r.returncode}: {r.stderr.strip()[-500:]}"
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung >{timeout_s}s (TPU tunnel down?)"
 
+
+def probe_backend(retries: int, wait_s: float, platform, timeout_s: int):
     last = None
     for attempt in range(1, retries + 1):
         t0 = time.perf_counter()
-        try:
-            r = subprocess.run([sys.executable, "-c", probe_src],
-                               capture_output=True, text=True,
-                               timeout=init_timeout)
-            if "PROBE_OK" in r.stdout:
-                log(f"probe ok after {time.perf_counter()-t0:.1f}s "
-                    f"(attempt {attempt}): {r.stdout.strip().splitlines()[-1]}")
-                break
-            last = RuntimeError(
-                f"probe rc={r.returncode}: {r.stderr.strip()[-500:]}")
-            log(f"backend probe attempt {attempt}/{retries} failed: {last}")
-        except subprocess.TimeoutExpired:
-            last = RuntimeError(
-                f"backend init hung >{init_timeout}s (TPU tunnel down?)")
-            log(f"backend probe attempt {attempt}/{retries}: {last}")
+        ok, msg = _probe_once(platform, timeout_s)
+        if ok:
+            log(f"probe ok after {time.perf_counter()-t0:.1f}s "
+                f"(attempt {attempt}): {msg}")
+            return
+        last = msg
+        log(f"backend probe attempt {attempt}/{retries} failed: {msg}")
         if attempt < retries:
             time.sleep(wait_s)
-    else:
-        raise RuntimeError(
-            f"backend init failed after {retries} attempts: {last}")
+    raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
+
+
+def init_backend(retries: int, wait_s: float):
+    platform = os.environ.get("APEX_TPU_BENCH_PLATFORM")
+    init_timeout = int(os.environ.get("APEX_TPU_BENCH_INIT_TIMEOUT", "420"))
+    probe_backend(retries, wait_s, platform, init_timeout)
 
     import jax
 
@@ -109,6 +121,14 @@ def init_backend(retries: int, wait_s: float):
     log(f"backend up after {time.perf_counter()-t0:.1f}s: "
         f"{len(devs)} x {devs[0].device_kind} ({devs[0].platform})")
     return devs
+
+
+def _is_transient(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return any(tok in s for tok in (
+        "UNAVAILABLE", "Connection refused", "Connection Failed",
+        "remote_compile", "transport", "DEADLINE_EXCEEDED", "Socket closed",
+        "connection reset", "Broken pipe"))
 
 
 def model_flops_per_token(cfg, seq_len: int) -> float:
@@ -184,41 +204,53 @@ def bench_optimizer_speedup(params_like, steps: int = 20) -> float:
     return naive_dt / fused_dt
 
 
-def main():
-    retries = int(os.environ.get("APEX_TPU_BENCH_RETRIES", "4"))
-    wait_s = float(os.environ.get("APEX_TPU_BENCH_RETRY_WAIT", "30"))
-    devs = init_backend(retries, wait_s)
-
+def run_workload(devs, batch_per_chip: int, seq_len: int, steps: int):
+    """Build + shard + compile + time one measurement. Raises on transient
+    backend failures — the caller owns retry policy."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from apex_tpu.models import (BertForPreTraining, bert_large_config,
-                                 make_pretrain_step, synthetic_batch)
+                                 bert_tiny_config, make_pretrain_step,
+                                 synthetic_batch)
     from apex_tpu.optimizers import FusedLAMB
 
-    batch_size = int(os.environ.get("APEX_TPU_BENCH_BATCH", "8"))
-    seq_len = int(os.environ.get("APEX_TPU_BENCH_SEQ", "512"))
-    steps = int(os.environ.get("APEX_TPU_BENCH_STEPS", "10"))
-
-    dev = devs[0]
     n_chips = len(devs)
+    batch_size = batch_per_chip * n_chips
 
-    cfg = bert_large_config(max_position_embeddings=max(512, seq_len))
+    if os.environ.get("APEX_TPU_BENCH_CONFIG") == "tiny":
+        cfg = bert_tiny_config(max_position_embeddings=max(128, seq_len))
+    else:
+        cfg = bert_large_config(max_position_embeddings=max(512, seq_len))
     model = BertForPreTraining(cfg)
     rng = np.random.default_rng(0)
     batch = synthetic_batch(rng, cfg, batch_size, seq_len)
 
-    log("initializing BERT-Large params...")
+    # data-parallel mesh over every local device; batch sharded over it,
+    # params/opt-state replicated — XLA inserts the grad psum (SURVEY §3.3:
+    # apex DDP's bucketed allreduce disappears into GSPMD)
+    mesh = Mesh(np.asarray(devs), ("data",))
+    data_sh = {k: NamedSharding(mesh, P("data", *[None] * (v.ndim - 1)))
+               for k, v in batch.items()}
+    repl = NamedSharding(mesh, P())
+    batch = {k: jax.device_put(v, data_sh[k]) for k, v in batch.items()}
+
+    log("initializing BERT params...")
     params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
                         batch["token_type_ids"], batch["attention_mask"])["params"]
+    params = jax.device_put(params, repl)
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    log(f"params: {n_params/1e6:.1f}M")
+    log(f"params: {n_params/1e6:.1f}M  batch={batch_size} ({batch_per_chip}/chip"
+        f" x {n_chips} chips)  seq={seq_len}")
 
     step = make_pretrain_step(model)
     opt = FusedLAMB(
         params, lr=1e-4, weight_decay=0.01,
         exclude_from_weight_decay=lambda n: "bias" in n or "norm" in n.lower())
+    opt.master = jax.device_put(opt.master, repl)
+    opt.state = {k: jax.device_put(v, repl) for k, v in opt.state.items()}
 
     def train_step(p, i):
         loss, grads = step(p, batch, i)
@@ -232,6 +264,10 @@ def main():
     loss, params = train_step(params, 1)
     jax.block_until_ready(params)
 
+    # verify the step really ran sharded (the smoke test asserts this key)
+    x = batch["input_ids"]
+    n_shards = len({s.device.id for s in x.addressable_shards})
+
     log(f"timing {steps} steps...")
     t0 = time.perf_counter()
     for i in range(steps):
@@ -242,19 +278,63 @@ def main():
     tokens = batch_size * seq_len
     tok_per_sec_chip = tokens / dt / n_chips
     flops = model_flops_per_token(cfg, seq_len) * tokens
-    mfu = flops / dt / (peak_flops(dev) * n_chips)
+    mfu = flops / dt / (peak_flops(devs[0]) * n_chips)
     log(f"step {dt*1e3:.1f}ms  loss={float(loss):.3f}  "
         f"tokens/s/chip={tok_per_sec_chip:.0f}  MFU={mfu*100:.1f}%")
+    return dict(tok_per_sec_chip=tok_per_sec_chip, mfu=mfu, dt=dt,
+                params=params, n_shards=n_shards, n_chips=n_chips,
+                device=devs[0])
+
+
+def main():
+    retries = int(os.environ.get("APEX_TPU_BENCH_RETRIES", "4"))
+    wait_s = float(os.environ.get("APEX_TPU_BENCH_RETRY_WAIT", "30"))
+    devs = init_backend(retries, wait_s)
+
+    batch_per_chip = int(os.environ.get("APEX_TPU_BENCH_BATCH", "8"))
+    seq_len = int(os.environ.get("APEX_TPU_BENCH_SEQ", "512"))
+    steps = int(os.environ.get("APEX_TPU_BENCH_STEPS", "10"))
+    compile_retries = int(os.environ.get("APEX_TPU_BENCH_COMPILE_RETRIES", "5"))
+    platform = os.environ.get("APEX_TPU_BENCH_PLATFORM")
+    init_timeout = int(os.environ.get("APEX_TPU_BENCH_INIT_TIMEOUT", "420"))
+
+    # round-2 failure mode: probe ok, then the first compile hit a transient
+    # `remote_compile: Connection refused`. Retry the whole workload with
+    # exponential backoff, re-probing the tunnel between attempts.
+    result = None
+    last = None
+    for attempt in range(1, compile_retries + 1):
+        try:
+            result = run_workload(devs, batch_per_chip, seq_len, steps)
+            break
+        except Exception as e:  # noqa: BLE001
+            if not _is_transient(e):
+                raise
+            last = e
+            backoff = min(wait_s * (2 ** (attempt - 1)), 240.0)
+            log(f"workload attempt {attempt}/{compile_retries} hit transient "
+                f"backend error: {type(e).__name__}: {e}\n"
+                f"backing off {backoff:.0f}s then re-probing...")
+            if attempt < compile_retries:
+                time.sleep(backoff)
+                try:
+                    probe_backend(2, wait_s, platform, init_timeout)
+                except RuntimeError as pe:
+                    log(f"re-probe failed ({pe}); retrying anyway")
+    if result is None:
+        raise RuntimeError(
+            f"workload failed after {compile_retries} attempts: {last}")
 
     try:
-        opt_speedup = bench_optimizer_speedup(params)
-    except Exception as e:  # noqa: BLE001
+        opt_speedup = bench_optimizer_speedup(result["params"])
+    except Exception:  # noqa: BLE001
         log("optimizer microbench failed:", traceback.format_exc())
         opt_speedup = None
 
-    emit(tok_per_sec_chip, "tokens/s/chip", mfu / 0.45,
-         mfu=round(mfu, 4), step_ms=round(dt * 1e3, 2),
-         device=dev.device_kind, n_chips=n_chips,
+    emit(result["tok_per_sec_chip"], "tokens/s/chip", result["mfu"] / 0.45,
+         mfu=round(result["mfu"], 4), step_ms=round(result["dt"] * 1e3, 2),
+         device=result["device"].device_kind, n_chips=result["n_chips"],
+         n_data_shards=result["n_shards"],
          optimizer_speedup=(round(opt_speedup, 3)
                             if opt_speedup is not None else None))
 

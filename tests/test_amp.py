@@ -179,3 +179,82 @@ def test_fp16_utils():
     assert fo.loss_scale == 2.0 ** 16
     out = fo.step(jax.tree.map(jnp.ones_like, p))
     assert jax.tree.structure(out) == jax.tree.structure(p)
+
+
+def test_multi_loss_single_optimizer_dynamic():
+    """Reference: handle.py scale_loss(loss, opt, loss_id=i) with
+    num_losses=2 on ONE optimizer — per-loss scalers diverge (one overflows
+    and halves, the other grows), the step skips on the union found-inf, and
+    a clean combined step matches the plain two-loss update."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2, weight_decay=0.0)
+    _, opt = amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                            loss_scale="dynamic", num_losses=2)
+    # multi-loss path: no scaler fused into the step
+    assert opt._amp_scaler is None
+    s0, s1 = amp._loss_scalers
+    scale0, scale1 = float(s0.state.scale), float(s1.state.scale)
+
+    with amp.scale_loss(jnp.float32(1.0), opt, loss_id=1) as sl:
+        assert float(sl) == scale1
+
+    # ---- clean step: grads of each SCALED loss, combined ----
+    g0 = {"w": jnp.full((4, 4), 0.25) * scale0}
+    g1 = {"w": jnp.full((4, 4), 0.25) * scale1}
+    grads, noop = amp.unscale_and_combine([g0, g1])
+    assert float(noop) == 0.0
+    np.testing.assert_allclose(np.asarray(grads["w"], np.float32), 0.5,
+                               rtol=1e-6)
+    out = opt.step(grads, noop=noop)
+    m, v = 0.1 * 0.5, 0.001 * 0.25
+    want = 1.0 - 1e-2 * (m / (1 - 0.9)) / (np.sqrt(v / (1 - 0.999)) + 1e-8)
+    np.testing.assert_allclose(np.asarray(out["w"], np.float32), want,
+                               rtol=1e-3)
+
+    # ---- loss 0 overflows: ITS scaler halves, loss 1's grows; step skips ----
+    g0_inf = {"w": jnp.full((4, 4), jnp.inf)}
+    g1_ok = {"w": jnp.full((4, 4), 0.1) * float(s1.state.scale)}
+    step_before = int(opt.step_count)
+    master_before = np.asarray(opt.master)
+    grads, noop = amp.unscale_and_combine([g0_inf, g1_ok])
+    assert float(noop) == 1.0
+    out = opt.step(grads, noop=noop)
+    assert float(s0.state.scale) == scale0 / 2          # overflow backoff
+    assert float(s1.state.scale) == float(scale1)       # clean: unchanged
+    assert int(s1.state.growth_tracker) == 2            # two clean steps
+    assert int(opt.step_count) == step_before           # skipped
+    np.testing.assert_allclose(np.asarray(opt.master), master_before)
+
+
+def test_multi_loss_scaler_growth_divergence():
+    """After scale_window clean steps on loss 1 only, its scale doubles
+    while loss 0's (halved by an earlier inf) stays put."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2)
+    _, opt = amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                            loss_scale="dynamic", num_losses=2)
+    s0, s1 = amp._loss_scalers
+    s0.state = s0.state._replace(scale=jnp.float32(1024.0))
+    s1.state = s1.state._replace(
+        scale=jnp.float32(2048.0),
+        growth_tracker=jnp.int32(s1._scale_window - 1))
+    g = {"w": jnp.ones((4, 4))}
+    _, noop = amp.unscale_and_combine(
+        [{"w": g["w"] * 1024.0}, {"w": g["w"] * 2048.0}])
+    assert float(noop) == 0.0
+    assert float(s0.state.scale) == 1024.0
+    assert float(s1.state.scale) == 4096.0   # grew on its own window
+
+
+def test_multi_loss_static_scale_rejects_unscale_and_combine():
+    """Static-scale multi-loss keeps the fused in-step unscale (the scaler
+    stays attached); unscale_and_combine must refuse rather than silently
+    unscale twice."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2)
+    _, opt = amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                            loss_scale=256.0, num_losses=2)
+    assert opt._amp_scaler is not None   # fused unscale stays attached
+    with pytest.raises(RuntimeError, match="static"):
+        amp.unscale_and_combine([{"w": jnp.ones((4, 4))},
+                                 {"w": jnp.ones((4, 4))}])

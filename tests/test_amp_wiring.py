@@ -16,6 +16,7 @@ from apex_tpu import amp
 from apex_tpu.mesh import MODEL_AXIS
 
 
+@pytest.mark.slow
 def test_o1_flips_bert_activation_dtype():
     """O1 initialize changes activation dtypes with NO config change."""
     from apex_tpu.models import BertForPreTraining, bert_tiny_config
@@ -35,6 +36,7 @@ def test_o1_flips_bert_activation_dtype():
     assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(params))
 
 
+@pytest.mark.slow
 def test_o1_flips_mlp_and_fused_dense_dtype():
     from apex_tpu.fused_dense import FusedDenseGeluDense
     from apex_tpu.mlp import MLP

@@ -1,0 +1,47 @@
+"""bench.py smoke: the measurement path must shard over every local device.
+
+VERDICT r2 weakness #4: throughput divided by n_chips while the step ran on
+one device. This runs bench.py as a subprocess on an 8-virtual-CPU-device
+platform with the tiny BERT config and asserts the emitted JSON proves the
+batch was split 8 ways (n_data_shards == n_chips == 8) with a nonzero
+throughput — i.e. per-chip numbers come from a genuinely sharded step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_sharded_over_8_cpu_devices():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=8").strip(),
+        "APEX_TPU_BENCH_PLATFORM": "cpu",
+        "APEX_TPU_BENCH_CONFIG": "tiny",
+        "APEX_TPU_BENCH_BATCH": "2",      # per chip -> global batch 16
+        "APEX_TPU_BENCH_SEQ": "64",
+        "APEX_TPU_BENCH_STEPS": "2",
+        "APEX_TPU_BENCH_RETRIES": "1",
+        "APEX_TPU_BENCH_COMPILE_RETRIES": "1",
+        "APEX_TPU_BENCH_INIT_TIMEOUT": "120",
+    })
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, timeout=600, env=env,
+                       cwd=REPO)
+    line = r.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert "error" not in rec, f"bench failed: {rec}\nstderr: {r.stderr[-2000:]}"
+    assert rec["n_chips"] == 8
+    assert rec["n_data_shards"] == 8, (
+        "batch not sharded over the device mesh — per-chip throughput would "
+        f"be fictional: {rec}")
+    assert rec["value"] > 0

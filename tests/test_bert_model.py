@@ -32,6 +32,7 @@ def tiny_setup(rng):
     return cfg, model, params, batch
 
 
+@pytest.mark.slow
 def test_forward_shapes(tiny_setup):
     cfg, model, params, batch = tiny_setup
     mlm, nsp = model.apply({"params": params}, batch["input_ids"],
@@ -42,6 +43,7 @@ def test_forward_shapes(tiny_setup):
     assert jnp.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_train_loss_decreases(tiny_setup):
     cfg, model, params, batch = tiny_setup
     step = make_pretrain_step(model)
@@ -54,6 +56,7 @@ def test_train_loss_decreases(tiny_setup):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_mesh_dp_tp_step_matches_single_device(tiny_setup):
     """TP x DP sharded grad step == replicated grad step (the reference's
     universal distributed-test pattern, SURVEY.md §4)."""

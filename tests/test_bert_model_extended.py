@@ -13,6 +13,8 @@ from apex_tpu.models import (BertForPreTraining, bert_tiny_config,
                              make_pretrain_step, synthetic_batch)
 from apex_tpu.optimizers import FusedLAMB
 
+pytestmark = pytest.mark.slow
+
 
 def test_bert_through_amp_initialize_o2(rng):
     """amp O2: params cast to bf16 (norms fp32), optimizer returns cast

@@ -13,6 +13,7 @@ from apex_tpu.mesh import CONTEXT_AXIS
 
 
 @pytest.mark.parametrize("stride,cin,cout", [(1, 32, 32), (2, 32, 64)])
+@pytest.mark.slow
 def test_spatial_bottleneck_matches_dense(rng, stride, cin, cout):
     from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
     from apex_tpu.transformer import parallel_state
@@ -40,6 +41,7 @@ def test_spatial_bottleneck_matches_dense(rng, stride, cin, cout):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_bottleneck_residual_paths(rng):
     from apex_tpu.contrib.bottleneck import Bottleneck
 

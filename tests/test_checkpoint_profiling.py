@@ -7,6 +7,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+@pytest.mark.slow
 def test_save_restore_roundtrip(tmp_path):
     from apex_tpu.utils import restore_checkpoint, save_checkpoint
 

@@ -13,6 +13,8 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.mesh import DATA_AXIS
 
+pytestmark = pytest.mark.slow
+
 
 def make_params(rng, n_tensors=5):
     shapes = [(64, 33), (129,), (7, 5, 3), (1024,), (300, 2)][:n_tensors]

@@ -1,0 +1,101 @@
+"""§5 tail: donation/aliasing regression + the metrics registry.
+
+SURVEY.md §5 race-detection row: XLA owns device-side ordering, but
+host-side donation bugs (reusing a buffer the jitted step consumed via
+``donate_argnums``/``input_output_aliases``) are the one async failure mode
+left — keep a regression test for them. The fused optimizers donate their
+flat master/state buffers every step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.utils import metrics
+
+
+def _params():
+    return {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+
+
+def test_donated_master_buffer_is_dead_after_step():
+    """step() donates the flat master/state buffers; a caller that kept a
+    reference must get a loud RuntimeError, not silently stale data."""
+    opt = FusedAdam(_params(), lr=1e-2)
+    master_before = opt.master
+    state_before = opt.state["m"]
+    opt.step(jax.tree.map(jnp.ones_like, _params()))
+    assert master_before.is_deleted()
+    assert state_before.is_deleted()
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(master_before)
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(state_before)
+
+
+def test_three_donated_steps_match_undonated_oracle():
+    """Repeated donation must not corrupt state: 3 fused steps == 3 steps of
+    a plain undonated jnp adam on the same schedule."""
+    opt = FusedAdam(_params(), lr=1e-2, weight_decay=0.0)
+    g = {"w": jnp.full((8, 8), 0.3), "b": jnp.full((8,), -0.1)}
+    for _ in range(3):
+        out = opt.step(g)
+
+    def oracle():
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-2
+        p = {k: np.asarray(v, np.float64) for k, v in _params().items()}
+        m = {k: np.zeros_like(v) for k, v in p.items()}
+        v = {k: np.zeros_like(x) for k, x in p.items()}
+        for t in range(1, 4):
+            for k in p:
+                gk = np.asarray(g[k], np.float64)
+                m[k] = b1 * m[k] + (1 - b1) * gk
+                v[k] = b2 * v[k] + (1 - b2) * gk * gk
+                mhat = m[k] / (1 - b1 ** t)
+                vhat = v[k] / (1 - b2 ** t)
+                p[k] = p[k] - lr * mhat / (np.sqrt(vhat) + eps)
+        return p
+
+    want = oracle()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(out[k], np.float64), want[k],
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_metrics_record_inside_jit():
+    metrics.clear()
+
+    @jax.jit
+    def step(x):
+        y = (x ** 2).sum()
+        metrics.record("loss", y)
+        return y
+
+    for i in range(3):
+        step(jnp.full((4,), float(i))).block_until_ready()
+    jax.effects_barrier()
+    vals = metrics.get("loss")
+    assert vals == [0.0, 4.0, 16.0], vals
+    assert metrics.mean("loss") == pytest.approx(20.0 / 3)
+    s = metrics.summary()["loss"]
+    assert s["count"] == 3 and s["last"] == 16.0
+    metrics.clear("loss")
+    assert metrics.get("loss") == []
+
+
+def test_average_meter_and_step_timer():
+    m = metrics.AverageMeter("acc")
+    m.update(1.0, n=2)
+    m.update(4.0)
+    assert m.count == 3 and m.avg == pytest.approx(2.0) and m.val == 4.0
+
+    metrics.clear()
+    t = metrics.StepTimer("t_ms")
+    t.start()
+    out = jax.jit(lambda x: x * 2)(jnp.ones((16,)))
+    dt = t.observe(out)
+    assert dt > 0 and metrics.get("t_ms") == [dt]
+    with pytest.raises(RuntimeError):
+        t.observe()

@@ -8,7 +8,7 @@ import os
 
 import pytest
 
-pytestmark = pytest.mark.example
+pytestmark = [pytest.mark.example, pytest.mark.slow]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

@@ -51,6 +51,7 @@ def test_grads_match_reference(rng, causal):
         np.testing.assert_allclose(a, b_, atol=5e-5, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_bias_and_cross_attention(rng):
     b, h, sq, sk, d = 2, 2, 40, 88, 32
     q, k, v = _qkv(rng, b, h, sq, sk, d, jnp.float32)
@@ -96,6 +97,7 @@ def _np_keep(bh, s1, s2, rate, seed):
     return (x >= thr).astype(np.float32) / (1.0 - rate)
 
 
+@pytest.mark.slow
 def test_dropout_exact_vs_explicit_mask(rng):
     """Fwd AND bwd must equal an explicitly-masked softmax with the same
     keep mask (reference: fused softmax-dropout in fast_multihead_attn)."""

@@ -17,6 +17,8 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu.mesh import MODEL_AXIS
 from apex_tpu.models.gpt import GPTModel, gpt_loss, gpt_tiny_config
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def tp4_mesh():

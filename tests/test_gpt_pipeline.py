@@ -20,6 +20,8 @@ from apex_tpu.models.gpt_pipeline import (
     split_gpt_params_for_pipeline,
 )
 
+pytestmark = pytest.mark.slow
+
 
 def _shard_tree(params1, params_tp_shape, rank, tp):
     """Slice a tp=1 GPT param tree into rank's tp shard (see

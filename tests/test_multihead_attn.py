@@ -21,6 +21,7 @@ def _mk(module_cls, rng_key, x, **kwargs):
 
 @pytest.mark.parametrize("bias", [False, True])
 @pytest.mark.parametrize("include_norm_add", [False, True])
+@pytest.mark.slow
 def test_self_fast_vs_default(rng, bias, include_norm_add):
     s, b, e = 24, 3, 64
     x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)

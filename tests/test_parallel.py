@@ -19,6 +19,7 @@ from apex_tpu.mesh import DATA_AXIS
 
 # --- SyncBatchNorm -----------------------------------------------------------
 
+@pytest.mark.slow
 def test_syncbn_matches_batchnorm_on_gathered_batch(mesh8, rng):
     """The canonical reference check (two_gpu_unit_test.py): SyncBN over N
     shards == plain BN over the concatenated batch."""
@@ -51,6 +52,7 @@ def test_syncbn_matches_batchnorm_on_gathered_batch(mesh8, rng):
         np.asarray(ref_state["batch_stats"]["var"]), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_syncbn_backward_matches_gathered(mesh8, rng):
     from apex_tpu.parallel import SyncBatchNorm
 

@@ -1,0 +1,108 @@
+"""True-1F1B schedule: parity with the autodiff oracle + O(S) memory.
+
+VERDICT r2 next-round #3: peak pipeline activation memory must scale with
+the stage count S, not the microbatch count M. The explicit 1F1B
+implementation keeps a [2(S-1)+1, act] ring of in-flight stage inputs and
+never differentiates through the tick scan, so XLA's reported peak for the
+whole fwd+bwd step must stay ~flat as M grows 8 -> 32; the autodiff
+formulation retains one stage-input residual per tick (O(M)) and is the
+contrast case.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import STAGE_AXIS
+
+from tests.test_pipeline_parallel import (
+    D, loss_fn, make_params, reference_loss_and_grads, stage_fn)
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture
+def pp4_mesh():
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(1, 4)
+
+
+def build_run(mesh, implementation):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        p = jax.tree.map(lambda t: t[0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, p, mb, loss_aux=lb,
+                              implementation=implementation)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None], grads)
+
+    return run
+
+
+def test_1f1b_matches_autodiff_and_reference(pp4_mesh, rng):
+    m = 8
+    params4 = make_params(rng, 4)
+    mbs = jnp.asarray(rng.standard_normal((m, 4, D), np.float32))
+    labels = jnp.asarray(rng.standard_normal((m, 4, D), np.float32))
+
+    ref_loss, ref_grads = reference_loss_and_grads(params4, mbs, labels)
+    loss_e, grads_e = build_run(pp4_mesh, "1f1b")(params4, mbs, labels)
+    loss_a, grads_a = build_run(pp4_mesh, "autodiff")(params4, mbs, labels)
+
+    np.testing.assert_allclose(np.asarray(loss_e), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        grads_e, ref_grads)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        grads_e, grads_a)
+
+
+def _peak_temp_bytes(mesh, implementation, m, width=256):
+    """XLA-reported temp allocation for one pipelined fwd+bwd step."""
+    run = build_run(mesh, implementation)
+    params4 = {
+        "w": jnp.zeros((4, width, width), jnp.float32),
+        "b": jnp.zeros((4, width), jnp.float32),
+    }
+    mbs = jax.ShapeDtypeStruct((m, 4, width), jnp.float32)
+    lbs = jax.ShapeDtypeStruct((m, 4, width), jnp.float32)
+    compiled = (jax.jit(run)
+                .lower(jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params4),
+                    mbs, lbs)
+                .compile())
+    ma = compiled.memory_analysis()
+    if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+        pytest.skip("backend does not report memory analysis")
+    return ma.temp_size_in_bytes
+
+
+@pytest.mark.parametrize("width", [256])
+def test_1f1b_memory_flat_in_microbatch_count(pp4_mesh, width):
+    """The reference 1F1B contract: activations in flight ~ S, not M."""
+    small = _peak_temp_bytes(pp4_mesh, "1f1b", m=8, width=width)
+    big = _peak_temp_bytes(pp4_mesh, "1f1b", m=32, width=width)
+    # 4x the microbatches must not cost meaningfully more temp memory
+    assert big <= small * 1.35 + (1 << 20), (small, big)
+
+    # contrast: the autodiff formulation's residuals grow ~linearly with M
+    a_small = _peak_temp_bytes(pp4_mesh, "autodiff", m=8, width=width)
+    a_big = _peak_temp_bytes(pp4_mesh, "autodiff", m=32, width=width)
+    assert a_big >= a_small * 1.7, (a_small, a_big)
+    # and at M=32 the 1F1B peak undercuts autodiff
+    assert big < a_big, (big, a_big)

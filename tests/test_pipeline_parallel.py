@@ -57,6 +57,7 @@ def reference_loss_and_grads(params4, microbatches, labels):
     return jax.value_and_grad(full_loss)(params4)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential(pp4_mesh, rng):
     from apex_tpu.transformer.pipeline_parallel import (
         forward_backward_pipelining_without_interleaving as fwd_bwd)

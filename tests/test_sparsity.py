@@ -35,6 +35,7 @@ def test_create_mask_is_2_4_and_keeps_top2(rng):
         assert kept.min() >= dropped.max() - 1e-7
 
 
+@pytest.mark.slow
 def test_masks_on_bert_param_tree():
     """Masks verified 2:4 on a BERT param tree (VERDICT done-criterion)."""
     from apex_tpu.contrib.sparsity import ASP

@@ -54,6 +54,7 @@ def test_column_parallel_linear_matches_dense(tp4_mesh, rng):
     assert not np.allclose(w4[0], w4[1])
 
 
+@pytest.mark.slow
 def test_column_parallel_linear_grad_matches_dense(tp4_mesh, rng):
     from apex_tpu.transformer.tensor_parallel import ColumnParallelLinear
 
@@ -113,6 +114,7 @@ def test_column_parallel_linear_grad_matches_dense(tp4_mesh, rng):
 
 # --- RowParallelLinear -------------------------------------------------------
 
+@pytest.mark.slow
 def test_row_parallel_linear_matches_dense(tp4_mesh, rng):
     from apex_tpu.transformer.tensor_parallel import RowParallelLinear
 
@@ -133,6 +135,7 @@ def test_row_parallel_linear_matches_dense(tp4_mesh, rng):
     np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_column_into_row_matches_mlp(tp4_mesh, rng):
     """The Megatron pair: CPL(gather_output=False) -> RPL(input_is_parallel)
     == dense 2-layer MLP (the reference's canonical usage)."""
@@ -161,6 +164,7 @@ def test_column_into_row_matches_mlp(tp4_mesh, rng):
     np.testing.assert_allclose(np.asarray(y), expect, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_roundtrip(tp4_mesh, rng):
     """CPL(sequence_parallel) -> RPL(sequence_parallel): activations enter
     and leave sharded over sequence; result == dense."""
@@ -191,6 +195,7 @@ def test_sequence_parallel_roundtrip(tp4_mesh, rng):
 
 # --- VocabParallelEmbedding --------------------------------------------------
 
+@pytest.mark.slow
 def test_vocab_parallel_embedding(tp4_mesh, rng):
     from apex_tpu.transformer.tensor_parallel import VocabParallelEmbedding
 
@@ -235,6 +240,7 @@ def test_vocab_parallel_cross_entropy(tp4_mesh, rng, smoothing):
     np.testing.assert_allclose(np.asarray(loss), ref, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_vocab_parallel_cross_entropy_grad(tp4_mesh, rng):
     from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
 

@@ -1,0 +1,141 @@
+"""ZeRO + PP + TP composed in ONE jitted step (VERDICT r2 next-round #4).
+
+MULTICHIP_r02 proved dp x cp x tp, ZeRO-over-dp, tp, and pp x tp separately;
+this runs the real-model GPT pipeline (stage-partitioned decoder, embedding
+preprocess, tied head + vocab-parallel CE) with tp=2 Megatron collectives
+INSIDE each stage, dp=2 data sharding, and the DistributedFusedAdam ZeRO
+update (psum_scatter grads over dp -> local row-shard Adam -> all-gather
+params) — all in a single shard_map program on the 8-device CPU mesh.
+
+Oracle: the dp-averaged stage grads fed to the single-rank FusedAdam facade
+must reproduce the ZeRO-updated params on every (stage, tp) coordinate.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
+from apex_tpu.ops import flat_buffer
+from apex_tpu.ops.flat_buffer import LANE
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture
+def mesh_dp2_pp2_tp2():
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(2, 2)
+
+
+def _build_stacked_gpt(tp, pp):
+    """[S, TP, ...] stacked pipeline+TP param layout (dryrun recipe)."""
+    from __graft_entry__ import _slice_tp_tree
+
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+    from apex_tpu.models.gpt_pipeline import split_gpt_params_for_pipeline
+
+    n_layers = 2 * pp
+    cfg1 = gpt_tiny_config(tensor_parallel_size=1, num_layers=n_layers)
+    cfg = gpt_tiny_config(tensor_parallel_size=tp, num_layers=n_layers)
+    rng = np.random.default_rng(0)
+    ids0 = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+
+    v1 = GPTModel(cfg1).init(jax.random.PRNGKey(0), ids0)["params"]
+    v_tp_shape = jax.eval_shape(
+        lambda: GPTModel(cfg).init(jax.random.PRNGKey(0), ids0))["params"]
+    per_rank = []
+    for r in range(tp):
+        tp_tree = _slice_tp_tree(v1, v_tp_shape, r, tp)
+        per_rank.append(split_gpt_params_for_pipeline(tp_tree, pp, n_layers))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *per_rank)
+    stacked = {"blocks": jax.tree.map(lambda t: t[:, :, 0], stacked["blocks"]),
+               "shared": stacked["shared"]}
+    return cfg, stacked
+
+
+def test_zero_pp_tp_one_step(mesh_dp2_pp2_tp2, rng):
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.models.gpt_pipeline import make_gpt_pipeline_fns
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    mesh = mesh_dp2_pp2_tp2
+    tp = pp = dp = 2
+    cfg, stacked = _build_stacked_gpt(tp, pp)
+    first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg)
+
+    m, b, s = 4, 4, 16
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+
+    local_template = jax.tree.map(lambda t: t[0, 0], stacked)
+    opt = DistributedFusedAdam(local_template, lr=1e-3, weight_decay=0.0,
+                               mesh=mesh)
+    shard_rows, padded_rows = opt.shard_rows, opt.padded_rows
+    spec = opt.spec
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS, MODEL_AXIS), P(None, DATA_AXIS), P(None, DATA_AXIS)),
+        out_specs=(P(),                                      # loss (replicated)
+                   P(DATA_AXIS, STAGE_AXIS, MODEL_AXIS),     # grads per dp rank
+                   P(DATA_AXIS, STAGE_AXIS, MODEL_AXIS),     # updated params
+                   P(STAGE_AXIS, MODEL_AXIS, DATA_AXIS, None)),  # master shard
+        check_vma=False)
+    def step(p_stacked, mb, lb):
+        local = jax.tree.map(lambda t: t[0, 0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, local, mb, loss_aux=lb,
+                              first_fn=first_fn, loss_with_params=True)
+        # ZeRO state bootstrap for the single tested step: this rank's row
+        # shard of the flat master + zero moments
+        flat = flat_buffer.flatten(local, spec)
+        pad = padded_rows - spec.total_rows
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad, LANE), jnp.float32)])
+        r = lax.axis_index(DATA_AXIS)
+        master0 = lax.dynamic_slice_in_dim(flat, r * shard_rows, shard_rows)
+        zeros = jnp.zeros((shard_rows, LANE), jnp.float32)
+        new_params, new_master, _, _, _ = opt.shard_step(
+            grads, master0, {"m": zeros, "v": zeros}, jnp.zeros((), jnp.int32))
+        loss = lax.pmean(loss, DATA_AXIS)
+        expand2 = lambda t: t[None, None]       # noqa: E731
+        expand3 = lambda t: t[None, None, None]  # noqa: E731
+        return (loss,
+                jax.tree.map(expand3, grads),
+                jax.tree.map(expand3, new_params),
+                new_master[None, None])
+
+    loss, grads_dp, params_dp, master = jax.jit(step)(stacked, mbs, labels)
+    jax.block_until_ready(params_dp)
+
+    assert np.isfinite(float(loss)), float(loss)
+    # master state is genuinely row-sharded: [S, TP, dp*shard_rows, LANE]
+    assert master.shape == (pp, tp, padded_rows, LANE)
+
+    # the all-gathered params must agree across the two dp ranks
+    jax.tree.map(
+        lambda t: np.testing.assert_allclose(
+            np.asarray(t[0]), np.asarray(t[1]), rtol=1e-6, atol=1e-7),
+        params_dp)
+
+    # oracle: per (stage, tp) coordinate, FusedAdam on the dp-mean grads
+    for si in range(pp):
+        for ri in range(tp):
+            local_p = jax.tree.map(lambda t: t[si, ri], stacked)
+            g_mean = jax.tree.map(
+                lambda t: (t[0, si, ri] + t[1, si, ri]) / 2.0, grads_dp)
+            ref_opt = FusedAdam(local_p, lr=1e-3, weight_decay=0.0)
+            ref_params = ref_opt.step(g_mean)
+            got = jax.tree.map(lambda t: t[0, si, ri], params_dp)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+                got, ref_params)
