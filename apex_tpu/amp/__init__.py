@@ -122,6 +122,12 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                     and not multi_loss_dynamic_single_opt
                     and (scaler.dynamic or float(scaler.state.scale) != 1.0)):
                 opt.attach_amp_scaler(scaler)
+            if multi_loss_dynamic_single_opt:
+                # no scaler is fused into step, so a caller skipping the
+                # unscale_and_combine protocol would apply ~2**16-scaled
+                # grads silently; the noop kwarg is the protocol's receipt,
+                # and the optimizer refuses to step without it
+                opt._amp_require_noop = True
             # O2/O3: the optimizer must hand back params in the cast dtypes
             if hasattr(opt, "set_output_dtypes") and policy.param_dtype != jnp.float32:
                 model_idx = min(i, len(model_list) - 1)
@@ -175,6 +181,13 @@ def unscale_and_combine(grads_list, loss_ids=None):
         range(len(grads_list)))
     if len(ids) != len(grads_list):
         raise ValueError("loss_ids must match grads_list length")
+    if not _loss_scalers:
+        # amp disabled / uninitialized: keep call sites working like
+        # scale_loss does — no scaling happened, so just sum
+        total = grads_list[0]
+        for g in grads_list[1:]:
+            total = jax.tree.map(jnp.add, total, g)
+        return total, jnp.zeros((), jnp.float32)
     scalers = tuple(_loss_scalers[i] for i in ids)
     if not any(s.dynamic for s in scalers):
         # with a STATIC loss_scale, initialize() fused the (single, shared)

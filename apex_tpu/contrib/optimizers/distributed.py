@@ -216,6 +216,12 @@ class DistributedFusedOptimizerBase:
             raise ValueError(
                 f"grad pytree structure {gdef} does not match the parameter "
                 f"structure this optimizer was built with ({self.spec.treedef})")
+        if getattr(self, "_amp_require_noop", False) and noop is None:
+            raise RuntimeError(
+                "this optimizer was initialized by amp with multiple "
+                "dynamically-scaled losses: combine grads with "
+                "amp.unscale_and_combine and call "
+                "step(grads, noop=noop)")
         if self._jit_step is None:
             def _pure(g_tree, master, state, step, gs, noop_, sstate):
                 def body(g_tree, master_s, state_s, step, gs, noop_, sstate):

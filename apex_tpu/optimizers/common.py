@@ -150,6 +150,16 @@ class FusedOptimizerBase:
                 f"grad pytree structure {gdef} does not match the parameter "
                 f"structure this optimizer was built with ({self.spec.treedef})"
             )
+        if getattr(self, "_amp_require_noop", False) and noop is None:
+            # amp multi-loss dynamic mode: grads MUST come through
+            # amp.unscale_and_combine (per-loss unscale + union found-inf);
+            # its noop flag is the receipt — without it the grads are still
+            # multiplied by the per-loss scales
+            raise RuntimeError(
+                "this optimizer was initialized by amp with multiple "
+                "dynamically-scaled losses: combine grads with "
+                "amp.unscale_and_combine and call "
+                "step(grads, noop=noop)")
         if self._jit_step is None:
             spec = self.spec
             seg_rows = self.seg_rows

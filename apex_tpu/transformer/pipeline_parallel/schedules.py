@@ -107,6 +107,52 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     return ys[n_stages - 1:]
 
 
+def _make_head_loss(loss_fn, loss_with_params, has_aux):
+    """Uniform last-stage loss call over the (params?, aux?) signatures."""
+    def head_loss(p, y, aux):
+        if loss_with_params:
+            return loss_fn(p, y, aux) if has_aux else loss_fn(p, y)
+        return loss_fn(y, aux) if has_aux else loss_fn(y)
+    return head_loss
+
+
+def _make_bwd_branches(stage_fn, entry, head_loss, zero_dp, zero_dx,
+                       act_dtype):
+    """The four per-tick backward branches shared by both 1F1B schedules.
+
+    Uniform signature ``(pb, x_saved, dy, mb_raw, aux) -> (dp, dx, loss)``
+    where ``pb`` is the params the slot differentiates against (the full
+    stage tree for the non-interleaved schedule; one chunk's tree for the
+    interleaved one). Each branch re-linearizes the stage from its saved
+    input (``jax.vjp`` on the spot — the reference's
+    deallocate_output_tensor + recompute discipline).
+    """
+    def bwd_dead(pb, x_saved, dy, mb_raw, aux):
+        return zero_dp, zero_dx, jnp.zeros((), jnp.float32)
+
+    def bwd_first(pb, x_saved, dy, mb_raw, aux):
+        # the first (virtual) stage recomputes through the embedding/
+        # preprocess so entry's param grads flow; its input cotangent has
+        # nowhere to go
+        y, vjp = jax.vjp(lambda p: stage_fn(p, entry(p, mb_raw)), pb)
+        (dp,) = vjp(dy.astype(y.dtype))
+        return dp, zero_dx, jnp.zeros((), jnp.float32)
+
+    def bwd_mid(pb, x_saved, dy, mb_raw, aux):
+        y, vjp = jax.vjp(stage_fn, pb, x_saved)
+        dp, dx = vjp(dy.astype(y.dtype))
+        return dp, dx.astype(act_dtype), jnp.zeros((), jnp.float32)
+
+    def bwd_last(pb, x_saved, dy, mb_raw, aux):
+        # fwd + loss head + bwd in one vjp, seeded by the scalar loss
+        def f(p, x):
+            return head_loss(p, stage_fn(p, x), aux)
+        loss, (dp, dx) = jax.value_and_grad(f, argnums=(0, 1))(pb, x_saved)
+        return dp, dx.astype(act_dtype), loss.astype(jnp.float32)
+
+    return (bwd_dead, bwd_first, bwd_mid, bwd_last)
+
+
 def _fwd_bwd_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
                   microbatches, loss_aux, axis_name: str,
                   first_fn: Optional[Callable], loss_with_params: bool):
@@ -136,9 +182,6 @@ def _fwd_bwd_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     """
     s = lax.axis_index(axis_name)
     n_stages = lax.axis_size(axis_name)
-    if n_stages < 2:
-        raise RuntimeError("1F1B schedule needs >= 2 stages; use "
-                           "forward_backward_no_pipelining for pp=1")
     m_count = _mb_count(microbatches)
     entry = first_fn if first_fn is not None else (lambda p, mb: mb)
     ring_depth = 2 * (n_stages - 1) + 1
@@ -151,37 +194,10 @@ def _fwd_bwd_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
     zero_dp = jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), stage_params)
     zero_dx = jnp.zeros(act_shape, act_dtype)
 
-    def head_loss(p, y, aux):
-        if loss_with_params:
-            return (loss_fn(p, y, aux) if loss_aux is not None
-                    else loss_fn(p, y))
-        return loss_fn(y, aux) if loss_aux is not None else loss_fn(y)
-
-    # backward branches — uniform signature (x_saved, dy, mb_raw, aux) ->
-    # (dparams, dx, loss). Which one runs is a per-device runtime switch.
-    def bwd_dead(x_saved, dy, mb_raw, aux):
-        return zero_dp, zero_dx, jnp.zeros((), jnp.float32)
-
-    def bwd_first(x_saved, dy, mb_raw, aux):
-        # stage 0 recomputes through the embedding/preprocess so entry's
-        # param grads flow; its input cotangent has nowhere to go
-        y, vjp = jax.vjp(lambda p: stage_fn(p, entry(p, mb_raw)), stage_params)
-        (dp,) = vjp(dy.astype(y.dtype))
-        return dp, zero_dx, jnp.zeros((), jnp.float32)
-
-    def bwd_mid(x_saved, dy, mb_raw, aux):
-        y, vjp = jax.vjp(stage_fn, stage_params, x_saved)
-        dp, dx = vjp(dy.astype(y.dtype))
-        return dp, dx.astype(act_dtype), jnp.zeros((), jnp.float32)
-
-    def bwd_last(x_saved, dy, mb_raw, aux):
-        # fwd + loss head + bwd in one vjp, seeded by the scalar loss
-        def f(p, x):
-            return head_loss(p, stage_fn(p, x), aux)
-        loss, grads = jax.value_and_grad(f, argnums=(0, 1))(
-            stage_params, x_saved)
-        dp, dx = grads
-        return dp, dx.astype(act_dtype), loss.astype(jnp.float32)
+    head_loss = _make_head_loss(loss_fn, loss_with_params,
+                                loss_aux is not None)
+    bwd_branches = _make_bwd_branches(stage_fn, entry, head_loss, zero_dp,
+                                      zero_dx, act_dtype)
 
     def tick(carry, t):
         ring, buf_f, buf_b, gacc, lacc = carry
@@ -219,9 +235,8 @@ def _fwd_bwd_1f1b(stage_fn: Callable, loss_fn: Callable, stage_params,
             bwd_live,
             jnp.where(s == 0, 1, jnp.where(s == n_stages - 1, 3, 2)),
             0)
-        dp, dx, lval = lax.switch(branch, (bwd_dead, bwd_first, bwd_mid,
-                                           bwd_last),
-                                  x_saved, buf_b, mb_b, aux_b)
+        dp, dx, lval = lax.switch(branch, bwd_branches,
+                                  stage_params, x_saved, buf_b, mb_b, aux_b)
         gacc = jax.tree.map(jnp.add, gacc, dp)
         lacc = lacc + lval
 
@@ -309,10 +324,12 @@ def forward_backward_pipelining_without_interleaving(
 
     if forward_only:
         return mean_loss_of(stage_params), None
-    if implementation == "1f1b":
+    # pp=1 has no pipeline to interleave: the autodiff scan handles it (the
+    # pre-round-3 behavior for direct callers on a size-1 stage axis)
+    if implementation == "1f1b" and n_stages >= 2:
         return _fwd_bwd_1f1b(stage_fn, loss_fn, stage_params, microbatches,
                              loss_aux, axis_name, first_fn, loss_with_params)
-    if implementation != "autodiff":
+    if implementation not in ("1f1b", "autodiff"):
         raise ValueError(f"unknown implementation {implementation!r}")
     loss, grads = jax.value_and_grad(mean_loss_of)(stage_params)
     return loss, grads
@@ -334,13 +351,13 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, microbatches,
     traverses the V*S virtual stages in V*S ticks; outputs emerge on the
     LAST stage from chunk V-1.
 
-    Cost-model note: the reference's interleaved 1F1B shrinks the bubble by
-    V because its host-driven schedule can start backward earlier; in this
-    SPMD scan formulation the fill/drain garbage fraction is
-    (V*S-1)/(M+V*S-1) — LARGER than the non-interleaved (S-1)/(M+S-1).
-    The schedule exists for semantic parity (get_forward_backward_func
-    dispatch, chunked-model state layout); prefer the non-interleaved
-    schedule for throughput on TPU unless per-stage memory forces V>1.
+    Cost-model note: this all-chunks-per-tick forward has fill/drain
+    fraction (V*S-1)/(M+V*S-1) — larger than non-interleaved. It remains
+    the forward_only path and the autodiff-gradient oracle; the schedule
+    that actually delivers the reference's VPP bubble reduction is
+    ``_fwd_bwd_interleaved_1f1b`` (one chunk-fwd + one chunk-bwd per tick),
+    which ``forward_backward_pipelining_with_interleaving`` now uses by
+    default when M % S == 0.
     """
     s = lax.axis_index(axis_name)
     n_stages = lax.axis_size(axis_name)
@@ -382,18 +399,151 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, microbatches,
     return ys[v_chunks * n_stages - 1:]
 
 
+def _fwd_bwd_interleaved_1f1b(stage_fn, loss_fn, chunk_params, microbatches,
+                              loss_aux, axis_name, first_fn,
+                              loss_with_params):
+    """Lock-step interleaved 1F1B: V chunks per stage, ONE chunk-forward and
+    ONE chunk-backward per device per tick — the genuine VPP bubble
+    reduction (reference: fwd_bwd_pipelining_with_interleaving.py).
+
+    Virtual stage ``vs = v*S + s`` lives on device s. Forward of microbatch
+    m through chunk v occupies per-device slot ``i = g*V*S + v*S + p``
+    (m = g*S + p, requiring M % S == 0 — the reference's interleaving
+    divisibility constraint) at tick ``i + s``; its backward occupies slot
+    ``j = g*V*S + (V-1-v)*S + p`` at tick ``V*S + j + (S-1-s)``. Both
+    neighbor dependencies then line up exactly one tick apart — including
+    the chunk-boundary wraps (device S-1 -> 0 forward, 0 -> S-1 backward),
+    which is why both ppermutes run with ``wrap=True``.
+
+    Bubble accounting (per-device tick cost = one chunk fwd + one chunk
+    bwd = (tf+tb)/V of a full stage): total ticks = V*M + V*S + S - 1, so
+    time = (M + S + (S-1)/V)*(tf+tb) — fill/drain overhead S + (S-1)/V
+    full-stage units vs the non-interleaved schedule's 2(S-1), i.e. the
+    bubble genuinely shrinks for S >= 4 and approaches half as V grows.
+    The price is the reference's own trade: ~2V*S in-flight chunk inputs
+    per device (ring buffer) vs 2S for non-interleaved.
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    v_chunks = jax.tree.leaves(chunk_params)[0].shape[0]
+    m_count = _mb_count(microbatches)
+    entry = first_fn if first_fn is not None else (lambda p, mb: mb)
+    vs_total = v_chunks * n_stages
+    vm = v_chunks * m_count
+    t_total = vs_total + vm + n_stages - 1
+    ring_depth = 2 * vs_total + n_stages
+
+    chunk0 = jax.tree.map(lambda t: t[0], chunk_params)
+    x0_probe = entry(chunk0, _index_mb(microbatches, 0, m_count))
+    act_shape, act_dtype = x0_probe.shape, x0_probe.dtype
+
+    zero_dp = jax.tree.map(lambda p: jnp.zeros(p.shape[1:], p.dtype),
+                           chunk_params)
+    zero_dx = jnp.zeros(act_shape, act_dtype)
+
+    def pick(v):
+        return jax.tree.map(
+            lambda t: lax.dynamic_index_in_dim(t, v, 0, keepdims=False),
+            chunk_params)
+
+    head_loss = _make_head_loss(loss_fn, loss_with_params,
+                                loss_aux is not None)
+    bwd_branches = _make_bwd_branches(stage_fn, entry, head_loss, zero_dp,
+                                      zero_dx, act_dtype)
+
+    def tick(carry, t):
+        ring, buf_f, buf_b, gacc, lacc = carry
+
+        # ---- forward: chunk slot i = t - s ----
+        i = t - s
+        fwd_live = (i >= 0) & (i < vm)
+        i_c = jnp.clip(i, 0, vm - 1)
+        v_f = (i_c // n_stages) % v_chunks
+        m_f = (i_c // vs_total) * n_stages + i_c % n_stages
+        pf = pick(v_f)
+        mb_f = _index_mb(microbatches, m_f, m_count)
+        x_in = lax.cond(
+            fwd_live & (s == 0) & (v_f == 0),
+            lambda: entry(pf, mb_f).astype(act_dtype),
+            lambda: buf_f)
+        slot_f = jnp.mod(i_c, ring_depth)
+        ring = lax.cond(fwd_live,
+                        lambda r: lax.dynamic_update_index_in_dim(
+                            r, x_in, slot_f, 0),
+                        lambda r: r, ring)
+        # the last VIRTUAL stage's forward happens inside bwd_last's vjp
+        y = lax.cond(
+            fwd_live & ~((s == n_stages - 1) & (v_f == v_chunks - 1)),
+            lambda: stage_fn(pf, x_in).astype(act_dtype),
+            lambda: zero_dx)
+
+        # ---- backward: chunk slot j = t - V*S - (S-1-s) ----
+        j = t - vs_total - (n_stages - 1 - s)
+        bwd_live = (j >= 0) & (j < vm)
+        j_c = jnp.clip(j, 0, vm - 1)
+        v_b = v_chunks - 1 - (j_c // n_stages) % v_chunks
+        g_b = j_c // vs_total
+        p_b = j_c % n_stages
+        m_b = g_b * n_stages + p_b
+        i_b = g_b * vs_total + v_b * n_stages + p_b   # fwd slot of (m_b, v_b)
+        x_saved = lax.dynamic_index_in_dim(
+            ring, jnp.mod(i_b, ring_depth), 0, keepdims=False)
+        pb = pick(v_b)
+        mb_b = _index_mb(microbatches, m_b, m_count)
+        aux_b = (_index_mb(loss_aux, m_b, m_count)
+                 if loss_aux is not None else jnp.zeros(()))
+        is_first_virt = (s == 0) & (v_b == 0)
+        is_last_virt = (s == n_stages - 1) & (v_b == v_chunks - 1)
+        branch = jnp.where(
+            bwd_live,
+            jnp.where(is_first_virt, 1, jnp.where(is_last_virt, 3, 2)),
+            0)
+        dp, dx, lval = lax.switch(branch, bwd_branches,
+                                  pb, x_saved, buf_b, mb_b, aux_b)
+        gacc = jax.tree.map(lambda G, d: G.at[v_b].add(d), gacc, dp)
+        lacc = lacc + lval
+
+        # both shifts wrap: the ring carries chunk-boundary handoffs
+        buf_f = p2p.send_forward_recv_forward(y, axis_name, wrap=True)
+        buf_b = p2p.send_backward_recv_backward(dx, axis_name, wrap=True)
+        return (ring, buf_f, buf_b, gacc, lacc), None
+
+    carry0 = (
+        jnp.zeros((ring_depth,) + tuple(act_shape), act_dtype),
+        jnp.zeros(act_shape, act_dtype),
+        jnp.zeros(act_shape, act_dtype),
+        jax.tree.map(lambda p: jnp.zeros(p.shape, p.dtype), chunk_params),
+        jnp.zeros((), jnp.float32),
+    )
+    (ring, buf_f, buf_b, gacc, lacc), _ = lax.scan(
+        tick, carry0, jnp.arange(t_total))
+    mean_loss = lax.psum(lacc, axis_name) / m_count
+    grads = jax.tree.map(lambda g: g / m_count, gacc)
+    return mean_loss, grads
+
+
 def forward_backward_pipelining_with_interleaving(
         stage_fn: Callable, loss_fn: Callable, chunk_params, microbatches,
         loss_aux=None, forward_only: bool = False,
         axis_name: str = STAGE_AXIS, checkpoint_stage: bool = True,
         first_fn: Optional[Callable] = None,
-        loss_with_params: bool = False):
+        loss_with_params: bool = False,
+        implementation: str = "1f1b"):
     """Interleaved/VPP schedule (reference:
     fwd_bwd_pipelining_with_interleaving.py). Same contract as the
     non-interleaved schedule except ``chunk_params`` leaves carry a leading
     ``[V]`` chunk axis; grads come back with the same layout. ``first_fn``
     runs on chunk 0 of stage 0, ``loss_fn`` (with ``loss_with_params=True``
     receiving chunk V-1's params) on the last stage.
+
+    ``implementation="1f1b"`` (default, requires M % S == 0 like the
+    reference's interleaving constraint — falls back to autodiff otherwise):
+    the lock-step schedule of ``_fwd_bwd_interleaved_1f1b``, whose
+    fill/drain cost S + (S-1)/V full-stage units genuinely undercuts the
+    non-interleaved schedule's 2(S-1) — the reference's VPP bubble
+    reduction, delivered. ``"autodiff"`` differentiates through
+    ``pipeline_apply_interleaved`` (O(V*M) memory and a LARGER bubble than
+    non-interleaved — kept as the oracle and the M % S != 0 fallback).
     """
     if not axis_is_bound(axis_name):
         raise RuntimeError(
@@ -420,6 +570,13 @@ def forward_backward_pipelining_with_interleaving(
 
     if forward_only:
         return mean_loss_of(chunk_params), None
+    if (implementation == "1f1b"
+            and _mb_count(microbatches) % n_stages == 0 and n_stages > 1):
+        return _fwd_bwd_interleaved_1f1b(
+            stage_fn, loss_fn, chunk_params, microbatches, loss_aux,
+            axis_name, first_fn, loss_with_params)
+    if implementation not in ("1f1b", "autodiff"):
+        raise ValueError(f"unknown implementation {implementation!r}")
     loss, grads = jax.value_and_grad(mean_loss_of)(chunk_params)
     return loss, grads
 

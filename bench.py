@@ -124,11 +124,11 @@ def init_backend(retries: int, wait_s: float):
 
 
 def _is_transient(e: BaseException) -> bool:
-    s = f"{type(e).__name__}: {e}"
+    s = f"{type(e).__name__}: {e}".lower()
     return any(tok in s for tok in (
-        "UNAVAILABLE", "Connection refused", "Connection Failed",
-        "remote_compile", "transport", "DEADLINE_EXCEEDED", "Socket closed",
-        "connection reset", "Broken pipe"))
+        "unavailable", "connection refused", "connection failed",
+        "remote_compile", "transport", "deadline_exceeded", "socket closed",
+        "connection reset", "broken pipe"))
 
 
 def model_flops_per_token(cfg, seq_len: int) -> float:

@@ -258,3 +258,22 @@ def test_multi_loss_static_scale_rejects_unscale_and_combine():
     with pytest.raises(RuntimeError, match="static"):
         amp.unscale_and_combine([{"w": jnp.ones((4, 4))},
                                  {"w": jnp.ones((4, 4))}])
+
+
+def test_multi_loss_dynamic_step_without_noop_raises():
+    """A caller skipping the unscale_and_combine protocol must fail loudly,
+    not silently apply 2**16-scaled grads."""
+    p = {"w": jnp.ones((4, 4))}
+    opt = FusedAdam(p, lr=1e-2)
+    _, opt = amp.initialize(p, opt, opt_level="O2", half_dtype=jnp.float16,
+                            loss_scale="dynamic", num_losses=2)
+    with pytest.raises(RuntimeError, match="unscale_and_combine"):
+        opt.step({"w": jnp.ones((4, 4))})
+
+
+def test_unscale_and_combine_graceful_when_amp_disabled():
+    amp._loss_scalers = []
+    g, noop = amp.unscale_and_combine([{"w": jnp.ones((2,))},
+                                       {"w": jnp.full((2,), 2.0)}])
+    np.testing.assert_allclose(np.asarray(g["w"]), 3.0)
+    assert float(noop) == 0.0
