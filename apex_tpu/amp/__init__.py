@@ -122,12 +122,13 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                     and not multi_loss_dynamic_single_opt
                     and (scaler.dynamic or float(scaler.state.scale) != 1.0)):
                 opt.attach_amp_scaler(scaler)
-            if multi_loss_dynamic_single_opt:
-                # no scaler is fused into step, so a caller skipping the
-                # unscale_and_combine protocol would apply ~2**16-scaled
-                # grads silently; the noop kwarg is the protocol's receipt,
-                # and the optimizer refuses to step without it
-                opt._amp_require_noop = True
+            # no scaler is fused into step in multi-loss dynamic mode, so a
+            # caller skipping the unscale_and_combine protocol would apply
+            # ~2**16-scaled grads silently; the noop kwarg is the protocol's
+            # receipt, and the optimizer refuses to step without it.
+            # Unconditional assignment: re-initialize in another mode must
+            # clear a stale flag.
+            opt._amp_require_noop = multi_loss_dynamic_single_opt
             # O2/O3: the optimizer must hand back params in the cast dtypes
             if hasattr(opt, "set_output_dtypes") and policy.param_dtype != jnp.float32:
                 model_idx = min(i, len(model_list) - 1)

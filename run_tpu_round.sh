@@ -1,0 +1,32 @@
+#!/bin/bash
+# One-shot real-TPU validation for a round: probe the tunnel, run the
+# on-chip Pallas kernel suite (committing its log), then the benchmark.
+# Safe to re-run; everything is retried/timeboxed. Usage:
+#   bash run_tpu_round.sh [round_tag]   # e.g. r03
+set -u
+TAG="${1:-r03}"
+cd "$(dirname "$0")"
+
+echo "[$(date +%H:%M:%S)] probing TPU tunnel..."
+timeout 300 python - << 'EOF'
+import subprocess, sys
+r = subprocess.run([sys.executable, "-c",
+                    "import jax; ds=jax.devices(); "
+                    "print('PROBE_OK', len(ds), ds[0].device_kind)"],
+                   capture_output=True, text=True, timeout=280)
+print(r.stdout.strip() or r.stderr.strip()[-300:])
+sys.exit(0 if "PROBE_OK" in r.stdout else 1)
+EOF
+if [ $? -ne 0 ]; then
+  echo "[$(date +%H:%M:%S)] tunnel down; nothing run"
+  exit 1
+fi
+
+echo "[$(date +%H:%M:%S)] on-chip kernel suite (Mosaic compile of every Pallas kernel)..."
+APEX_TPU_REAL=1 timeout 3000 python -m pytest tests/test_real_tpu_kernels.py -v \
+  2>&1 | tee "TPU_TESTS_${TAG}.log" | tail -15
+
+echo "[$(date +%H:%M:%S)] benchmark..."
+timeout 5400 python bench.py 2> "bench_${TAG}.stderr.log" | tee "BENCH_${TAG}.json.local"
+tail -5 "bench_${TAG}.stderr.log"
+echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"

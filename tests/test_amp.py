@@ -271,8 +271,8 @@ def test_multi_loss_dynamic_step_without_noop_raises():
         opt.step({"w": jnp.ones((4, 4))})
 
 
-def test_unscale_and_combine_graceful_when_amp_disabled():
-    amp._loss_scalers = []
+def test_unscale_and_combine_graceful_when_amp_disabled(monkeypatch):
+    monkeypatch.setattr(amp, "_loss_scalers", [])
     g, noop = amp.unscale_and_combine([{"w": jnp.ones((2,))},
                                        {"w": jnp.full((2,), 2.0)}])
     np.testing.assert_allclose(np.asarray(g["w"]), 3.0)

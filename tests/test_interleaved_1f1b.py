@@ -54,7 +54,7 @@ def make_virtual_params(rng):
             jnp.asarray(w_virt), jnp.asarray(b_virt))
 
 
-def build_run(mesh, implementation, m):
+def build_run(mesh, implementation):
     from apex_tpu.transformer.pipeline_parallel import (
         forward_backward_pipelining_with_interleaving as fwd_bwd)
 
@@ -90,9 +90,9 @@ def test_interleaved_1f1b_matches_sequential_and_oracle(pp4_mesh, rng):
     ref_l, (ref_gw, ref_gb) = jax.value_and_grad(ref, argnums=(0, 1))(
         w_virt, b_virt)
 
-    loss_e, grads_e = jax.jit(build_run(pp4_mesh, "1f1b", m))(
+    loss_e, grads_e = jax.jit(build_run(pp4_mesh, "1f1b"))(
         params, mbs, labels)
-    loss_a, grads_a = jax.jit(build_run(pp4_mesh, "autodiff", m))(
+    loss_a, grads_a = jax.jit(build_run(pp4_mesh, "autodiff"))(
         params, mbs, labels)
 
     np.testing.assert_allclose(np.asarray(loss_e), float(ref_l),
@@ -111,7 +111,7 @@ def test_interleaved_1f1b_matches_sequential_and_oracle(pp4_mesh, rng):
 
 
 def _peak_temp_bytes(mesh, m, width=128):
-    run = build_run(mesh, "1f1b", m)
+    run = build_run(mesh, "1f1b")
     params = {"w": jnp.zeros((S, V, width, width), jnp.float32),
               "b": jnp.zeros((S, V, width), jnp.float32)}
     mbs = jax.ShapeDtypeStruct((m, 4, width), jnp.float32)
