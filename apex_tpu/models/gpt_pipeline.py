@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.mesh import MODEL_AXIS, STAGE_AXIS
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS, STAGE_AXIS
 from apex_tpu.models.gpt import GPTConfig, GPTModel, ParallelDecoderBlock
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.tensor_parallel import (
@@ -114,11 +114,28 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
     block = ParallelDecoderBlock(cfg)
     norm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps)
 
+    def _cp_bound():
+        return cfg.context_parallel and axis_is_bound(CONTEXT_AXIS)
+
     def first_fn(local, ids):
         sh = local["shared"]
         x = emb.apply({"params": sh["word_embeddings"]}, ids)
         s = ids.shape[-1]
-        x = x + sh["position_embeddings"][None, :s, :]
+        if _cp_bound():
+            # sequence sharded over ``context``: chunk i holds global
+            # positions [i*s, (i+1)*s) (mirrors GPTModel's CP path)
+            cp = lax.axis_size(CONTEXT_AXIS)
+            if cp * s > cfg.max_position_embeddings:
+                # dynamic_slice would CLAMP an out-of-range start and
+                # silently reuse positions on late ranks
+                raise ValueError(
+                    f"global sequence cp*s = {cp}*{s} exceeds "
+                    f"max_position_embeddings={cfg.max_position_embeddings}")
+            off = lax.axis_index(CONTEXT_AXIS) * s
+            pos = lax.dynamic_slice_in_dim(sh["position_embeddings"], off, s)
+        else:
+            pos = sh["position_embeddings"][:s]
+        x = x + pos[None, :, :]
         return x.astype(cfg.dtype)
 
     def stage_fn(local, x):
@@ -141,6 +158,10 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             per_tok = -jnp.take_along_axis(
                 logp, labels[..., None], axis=-1)[..., 0]
-        return per_tok.mean()
+        loss = per_tok.mean()
+        if _cp_bound():
+            # chunk means combine to the global token mean (equal chunks)
+            loss = lax.pmean(loss, CONTEXT_AXIS)
+        return loss
 
     return first_fn, stage_fn, loss_fn

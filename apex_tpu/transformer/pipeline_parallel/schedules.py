@@ -107,6 +107,67 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
     return ys[n_stages - 1:]
 
 
+def _jaxpr_has_ppermute(jaxpr) -> bool:
+    from jax.extend import core as jex_core
+
+    jaxpr_types = (jex_core.ClosedJaxpr, jex_core.Jaxpr)
+
+    def as_jaxpr(v):
+        return v.jaxpr if isinstance(v, jex_core.ClosedJaxpr) else v
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "ppermute":
+            return True
+        for val in eqn.params.values():
+            subs = []
+            if isinstance(val, jaxpr_types):
+                subs = [as_jaxpr(val)]
+            elif isinstance(val, (tuple, list)):
+                subs = [as_jaxpr(v) for v in val
+                        if isinstance(v, jaxpr_types)]
+            if any(_jaxpr_has_ppermute(s) for s in subs):
+                return True
+    return False
+
+
+def _stage_issues_ppermute(stage_fn, stage_params, x_probe) -> bool:
+    """Does one stage step (forward OR backward) emit a collective-permute
+    (ring attention, halo exchange)? Decides the schedule implementation:
+    ppermute lowers as a GLOBAL collective over every mesh device, so it
+    cannot sit inside the explicit 1F1B's per-device dead-slot branches —
+    devices whose slot is dead would never join the rendezvous (observed as
+    an XLA CPU rendezvous abort; on real hardware, a hang). Such stages need
+    the uniform autodiff schedule, which runs every stage every tick.
+    Sub-axis collectives (psum/all_gather over ``model``/``context``
+    subgroups) are fine in branches because every subgroup member shares the
+    branch predicate.
+
+    The probe traces the full value-and-grad of the stage so custom_vjp
+    rules whose ppermute lives only in the hand-written backward are caught
+    too.
+    """
+    def fwd_bwd_probe(p, x):
+        return jax.grad(
+            lambda p, x: jnp.sum(stage_fn(p, x).astype(jnp.float32)),
+            argnums=(0, 1))(p, x)
+
+    try:
+        jaxpr = jax.make_jaxpr(fwd_bwd_probe)(stage_params, x_probe)
+    except Exception:  # noqa: BLE001 — detection is best-effort
+        return False
+    return _jaxpr_has_ppermute(jaxpr.jaxpr)
+
+
+def _use_explicit_schedule(stage_fn, params_for_probe, first_fn,
+                           microbatches) -> bool:
+    """Shared dispatch gate for both 1F1B schedules: build the stage-0
+    activation probe and route ppermute-bearing stages to autodiff."""
+    entry = first_fn if first_fn is not None else (lambda p, mb: mb)
+    x_probe = entry(params_for_probe,
+                    _index_mb(microbatches, 0, _mb_count(microbatches)))
+    return not _stage_issues_ppermute(stage_fn, params_for_probe, x_probe)
+
+
 def _make_head_loss(loss_fn, loss_with_params, has_aux):
     """Uniform last-stage loss call over the (params?, aux?) signatures."""
     def head_loss(p, y, aux):
@@ -325,10 +386,15 @@ def forward_backward_pipelining_without_interleaving(
     if forward_only:
         return mean_loss_of(stage_params), None
     # pp=1 has no pipeline to interleave: the autodiff scan handles it (the
-    # pre-round-3 behavior for direct callers on a size-1 stage axis)
-    if implementation == "1f1b" and n_stages >= 2:
-        return _fwd_bwd_1f1b(stage_fn, loss_fn, stage_params, microbatches,
-                             loss_aux, axis_name, first_fn, loss_with_params)
+    # pre-round-3 behavior for direct callers on a size-1 stage axis).
+    # Ring-attention/halo stages (they emit ppermute, a GLOBAL collective)
+    # also route to autodiff — see _stage_issues_ppermute.
+    if (implementation == "1f1b" and n_stages >= 2
+            and _use_explicit_schedule(stage_fn, stage_params, first_fn,
+                                       microbatches)):
+        return _fwd_bwd_1f1b(stage_fn, loss_fn, stage_params,
+                             microbatches, loss_aux, axis_name, first_fn,
+                             loss_with_params)
     if implementation not in ("1f1b", "autodiff"):
         raise ValueError(f"unknown implementation {implementation!r}")
     loss, grads = jax.value_and_grad(mean_loss_of)(stage_params)
@@ -571,7 +637,10 @@ def forward_backward_pipelining_with_interleaving(
     if forward_only:
         return mean_loss_of(chunk_params), None
     if (implementation == "1f1b"
-            and _mb_count(microbatches) % n_stages == 0 and n_stages > 1):
+            and _mb_count(microbatches) % n_stages == 0 and n_stages > 1
+            and _use_explicit_schedule(
+                stage_fn, jax.tree.map(lambda t: t[0], chunk_params),
+                first_fn, microbatches)):
         return _fwd_bwd_interleaved_1f1b(
             stage_fn, loss_fn, chunk_params, microbatches, loss_aux,
             axis_name, first_fn, loss_with_params)

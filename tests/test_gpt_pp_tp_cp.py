@@ -1,0 +1,95 @@
+"""Full model-parallel composition: PP x TP x CP in one jitted step.
+
+GPT decoder stage-partitioned over ``stage``, Megatron TP over ``model``
+inside each stage, and the sequence sharded over ``context`` with ring
+attention — all three model-parallel axes of the mesh active in a single
+shard_map program (data=1 on the 8-device CPU mesh). Loss must match the
+single-device tp=1 unpipelined model.
+
+Schedule note: ring attention emits ppermute (a global collective), so the
+dispatcher's _stage_issues_ppermute detection must route this model to the
+uniform autodiff schedule — the explicit 1F1B's dead-slot branches would
+deadlock the permute rendezvous (this test exercises that routing).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS, STAGE_AXIS
+from apex_tpu.models.gpt import GPTModel, gpt_loss, gpt_tiny_config
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture
+def pp2_tp2_cp2_mesh():
+    from apex_tpu.transformer import parallel_state
+
+    return parallel_state.initialize_model_parallel(
+        2, 2, context_parallel_size_=2)
+
+
+def test_gpt_pp_tp_cp_one_step(pp2_tp2_cp2_mesh, rng):
+    from __graft_entry__ import _slice_tp_tree
+
+    from apex_tpu.models.gpt_pipeline import (
+        make_gpt_pipeline_fns, split_gpt_params_for_pipeline)
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    mesh = pp2_tp2_cp2_mesh
+    tp = pp = 2
+    n_layers = 2 * pp
+    cfg1 = gpt_tiny_config(tensor_parallel_size=1, num_layers=n_layers)
+    cfg = gpt_tiny_config(tensor_parallel_size=tp, num_layers=n_layers,
+                          context_parallel=True)
+
+    m, b, s = 4, 2, 32
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)),
+                         jnp.int32)
+
+    # reference: unsharded tp=1 model, mean loss over microbatches
+    m1 = GPTModel(cfg1)
+    v1 = m1.init(jax.random.PRNGKey(0), mbs[0])["params"]
+    ref = float(jax.vmap(
+        lambda ii, ll: gpt_loss(m1, {"params": v1}, ii, ll,
+                                axis_name="unbound"))(mbs, labels).mean())
+
+    v_tp_shape = jax.eval_shape(
+        lambda: GPTModel(cfg).init(jax.random.PRNGKey(0), mbs[0]))["params"]
+    per_rank = []
+    for r in range(tp):
+        tp_tree = _slice_tp_tree(v1, v_tp_shape, r, tp)
+        per_rank.append(split_gpt_params_for_pipeline(tp_tree, pp, n_layers))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *per_rank)
+    stacked = {"blocks": jax.tree.map(lambda t: t[:, :, 0], stacked["blocks"]),
+               "shared": stacked["shared"]}
+
+    first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg)
+
+    seq_sh = P(None, None, CONTEXT_AXIS)   # [M, B, S] sharded on S
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS, MODEL_AXIS), seq_sh, seq_sh),
+        out_specs=(P(), P(STAGE_AXIS, MODEL_AXIS)),
+        check_vma=False)
+    def step(p_stacked, mb, lb):
+        local = jax.tree.map(lambda t: t[0, 0], p_stacked)
+        loss, grads = fwd_bwd(stage_fn, loss_fn, local, mb, loss_aux=lb,
+                              first_fn=first_fn, loss_with_params=True)
+        return loss, jax.tree.map(lambda t: t[None, None], grads)
+
+    with mesh:
+        loss, grads = jax.jit(step)(stacked, mbs, labels)
+    jax.block_until_ready(grads)
+
+    np.testing.assert_allclose(float(loss), ref, rtol=3e-5, atol=3e-5)
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree.leaves(grads))
