@@ -11,7 +11,12 @@ from apex_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention_with_lse,
     mha_reference,
 )
-from apex_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from apex_tpu.ops.ring_attention import (  # noqa: F401
+    from_zigzag,
+    ring_attention,
+    ring_attention_zigzag,
+    to_zigzag,
+)
 from apex_tpu.ops.scaled_softmax import (  # noqa: F401
     scaled_masked_softmax,
     scaled_softmax,

@@ -19,18 +19,21 @@ merge is plain jnp — so ``jax.grad`` through the scan + ppermute yields the
 exact ring backward (grads ride the reverse ring automatically via
 ppermute's transpose) with no hand-written outer VJP.
 
-Causal load note: chunks are laid out in sequence order, so rotation step 0
-is exactly the causal diagonal for every device (a *static* branch) and later
-steps are all-or-nothing (device i attends chunk j iff j < i). Devices late
-in the ring discard more work — the classic ring-attention imbalance;
-zigzag/striped layouts could fix it but complicate the story, and the wasted
-kernels are uniform SPMD work that XLA overlaps with the permutes.
+Causal load note: ``ring_attention``'s chunks are laid out in sequence
+order, so rotation step 0 is exactly the causal diagonal for every device
+(a *static* branch) and later steps are all-or-nothing (device i attends
+chunk j iff j < i) — devices late in the ring discard more work, the
+classic ring-attention imbalance. ``ring_attention_zigzag`` (below) fixes
+it for causal masks: each device holds one early + one late half-chunk
+(``to_zigzag``/``from_zigzag`` layout helpers) so every rotation step does
+exactly two live half-chunk kernels.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -123,3 +126,130 @@ def ring_attention(
 
     (_, _, o, lse), _ = lax.scan(body, (kc, vc, o, lse), jnp.arange(1, cp))
     return o.astype(q.dtype)
+
+
+# =============================================================================
+# zigzag layout — load-balanced CAUSAL ring attention
+# =============================================================================
+
+def zigzag_chunk_indices(cp: int):
+    """Global chunk ids (out of 2*cp) held by each device: (i, 2cp-1-i)."""
+    return [(i, 2 * cp - 1 - i) for i in range(cp)]
+
+
+def to_zigzag(x, cp: int, axis: int = 2):
+    """Permute a GLOBAL sequence into zigzag device order (call before
+    sharding over ``context``): device i's slice holds chunks (i, 2cp-1-i),
+    so each device owns one early and one late chunk and the causal-mask
+    work is uniform around the ring."""
+    s = x.shape[axis]
+    if s % (2 * cp):
+        raise ValueError(f"sequence {s} not divisible by 2*cp={2 * cp}")
+    chunks = jnp.split(x, 2 * cp, axis=axis)
+    return jnp.concatenate(
+        [chunks[c] for pair in zigzag_chunk_indices(cp) for c in pair],
+        axis=axis)
+
+def from_zigzag(x, cp: int, axis: int = 2):
+    """Inverse of ``to_zigzag``."""
+    order = [c for pair in zigzag_chunk_indices(cp) for c in pair]
+    inv = [order.index(c) for c in range(2 * cp)]
+    chunks = jnp.split(x, 2 * cp, axis=axis)
+    return jnp.concatenate([chunks[i] for i in inv], axis=axis)
+
+
+def ring_attention_zigzag(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """CAUSAL ring attention over a zigzag-sharded sequence.
+
+    The sequence-ordered layout of ``ring_attention`` wastes ~half the
+    kernel work under a causal mask: late-ring devices discard most
+    arriving K/V chunks (its own docstring concedes the imbalance). The
+    zigzag layout fixes it: the sequence is split into 2*cp chunks and
+    device i holds the PAIR (chunk i, chunk 2cp-1-i) — one early chunk
+    (few causal keys) and one late chunk (many), so every device computes
+    exactly two half-chunk flash calls per rotation step:
+
+      step 0 (own pair, static): early-diag, late-vs-early full, late-diag;
+      step r>0 receiving device j's pair: late-q vs early-kv is ALWAYS a
+      live full block, plus ONE more — early-q vs early-kv when j < i,
+      late-q vs late-kv when j > i (a per-device ``lax.cond``; Pallas
+      calls are local compute, so divergent branches are safe — unlike
+      collectives, see schedules._stage_issues_ppermute).
+
+    Inputs are the LOCAL zigzag slice [B, H, 2*S_h, D] (produce the global
+    layout with ``to_zigzag`` before sharding; undo with ``from_zigzag``).
+    Fully differentiable (custom_vjp flash + jnp merges + ppermute
+    transpose).
+    """
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError(
+            f"zigzag ring needs equal q/k/v chunk shapes, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
+    if q.shape[2] % 2:
+        raise ValueError("local zigzag slice must hold two half-chunks")
+    d = q.shape[-1]
+    scale = (1.0 / (d ** 0.5)) if scale is None else float(scale)
+    cp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s_h = q.shape[2] // 2
+
+    def halves(t):
+        return t[:, :, :s_h], t[:, :, s_h:]
+
+    q_e, q_l = halves(q)
+
+    def attend(qq, kk, vv, causal):
+        return flash_attention_with_lse(
+            qq, kk, vv, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k)
+
+    # ---- step 0: own pair (static diagonal structure) ----
+    k_e, k_l = halves(k)
+    v_e, v_l = halves(v)
+    o_e, lse_e = attend(q_e, k_e, v_e, True)         # early diag
+    acc_e = (o_e.astype(jnp.float32), lse_e)
+    o_l0, lse_l0 = attend(q_l, k_e, v_e, False)      # late q sees all early
+    o_l1, lse_l1 = attend(q_l, k_l, v_l, True)       # late diag
+    acc_l = _merge(o_l0.astype(jnp.float32), lse_l0,
+                   o_l1.astype(jnp.float32), lse_l1)
+    if cp == 1:
+        return jnp.concatenate([acc_e[0], acc_l[0]], axis=2).astype(q.dtype)
+
+    kc, vc = _rotate(k, axis_name, cp), _rotate(v, axis_name, cp)
+
+    def body(carry, r):
+        kc, vc, acc_e, acc_l = carry
+        # at step r this device holds device j = (idx - r) mod cp's pair:
+        # global chunks (j, 2cp-1-j)
+        j = jnp.mod(idx - r, cp)
+        kc_e, kc_l = halves(kc)
+        vc_e, vc_l = halves(vc)
+        # always live: late q (chunk 2cp-1-i) vs j's early kv (chunk j < cp)
+        o_a, lse_a = attend(q_l, kc_e, vc_e, False)
+        acc_l = _merge(acc_l[0], acc_l[1], o_a.astype(jnp.float32), lse_a)
+        # the second block depends on ring position (balanced: always ONE)
+        o_b, lse_b = lax.cond(
+            j < idx,
+            lambda: attend(q_e, kc_e, vc_e, False),   # chunk j < chunk i
+            lambda: attend(q_l, kc_l, vc_l, False))   # 2cp-1-j < 2cp-1-i
+        cand_e = _merge(acc_e[0], acc_e[1], o_b.astype(jnp.float32), lse_b)
+        cand_l = _merge(acc_l[0], acc_l[1], o_b.astype(jnp.float32), lse_b)
+        sel = lambda a, b: jax.tree.map(  # noqa: E731
+            lambda x, y: jnp.where(j < idx, x, y), a, b)
+        acc_e = sel(cand_e, acc_e)
+        acc_l = sel(acc_l, cand_l)
+        return (_rotate(kc, axis_name, cp), _rotate(vc, axis_name, cp),
+                acc_e, acc_l), None
+
+    (_, _, acc_e, acc_l), _ = lax.scan(
+        body, (kc, vc, acc_e, acc_l), jnp.arange(1, cp))
+    return jnp.concatenate([acc_e[0], acc_l[0]], axis=2).astype(q.dtype)

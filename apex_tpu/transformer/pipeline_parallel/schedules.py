@@ -130,42 +130,46 @@ def _jaxpr_has_ppermute(jaxpr) -> bool:
     return False
 
 
-def _stage_issues_ppermute(stage_fn, stage_params, x_probe) -> bool:
-    """Does one stage step (forward OR backward) emit a collective-permute
-    (ring attention, halo exchange)? Decides the schedule implementation:
+def _use_explicit_schedule(stage_fn, params_for_probe, first_fn, loss_fn,
+                           loss_aux, loss_with_params, microbatches) -> bool:
+    """Shared dispatch gate for both 1F1B schedules: does one full
+    stage step (entry preprocess + stage body + loss head, forward AND
+    backward) emit a collective-permute (ring attention, halo exchange)?
+
     ppermute lowers as a GLOBAL collective over every mesh device, so it
     cannot sit inside the explicit 1F1B's per-device dead-slot branches —
     devices whose slot is dead would never join the rendezvous (observed as
-    an XLA CPU rendezvous abort; on real hardware, a hang). Such stages need
-    the uniform autodiff schedule, which runs every stage every tick.
+    an XLA CPU rendezvous abort; on real hardware, a hang). Such programs
+    need the uniform autodiff schedule, which runs every stage every tick.
     Sub-axis collectives (psum/all_gather over ``model``/``context``
-    subgroups) are fine in branches because every subgroup member shares the
-    branch predicate.
+    subgroups) are fine in branches because every subgroup member shares
+    the branch predicate.
 
-    The probe traces the full value-and-grad of the stage so custom_vjp
-    rules whose ppermute lives only in the hand-written backward are caught
-    too.
+    The probe traces grad-wrt-params of entry -> stage -> loss, so it
+    covers first_fn/loss_fn (they too run inside branches) and custom_vjp
+    rules whose ppermute lives only in the hand-written backward, while
+    avoiding grads of integer activations. Detection failure routes to the
+    SAFE autodiff schedule (a false "explicit" would deadlock; a false
+    "autodiff" merely costs memory). Cost: one extra abstract trace per
+    compilation — fwd_bwd only ever runs inside shard_map, so the probe
+    evaluates on tracers, never on real data.
     """
-    def fwd_bwd_probe(p, x):
-        return jax.grad(
-            lambda p, x: jnp.sum(stage_fn(p, x).astype(jnp.float32)),
-            argnums=(0, 1))(p, x)
+    entry = first_fn if first_fn is not None else (lambda p, mb: mb)
+    mb0 = _index_mb(microbatches, 0, _mb_count(microbatches))
+    aux0 = (_index_mb(loss_aux, 0, _mb_count(microbatches))
+            if loss_aux is not None else None)
+    head_loss = _make_head_loss(loss_fn, loss_with_params,
+                                loss_aux is not None)
+
+    def full_step(p):
+        y = stage_fn(p, entry(p, mb0))
+        return head_loss(p, y, aux0).astype(jnp.float32)
 
     try:
-        jaxpr = jax.make_jaxpr(fwd_bwd_probe)(stage_params, x_probe)
-    except Exception:  # noqa: BLE001 — detection is best-effort
+        jaxpr = jax.make_jaxpr(jax.grad(full_step))(params_for_probe)
+    except Exception:  # noqa: BLE001 — fail toward the deadlock-free path
         return False
-    return _jaxpr_has_ppermute(jaxpr.jaxpr)
-
-
-def _use_explicit_schedule(stage_fn, params_for_probe, first_fn,
-                           microbatches) -> bool:
-    """Shared dispatch gate for both 1F1B schedules: build the stage-0
-    activation probe and route ppermute-bearing stages to autodiff."""
-    entry = first_fn if first_fn is not None else (lambda p, mb: mb)
-    x_probe = entry(params_for_probe,
-                    _index_mb(microbatches, 0, _mb_count(microbatches)))
-    return not _stage_issues_ppermute(stage_fn, params_for_probe, x_probe)
+    return not _jaxpr_has_ppermute(jaxpr.jaxpr)
 
 
 def _make_head_loss(loss_fn, loss_with_params, has_aux):
@@ -391,6 +395,7 @@ def forward_backward_pipelining_without_interleaving(
     # also route to autodiff — see _stage_issues_ppermute.
     if (implementation == "1f1b" and n_stages >= 2
             and _use_explicit_schedule(stage_fn, stage_params, first_fn,
+                                       loss_fn, loss_aux, loss_with_params,
                                        microbatches)):
         return _fwd_bwd_1f1b(stage_fn, loss_fn, stage_params,
                              microbatches, loss_aux, axis_name, first_fn,
@@ -640,7 +645,8 @@ def forward_backward_pipelining_with_interleaving(
             and _mb_count(microbatches) % n_stages == 0 and n_stages > 1
             and _use_explicit_schedule(
                 stage_fn, jax.tree.map(lambda t: t[0], chunk_params),
-                first_fn, microbatches)):
+                first_fn, loss_fn, loss_aux, loss_with_params,
+                microbatches)):
         return _fwd_bwd_interleaved_1f1b(
             stage_fn, loss_fn, chunk_params, microbatches, loss_aux,
             axis_name, first_fn, loss_with_params)

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Retry run_tpu_round.sh until it succeeds once (TPU tunnel is flaky and
+# may return at any time). Stops after a successful bench artifact or when
+# the deadline (seconds, default 8h) passes.
+set -u
+TAG="${1:-r03}"
+DEADLINE="${2:-28800}"
+START=$(date +%s)
+cd "$(dirname "$0")"
+while true; do
+  now=$(date +%s)
+  if [ $((now - START)) -ge "$DEADLINE" ]; then
+    echo "[watch] deadline reached"; exit 1
+  fi
+  bash run_tpu_round.sh "$TAG" && {
+    echo "[watch] TPU round completed"; exit 0; }
+  sleep 900
+done
