@@ -10,6 +10,16 @@ plumbing, not the model, is the exercised surface.
 Run:  python examples/dcgan/main_amp.py --steps 20
 """
 
+import os as _os
+import sys as _sys
+
+# runnable without installation: put the repo root on sys.path
+_REPO_ROOT = _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", ".."))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+
 from __future__ import annotations
 
 import argparse

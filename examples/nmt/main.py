@@ -11,6 +11,16 @@ task (the loss must fall toward copying the source).
 Run:  python examples/nmt/main.py --steps 30
 """
 
+import os as _os
+import sys as _sys
+
+# runnable without installation: put the repo root on sys.path
+_REPO_ROOT = _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", ".."))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+
 from __future__ import annotations
 
 import argparse

@@ -9,6 +9,16 @@ reference knobs.
 Run:  python examples/simple/distributed/distributed_data_parallel.py
 """
 
+import os as _os
+import sys as _sys
+
+# runnable without installation: put the repo root on sys.path
+_REPO_ROOT = _os.path.abspath(_os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "..", "..", ".."))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
+
 import jax
 import jax.numpy as jnp
 import numpy as np

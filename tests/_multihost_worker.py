@@ -1,0 +1,86 @@
+"""Worker for tests/test_multihost.py — one simulated host.
+
+Joins a 2-process jax.distributed cluster (Gloo over localhost, the CPU
+stand-in for DCN), contributes 4 virtual CPU devices to the 8-device
+global mesh, and runs a Megatron-TP GPT grad step over the apex_tpu
+parallel_state mesh spanning BOTH processes. Prints PASS lines the parent
+asserts on.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+
+
+def main():
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid)
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sys.path.insert(0, ".")
+    from apex_tpu.mesh import DATA_AXIS, MODEL_AXIS
+    from apex_tpu.models.gpt import GPTModel, gpt_loss, gpt_tiny_config
+    from apex_tpu.transformer import parallel_state
+
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+    # tp=2 -> dp=4: the data axis SPANS the process boundary (the DCN story)
+    mesh = parallel_state.initialize_model_parallel(2, 1)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    assert sizes == {"data": 4, "stage": 1, "context": 1, "model": 2}, sizes
+    print(f"PASS mesh pid={pid} {sizes}")
+
+    cfg = gpt_tiny_config(tensor_parallel_size=2)
+    model = GPTModel(cfg)
+    rng = np.random.default_rng(0)  # identical data on both processes
+    ids_np = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    labels_np = np.roll(ids_np, -1, axis=1)
+
+    def replicated(x_np):
+        sh = NamedSharding(mesh, P())
+        return jax.make_array_from_callback(
+            x_np.shape, sh, lambda idx: x_np[idx])
+
+    ids, labels = replicated(ids_np), replicated(labels_np)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+        out_specs=(P(MODEL_AXIS), P(MODEL_AXIS)), check_vma=False)
+    def tp_step(ii, ll):
+        v = model.init(jax.random.PRNGKey(0), ii)["params"]
+
+        def f(p):
+            # shard the batch over the cross-process data axis by slicing
+            # per data rank — grads then pmean over ``data``, which rides
+            # the simulated DCN between the two hosts
+            r = jax.lax.axis_index(DATA_AXIS)
+            my_ii = jax.lax.dynamic_slice_in_dim(ii, r * 2, 2)
+            my_ll = jax.lax.dynamic_slice_in_dim(ll, r * 2, 2)
+            return gpt_loss(model, {"params": p}, my_ii, my_ll)
+
+        loss, grads = jax.value_and_grad(f)(v)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jax.lax.pmean(g, DATA_AXIS).astype(jnp.float32) ** 2)
+            for g in jax.tree.leaves(grads)))
+        return loss.reshape(1), gnorm.reshape(1)
+
+    with mesh:
+        loss, gnorm = jax.jit(tp_step)(ids, labels)
+    loss_local = float(loss.addressable_shards[0].data[0])
+    gnorm_local = float(gnorm.addressable_shards[0].data[0])
+    assert np.isfinite(loss_local) and np.isfinite(gnorm_local)
+    print(f"PASS step pid={pid} loss={loss_local:.6f} gnorm={gnorm_local:.6f}")
+
+
+if __name__ == "__main__":
+    main()

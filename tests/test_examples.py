@@ -58,3 +58,41 @@ def test_simple_ddp_loop():
                 "examples/simple/distributed/distributed_data_parallel.py")
     losses = mod.run_training(steps=6, verbose=_quiet)
     assert losses[-1] < losses[0]
+
+
+def test_long_context_ring_attention_trains():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    lc = _load("example_long_context",
+               "examples/long_context/train_ring_attention.py")
+    losses = lc.run_training(steps=6, seq_len=64, cp=4, verbose=_quiet)
+    assert losses[-1] < losses[0], losses
+
+    # the in-shard_map grads (psum over context + pmean over data) must
+    # equal the plain value_and_grad of the unsharded model — review r3
+    # caught the example shipping partial per-chunk grads
+    from apex_tpu.models.gpt import GPTModel, gpt_loss, gpt_tiny_config
+    from apex_tpu.transformer import parallel_state
+
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        1, 1, context_parallel_size_=4)
+    cfg = gpt_tiny_config(context_parallel=True, max_position_embeddings=64)
+    model = GPTModel(cfg)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 64)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(0), ids[:, :16])["params"]
+    fn = lc.make_loss_and_grad_fn(model, mesh)
+    loss, grads = jax.jit(fn)(params, ids, labels)
+
+    cfg1 = gpt_tiny_config(max_position_embeddings=64)
+    m1 = GPTModel(cfg1)
+    ref_l, ref_g = jax.value_and_grad(
+        lambda p: gpt_loss(m1, {"params": p}, ids, labels,
+                           axis_name="unbound"))(params)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5), grads, ref_g)
