@@ -47,6 +47,28 @@ def _block_sizes(sq: int, sk: int, block_q: Optional[int], block_k: Optional[int
     return bq, bk
 
 
+def _head_pad(d: int) -> int:
+    """Padded head-dim for the kernel blocks.
+
+    Default: round up to a 128-lane multiple — always legal. With
+    ``APEX_TPU_FLASH_TIGHT_HEADDIM=1`` a sublane-aligned d (64 for
+    BERT/GPT-2 heads) is kept as-is: the block's minor dim then equals the
+    full array dim, which Mosaic's (8, 128)-or-full-dim rule permits, and
+    the QK^T/PV contractions stop wasting half their MXU work on zero
+    padding. Gated off by default until the on-chip suite
+    (tests/test_real_tpu_kernels.py::test_flash_attention_tight_head_dim)
+    has proven the layout compiles on the target chip generation.
+    """
+    import os
+
+    if d % 128 == 0:
+        return d
+    if (os.environ.get("APEX_TPU_FLASH_TIGHT_HEADDIM") == "1"
+            and d % 8 == 0):
+        return d
+    return _dispatch.round_up(d, 128)
+
+
 def _pad_to(x, axis, mult):
     size = x.shape[axis]
     pad = _dispatch.round_up(size, mult) - size
@@ -169,11 +191,11 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
-    d_pad = _dispatch.round_up(d, 128)
+    d_pad = _head_pad(d)
 
-    qp = _pad_to(_pad_to(q, 2, bq), 3, 128)
-    kp = _pad_to(_pad_to(k, 2, bk), 3, 128)
-    vp = _pad_to(_pad_to(v, 2, bk), 3, 128)
+    qp = _pad_to(_pad_to(q, 2, bq), 3, d_pad)
+    kp = _pad_to(_pad_to(k, 2, bk), 3, d_pad)
+    vp = _pad_to(_pad_to(v, 2, bk), 3, d_pad)
     sq_p, sk_p = qp.shape[2], kp.shape[2]
     nq, nk = sq_p // bq, sk_p // bk
     causal_offset = kv_len - q_len
@@ -376,7 +398,7 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
-    d_pad = _dispatch.round_up(d, 128)
+    d_pad = _head_pad(d)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if delta_adjust is not None:
@@ -384,10 +406,10 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         # ds = p*(dp - delta + dlse) = p*(dp - (delta - dlse))
         delta = delta + delta_adjust
 
-    qp = _pad_to(_pad_to(q, 2, bq), 3, 128)
-    kp = _pad_to(_pad_to(k, 2, bk), 3, 128)
-    vp = _pad_to(_pad_to(v, 2, bk), 3, 128)
-    dop = _pad_to(_pad_to(do, 2, bq), 3, 128)
+    qp = _pad_to(_pad_to(q, 2, bq), 3, d_pad)
+    kp = _pad_to(_pad_to(k, 2, bk), 3, d_pad)
+    vp = _pad_to(_pad_to(v, 2, bk), 3, d_pad)
+    dop = _pad_to(_pad_to(do, 2, bq), 3, d_pad)
     # pad lse with +inf → p = exp(s - inf) = 0 for padded q rows
     sq_p, sk_p = qp.shape[2], kp.shape[2]
     lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - q_len)),

@@ -10,6 +10,8 @@ plumbing, not the model, is the exercised surface.
 Run:  python examples/dcgan/main_amp.py --steps 20
 """
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
@@ -19,8 +21,6 @@ _REPO_ROOT = _os.path.abspath(_os.path.join(
 if _REPO_ROOT not in _sys.path:
     _sys.path.insert(0, _REPO_ROOT)
 
-
-from __future__ import annotations
 
 import argparse
 

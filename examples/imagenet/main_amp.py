@@ -12,6 +12,8 @@ same axis. Synthetic data by default (the reference's tests/L1 mode).
 Run:  python examples/imagenet/main_amp.py --steps 20 --opt-level O1
 """
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
@@ -21,8 +23,6 @@ _REPO_ROOT = _os.path.abspath(_os.path.join(
 if _REPO_ROOT not in _sys.path:
     _sys.path.insert(0, _REPO_ROOT)
 
-
-from __future__ import annotations
 
 import argparse
 import time

@@ -81,9 +81,10 @@ def run_training(steps: int = 8, seq_len: int = 128, cp: int = 4,
     opt = FusedAdam(params, lr=3e-3, weight_decay=0.0)
     loss_and_grad_fn = make_loss_and_grad_fn(model, mesh)
 
+    step_fn = jax.jit(loss_and_grad_fn)
     losses = []
     for step in range(steps):
-        loss, grads = jax.jit(loss_and_grad_fn)(params, ids, labels)
+        loss, grads = step_fn(params, ids, labels)
         params = opt.step(grads)
         losses.append(float(loss))
         verbose(f"step {step}: loss {losses[-1]:.4f}  "

@@ -11,6 +11,8 @@ task (the loss must fall toward copying the source).
 Run:  python examples/nmt/main.py --steps 30
 """
 
+from __future__ import annotations
+
 import os as _os
 import sys as _sys
 
@@ -20,8 +22,6 @@ _REPO_ROOT = _os.path.abspath(_os.path.join(
 if _REPO_ROOT not in _sys.path:
     _sys.path.insert(0, _REPO_ROOT)
 
-
-from __future__ import annotations
 
 import argparse
 from typing import Any
