@@ -47,24 +47,30 @@ def _block_sizes(sq: int, sk: int, block_q: Optional[int], block_k: Optional[int
     return bq, bk
 
 
+# Read ONCE at import: the value participates in traced shapes, and jit
+# caches are not keyed on env vars — a mid-process flip would silently keep
+# serving the previously-compiled layout. Set the env before importing
+# apex_tpu (tests monkeypatch this constant + jax.clear_caches()).
+_TIGHT_HEADDIM = __import__("os").environ.get(
+    "APEX_TPU_FLASH_TIGHT_HEADDIM") == "1"
+
+
 def _head_pad(d: int) -> int:
     """Padded head-dim for the kernel blocks.
 
     Default: round up to a 128-lane multiple — always legal. With
-    ``APEX_TPU_FLASH_TIGHT_HEADDIM=1`` a sublane-aligned d (64 for
-    BERT/GPT-2 heads) is kept as-is: the block's minor dim then equals the
-    full array dim, which Mosaic's (8, 128)-or-full-dim rule permits, and
-    the QK^T/PV contractions stop wasting half their MXU work on zero
-    padding. Gated off by default until the on-chip suite
+    ``APEX_TPU_FLASH_TIGHT_HEADDIM=1`` (read at import, see
+    ``_TIGHT_HEADDIM``) a sublane-aligned d (64 for BERT/GPT-2 heads) is
+    kept as-is: the block's minor dim then equals the full array dim,
+    which Mosaic's (8, 128)-or-full-dim rule permits, and the QK^T/PV
+    contractions stop wasting half their MXU work on zero padding. Gated
+    off by default until the on-chip suite
     (tests/test_real_tpu_kernels.py::test_flash_attention_tight_head_dim)
     has proven the layout compiles on the target chip generation.
     """
-    import os
-
     if d % 128 == 0:
         return d
-    if (os.environ.get("APEX_TPU_FLASH_TIGHT_HEADDIM") == "1"
-            and d % 8 == 0):
+    if _TIGHT_HEADDIM and d % 8 == 0:
         return d
     return _dispatch.round_up(d, 128)
 

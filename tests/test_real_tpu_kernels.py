@@ -281,11 +281,12 @@ def test_group_norm_backward_kernel_path(tpu, rng):
                                    rtol=3e-3, atol=3e-3)
 
 
-def test_flash_attention_tight_head_dim(tpu, rng):
-    """Round-3 perf lever: APEX_TPU_FLASH_TIGHT_HEADDIM=1 keeps head_dim 64
-    unpadded (block minor dim = full array dim) instead of zero-padding to
-    128 — halving the QK^T/PV MXU work at BERT/GPT head shapes. This proves
-    the layout compiles under Mosaic and matches the padded path."""
+def test_flash_attention_tight_head_dim(tpu, rng, monkeypatch):
+    """Round-3 perf lever: tight head-dim keeps head_dim 64 unpadded (block
+    minor dim = full array dim) instead of zero-padding to 128 — halving
+    the QK^T/PV MXU work at BERT/GPT head shapes. This proves the layout
+    compiles under Mosaic and matches the padded path in BOTH forward and
+    backward."""
     from apex_tpu.ops import flash_attention
 
     b, h, d = 2, 8, 64
@@ -293,17 +294,26 @@ def test_flash_attention_tight_head_dim(tpu, rng):
     k = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((b, h, SEQ, d)), jnp.bfloat16)
 
+    def loss(q):
+        return jnp.sum(flash_attention(q, k, v, causal=True
+                                       ).astype(jnp.float32) ** 2)
+
     ref = jax.jit(functools.partial(flash_attention, causal=True))(q, k, v)
-    os.environ["APEX_TPU_FLASH_TIGHT_HEADDIM"] = "1"
+    g_ref = jax.jit(jax.grad(loss))(q)
+
+    import apex_tpu.ops.flash_attention as fa_impl
+
+    monkeypatch.setattr(fa_impl, "_TIGHT_HEADDIM", True)
     try:
         jax.clear_caches()
         out = jax.jit(functools.partial(flash_attention, causal=True))(q, k, v)
-        g = jax.jit(jax.grad(lambda q: jnp.sum(
-            flash_attention(q, k, v, causal=True).astype(jnp.float32))))(q)
+        g = jax.jit(jax.grad(loss))(q)
     finally:
-        del os.environ["APEX_TPU_FLASH_TIGHT_HEADDIM"]
+        monkeypatch.setattr(fa_impl, "_TIGHT_HEADDIM", False)
         jax.clear_caches()
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=2e-2, atol=2e-2)
-    assert np.isfinite(np.asarray(g, np.float32)).all()
+    np.testing.assert_allclose(np.asarray(g, np.float32),
+                               np.asarray(g_ref, np.float32),
+                               rtol=5e-2, atol=5e-2)

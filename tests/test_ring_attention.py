@@ -179,3 +179,20 @@ def test_zigzag_ring_grads_match_single_device(rng):
         np.testing.assert_allclose(np.asarray(gz), np.asarray(gr),
                                    atol=3e-4, rtol=3e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.slow
+def test_ring_bf16_matches_single_device(rng):
+    """bf16 inputs: the ring's f32 lse-merge must keep parity with the
+    single-device bf16 flash kernel at bf16-level tolerance."""
+    b, h, s, d = 1, 2, 128, 32
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+
+    ref = flash_attention(q, k, v, causal=True)
+    out = ring_sharded(q, k, v, 4, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
