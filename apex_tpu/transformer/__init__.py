@@ -6,6 +6,7 @@ functional.FusedScaleMaskSoftmax. Rebuilt here over jax.shard_map + XLA
 collectives (SURVEY.md §2.4).
 """
 
+from apex_tpu.transformer import moe  # noqa: F401
 from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

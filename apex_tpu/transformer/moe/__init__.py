@@ -1,0 +1,15 @@
+"""Mixture-of-experts with expert parallelism (beyond reference).
+
+The reference apex has no MoE; EP completes this framework's parallelism
+surface (SURVEY.md §2.4 footnote). See layer.py for the TPU-first design.
+"""
+
+from apex_tpu.transformer.moe.layer import (MoEAuxLosses, MoEMLP,
+                                            compute_dispatch_combine)
+from apex_tpu.transformer.moe.router import (TopKRouter, load_balancing_loss,
+                                             router_z_loss)
+
+__all__ = [
+    "MoEAuxLosses", "MoEMLP", "compute_dispatch_combine",
+    "TopKRouter", "load_balancing_loss", "router_z_loss",
+]

@@ -1,0 +1,209 @@
+"""Mixture-of-experts MLP with expert parallelism over a mesh axis.
+
+Beyond-reference extension: apex has no MoE (SURVEY.md §2.4 lists EP as
+reference-absent by design), but expert parallelism completes the framework's
+parallelism surface (dp/tp/pp/sp/cp/ep). The design is the canonical TPU
+formulation (GShard/Switch lineage):
+
+- **Dispatch/combine are einsums over static one-hot masks**, not gathers:
+  ``dispatch (T,E,C)`` one-hot in its capacity slot, ``xd = einsum('tec,td->
+  ecd')``. Static shapes + MXU-friendly contractions; XLA fuses the mask
+  construction into the einsum operands.
+- **Expert parallelism = ``lax.all_to_all`` over a named mesh axis** inside
+  ``shard_map`` (the same idiom as the TP layers in
+  tensor_parallel/layers.py): each rank routes its local tokens to all E
+  experts' capacity slots, a tiled all_to_all regroups slots by expert owner,
+  local experts run as one batched einsum over (E_local, ep*C, d), and the
+  inverse all_to_all brings results home for the combine einsum. On hardware
+  the all_to_all rides ICI; under GSPMD jit the same module works with the
+  axis unbound and experts replicated/sharded by annotation.
+- **Capacity-based token dropping** with per-(token,slot) priority by position
+  (GShard's position-in-expert cumsum). ``capacity_factor`` >= num_experts/k
+  guarantees droplessness (used by the parity tests).
+
+Default expert axis is ``data`` — the Megatron convention of carving expert
+parallelism out of the data-parallel group, so ep needs no fifth mesh axis and
+composes with TP (experts can themselves be tensor-parallel over ``model``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import DATA_AXIS
+from apex_tpu.transformer.moe.router import (TopKRouter, load_balancing_loss,
+                                             router_z_loss)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+from apex_tpu.transformer.utils import divide
+
+
+@flax.struct.dataclass
+class MoEAuxLosses:
+    """Raw (un-scaled) auxiliary losses plus the pre-scaled total.
+
+    A registered pytree (flax.struct) so it can cross jit/shard_map/grad-aux
+    boundaries — the normal pattern is returning it from a jitted step for
+    logging."""
+
+    load_balance: jnp.ndarray
+    z_loss: jnp.ndarray
+    total: jnp.ndarray  # aux_loss_coeff * load_balance + z_loss_coeff * z_loss
+
+
+def compute_dispatch_combine(probs: jnp.ndarray, k: int, capacity: int,
+                             normalize_gates: bool = False):
+    """Build (combine, dispatch, expert_mask) from router probabilities.
+
+    ``probs``: (T, E) fp32. Returns ``combine`` (T, E, C) fp32 gate weights,
+    ``dispatch`` (T, E, C) 0/1, ``expert_mask`` (T, E) 0/1 (pre-drop top-k
+    assignment, for the balance loss). Slot priority is GShard's: choice rank
+    first (all tokens' 1st choices beat any 2nd choice), token order second.
+
+    ``normalize_gates`` renormalizes each token's top-k gates to sum to 1
+    BEFORE capacity dropping (Mixtral semantics) — a dropped slot's gate mass
+    is lost, like GShard, rather than silently inflating the surviving slots.
+    """
+    t, num_experts = probs.shape
+    top_vals, top_idx = lax.top_k(probs, k)          # (T, k)
+    if normalize_gates:
+        top_vals = top_vals / jnp.maximum(
+            jnp.sum(top_vals, axis=-1, keepdims=True), 1e-9)
+    combine = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, num_experts, capacity), jnp.float32)
+    expert_mask = jnp.zeros((t, num_experts), jnp.float32)
+    counts = jnp.zeros((num_experts,), jnp.int32)    # slots already claimed
+    for i in range(k):                               # k is tiny and static
+        mask_i = jax.nn.one_hot(top_idx[:, i], num_experts,
+                                dtype=jnp.int32)     # (T, E)
+        pos_i = jnp.cumsum(mask_i, axis=0) - 1 + counts[None, :]
+        keep = (pos_i < capacity) & (mask_i > 0)     # (T, E)
+        slot = jax.nn.one_hot(jnp.where(keep, pos_i, capacity), capacity,
+                              dtype=jnp.float32)     # (T, E, C); drop -> 0s
+        dispatch_i = slot * keep[..., None]
+        dispatch = dispatch + dispatch_i
+        combine = combine + dispatch_i * top_vals[:, i][:, None, None]
+        expert_mask = expert_mask + mask_i
+        counts = counts + jnp.sum(mask_i, axis=0)
+    return combine, dispatch, jnp.minimum(expert_mask, 1.0)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed mixture-of-experts FFN (GELU two-layer experts).
+
+    Parameters hold the PER-RANK expert shards (``num_experts /
+    expert_world_size`` experts each), mirroring the per-shard convention of
+    ``ColumnParallelLinear``. Run inside ``shard_map`` with ``axis_name``
+    bound for real expert parallelism; with the axis unbound it degrades to a
+    single-rank dense-dispatch MoE (and ``expert_world_size`` must be 1).
+
+    Returns ``(y, MoEAuxLosses)`` — callers add ``aux.total`` to their loss.
+    """
+
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    normalize_gates: bool = True          # Mixtral-style renormalize top-k
+    aux_loss_coeff: float = 1e-2
+    z_loss_coeff: float = 0.0
+    params_dtype: jnp.dtype = jnp.float32
+    expert_world_size: Optional[int] = None   # default: axis size if bound
+    axis_name: str = DATA_AXIS
+
+    def _world(self) -> int:
+        if self.expert_world_size is not None:
+            return self.expert_world_size
+        if axis_is_bound(self.axis_name):
+            return lax.axis_size(self.axis_name)
+        return 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        orig_shape = x.shape
+        d = self.hidden_size
+        assert orig_shape[-1] == d, (orig_shape, d)
+        x = x.reshape(-1, d)                       # (T_local, d)
+        t = x.shape[0]
+        ep = self._world()
+        if ep > 1 and not axis_is_bound(self.axis_name):
+            raise RuntimeError(
+                f"expert_world_size={ep} but axis '{self.axis_name}' is not "
+                "bound — run inside shard_map (same contract as the TP "
+                "layers) or set expert_world_size=1")
+        if ep > 1 and ep != lax.axis_size(self.axis_name):
+            raise RuntimeError(
+                f"expert_world_size={ep} != size of bound axis "
+                f"'{self.axis_name}' ({lax.axis_size(self.axis_name)})")
+        e_local = divide(self.num_experts, ep)
+        dt = resolve_compute_dtype(x.dtype)
+
+        probs, logits = TopKRouter(self.num_experts,
+                                   params_dtype=self.params_dtype,
+                                   name="router")(x)
+        capacity = max(int(self.capacity_factor * self.k * t
+                           / self.num_experts), 1)
+        combine, dispatch, expert_mask = compute_dispatch_combine(
+            probs, self.k, capacity, normalize_gates=self.normalize_gates)
+
+        # --- dispatch: (T,E,C) x (T,d) -> (E,C,d), bf16 on the MXU
+        xd = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x.astype(dt))
+
+        bound = axis_is_bound(self.axis_name) and ep > 1
+        if bound:
+            # regroup capacity slots under their owning rank's experts:
+            # (E, C, d) --all_to_all--> (E_local, ep*C, d)
+            xd = lax.all_to_all(xd, self.axis_name, split_axis=0,
+                                concat_axis=1, tiled=True)
+
+        # --- local experts: one batched einsum over the expert dim
+        init = nn.initializers.lecun_normal()
+
+        def shard_init(base):
+            def f(key, shape, dtype):
+                if axis_is_bound(self.axis_name):
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.axis_name))
+                return base(key, shape, dtype)
+            return f
+
+        w1 = self.param("w1", shard_init(init),
+                        (e_local, d, self.ffn_hidden_size), self.params_dtype)
+        b1 = self.param("b1", shard_init(nn.initializers.zeros),
+                        (e_local, self.ffn_hidden_size), self.params_dtype)
+        w2 = self.param("w2", shard_init(init),
+                        (e_local, self.ffn_hidden_size, d), self.params_dtype)
+        b2 = self.param("b2", shard_init(nn.initializers.zeros),
+                        (e_local, d), self.params_dtype)
+        h = jnp.einsum("ecd,edf->ecf", xd, w1.astype(dt)) + b1[:, None].astype(dt)
+        h = nn.gelu(h)
+        yd = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) + b2[:, None].astype(dt)
+
+        if bound:
+            # inverse: (E_local, ep*C, d) -> (E, C, d) back on token owners
+            yd = lax.all_to_all(yd, self.axis_name, split_axis=1,
+                                concat_axis=0, tiled=True)
+
+        # --- combine: fp32 gates, fp32 accumulation
+        y = jnp.einsum("tec,ecd->td", combine.astype(dt), yd,
+                       preferred_element_type=jnp.float32)
+        y = y.astype(x.dtype).reshape(orig_shape)
+
+        lb = load_balancing_loss(probs, expert_mask)
+        zl = router_z_loss(logits)
+        if bound:
+            lb = lax.pmean(lb, self.axis_name)
+            zl = lax.pmean(zl, self.axis_name)
+        aux = MoEAuxLosses(
+            load_balance=lb, z_loss=zl,
+            total=self.aux_loss_coeff * lb + self.z_loss_coeff * zl)
+        return y, aux
+
+    forward = __call__
