@@ -76,7 +76,16 @@ def build_spec(tree) -> FlatSpec:
 
 
 def flatten(tree, spec: FlatSpec, dtype=jnp.float32) -> jax.Array:
-    """Concatenate a pytree into one padded ``(total_rows, LANE)`` buffer."""
+    """Concatenate a pytree into one padded ``(total_rows, LANE)`` buffer.
+
+    Built as a concat of per-leaf ``(rows_i, LANE)`` blocks along axis 0 —
+    never as one giant 1D array. A full-buffer 1D<->2D reshape is NOT a
+    bitcast under TPU tiled layouts, and with an odd ``total_rows`` the
+    backend lowers it through a relayout whose intermediate allocates
+    ~64x the buffer (observed on-chip: an f32[N/2, 2] relayout tile-padded
+    2->128 lanes = 86 GB for BERT-Large; TPU_TESTS_r03.log). Row-space
+    concat keeps every reshape leaf-local.
+    """
     leaves = jax.tree.leaves(tree)
     parts: List[jax.Array] = []
     for leaf, n, rows in zip(leaves, spec.sizes, spec.row_counts):
@@ -84,20 +93,25 @@ def flatten(tree, spec: FlatSpec, dtype=jnp.float32) -> jax.Array:
         pad = rows * LANE - n
         if pad:
             v = jnp.concatenate([v, jnp.zeros((pad,), dtype)])
-        parts.append(v)
-    return jnp.concatenate(parts).reshape(spec.total_rows, LANE)
+        parts.append(v.reshape(rows, LANE))
+    return jnp.concatenate(parts, axis=0)
 
 
 def unflatten(flat: jax.Array, spec: FlatSpec, dtypes: Sequence[Any] | None = None):
-    """Slice a ``(total_rows, LANE)`` buffer back into the original pytree."""
-    flat1d = flat.reshape(-1)
+    """Slice a ``(total_rows, LANE)`` buffer back into the original pytree.
+
+    Row-sliced per leaf (2D static slices) so the only 1D reshapes are
+    leaf-sized — see ``flatten`` for why a full-buffer 1D view is
+    catastrophic under TPU tiled layouts.
+    """
     leaves = []
-    for shape, dt, n, off in zip(
+    for shape, dt, n, off, cnt in zip(
         spec.shapes,
         dtypes if dtypes is not None else spec.dtypes,
         spec.sizes,
         spec.row_offsets,
+        spec.row_counts,
     ):
-        chunk = jax.lax.dynamic_slice_in_dim(flat1d, off * LANE, ((n + LANE - 1) // LANE) * LANE)
+        chunk = flat[off:off + cnt].reshape(cnt * LANE)
         leaves.append(chunk[:n].reshape(shape).astype(dt))
     return jax.tree.unflatten(spec.treedef, leaves)

@@ -65,8 +65,12 @@ def _gn_fwd_kernel(x_ref, w_ref, b_ref, y_ref, mean_ref, rstd_ref,
     if act == "silu":
         y = _silu(y)
     y_ref[0] = y.astype(y_ref.dtype)
-    mean_ref[0, 0] = mean
-    rstd_ref[0, 0] = rstd
+    # stats ride in ONE whole-array SMEM block (Mosaic rejects (1, 1)
+    # grid-blocked outputs: block dims must be (8, 128)-divisible or equal
+    # the array's — TPU_TESTS_r03.log); each step writes its own row
+    i = pl.program_id(0)
+    mean_ref[i, 0] = mean
+    rstd_ref[i, 0] = rstd
 
 
 def _kernel_eligible(hw: int, cg: int) -> bool:
@@ -125,8 +129,10 @@ def _gn_fwd(x, weight, bias, num_groups, eps, act):
         out_specs=[
             pl.BlockSpec((1, hw, cg), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((n * g, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((n * g, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n * g, hw, cg), x.dtype),
@@ -152,8 +158,9 @@ def _gn_bwd_kernel(x_ref, dy_ref, w_ref, b_ref, mean_ref, rstd_ref,
     the jnp formulation, measured via cost_analysis; docs/normalization.md)."""
     x = x_ref[0].astype(jnp.float32)
     dy = dy_ref[0].astype(jnp.float32)
-    mean = mean_ref[0, 0]
-    rstd = rstd_ref[0, 0]
+    i = pl.program_id(0)                        # stats: whole-array SMEM block
+    mean = mean_ref[i, 0]
+    rstd = rstd_ref[i, 0]
     xhat = (x - mean) * rstd
     if act == "silu":
         wv = w_ref[0].astype(jnp.float32) if affine else 1.0
@@ -213,8 +220,10 @@ def _gn_bwd(num_groups, eps, act, res, dy):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, cg), lambda i: (i, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((n * g, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((n * g, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, hw, cg), lambda i: (i, 0, 0),

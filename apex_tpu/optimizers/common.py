@@ -71,7 +71,10 @@ class FusedOptimizerBase:
                  exclude_from_weight_decay: Optional[Callable[[str], bool]] = None):
         self.defaults = dict(defaults)
         self.spec: FlatSpec = build_spec(params)
-        self.seg_rows = jnp.asarray(self.spec.segment_rows())
+        # host-side constant: staying numpy means jit embeds it as a literal
+        # without a device round-trip (a device-array closure constant
+        # requires a D2H copy at trace time — the bench_r03 failure mode)
+        self.seg_rows = self.spec.segment_rows()
         self.master = flat_buffer.flatten(params, self.spec)
         self.state = {
             name: jnp.zeros((self.spec.total_rows, LANE), jnp.float32)

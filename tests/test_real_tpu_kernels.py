@@ -148,7 +148,8 @@ def test_scaled_masked_softmax_seq512(tpu, rng):
         scaled_upper_triang_masked_softmax)
 
     b, h = 4, 16
-    x = jnp.asarray(rng.standard_normal((b, h, SEQ, SEQ)), jnp.bfloat16)
+    # reference API: 3D (attn_batches, sq, sk) — apex ScaledUpperTriangMaskedSoftmax
+    x = jnp.asarray(rng.standard_normal((b * h, SEQ, SEQ)), jnp.bfloat16)
     y = jax.jit(lambda x: scaled_upper_triang_masked_softmax(
         x, scale=0.125))(x)
     y32 = np.asarray(y, np.float32)
@@ -301,7 +302,11 @@ def test_flash_attention_tight_head_dim(tpu, rng, monkeypatch):
     ref = jax.jit(functools.partial(flash_attention, causal=True))(q, k, v)
     g_ref = jax.jit(jax.grad(loss))(q)
 
-    import apex_tpu.ops.flash_attention as fa_impl
+    import importlib
+
+    # NB: `import apex_tpu.ops.flash_attention` resolves to the FUNCTION
+    # (ops/__init__ re-export shadows the submodule attribute)
+    fa_impl = importlib.import_module("apex_tpu.ops.flash_attention")
 
     monkeypatch.setattr(fa_impl, "_TIGHT_HEADDIM", True)
     try:
