@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
-from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import flash_attention, ring_attention
 from apex_tpu.transformer.tensor_parallel import (
@@ -53,6 +53,20 @@ class GPTConfig:
     # sequence over it (a replicated sequence under a cp>1 mesh would get
     # wrong position offsets and double-counted ring keys)
     context_parallel: bool = False
+    # --- mixture-of-experts (beyond reference) -------------------------------
+    # num_experts > 0 turns every ``moe_layer_freq``-th block's MLP into a
+    # routed MoEMLP (apex_tpu.transformer.moe). ``expert_parallel`` is the
+    # same explicit opt-in discipline as context_parallel: it asserts the
+    # caller runs inside shard_map with tokens SHARDED over ``data`` so the
+    # experts can shard over that axis (ep = data axis size). Experts are
+    # replicated across TP ranks (each model rank runs the identical MoE —
+    # redundant but consistent; expert-TP composition is a future extension).
+    num_experts: int = 0
+    moe_layer_freq: int = 2          # every Nth block (1 = all blocks)
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 1e-2
+    expert_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -71,9 +85,21 @@ def gpt_tiny_config(**overrides) -> GPTConfig:
 
 
 class ParallelDecoderBlock(nn.Module):
-    """Pre-LN block: LN -> TP attention -> residual -> LN -> TP MLP -> res."""
+    """Pre-LN block: LN -> TP attention -> residual -> LN -> TP MLP -> res.
+
+    With ``config.num_experts > 0`` and this block's ``layer_idx`` selected
+    by ``moe_layer_freq``, the MLP is a routed ``MoEMLP``; its aux loss is
+    sown into the ``intermediates`` collection (``gpt_loss`` collects it).
+    """
 
     config: GPTConfig
+    layer_idx: int = 0
+
+    def _is_moe_layer(self) -> bool:
+        cfg = self.config
+        return (cfg.num_experts > 0
+                and self.layer_idx % cfg.moe_layer_freq
+                == cfg.moe_layer_freq - 1)
 
     @nn.compact
     def __call__(self, x):
@@ -113,13 +139,29 @@ class ParallelDecoderBlock(nn.Module):
 
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
         h = h.astype(dt)
-        h = ColumnParallelLinear(
-            e, 4 * e, gather_output=False, world_size=tp,
-            params_dtype=cfg.param_dtype, name="mlp_in")(h)
-        h = jax.nn.gelu(h, approximate=True)
-        mlp_out = RowParallelLinear(
-            4 * e, e, input_is_parallel=True, world_size=tp,
-            params_dtype=cfg.param_dtype, name="mlp_out")(h)
+        if self._is_moe_layer():
+            from apex_tpu.transformer.moe import MoEMLP
+
+            use_ep = cfg.expert_parallel and _axis_bound(DATA_AXIS)
+            moe = MoEMLP(
+                hidden_size=e, ffn_hidden_size=4 * e,
+                num_experts=cfg.num_experts, k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                aux_loss_coeff=cfg.moe_aux_loss_coeff,
+                params_dtype=cfg.param_dtype,
+                expert_world_size=None if use_ep else 1,
+                axis_name=DATA_AXIS if use_ep else "unbound_ep",
+                name="moe_mlp")
+            mlp_out, aux = moe(h)
+            self.sow("intermediates", "moe_aux", aux.total)
+        else:
+            h = ColumnParallelLinear(
+                e, 4 * e, gather_output=False, world_size=tp,
+                params_dtype=cfg.param_dtype, name="mlp_in")(h)
+            h = jax.nn.gelu(h, approximate=True)
+            mlp_out = RowParallelLinear(
+                4 * e, e, input_is_parallel=True, world_size=tp,
+                params_dtype=cfg.param_dtype, name="mlp_out")(h)
         return x + mlp_out.astype(x.dtype)
 
 
@@ -159,7 +201,7 @@ class GPTModel(nn.Module):
             pos_s = pos[:s]
         x = (x + pos_s[None, :, :]).astype(dt)
         for i in range(cfg.num_layers):
-            x = ParallelDecoderBlock(cfg, name=f"layer_{i}")(x)
+            x = ParallelDecoderBlock(cfg, layer_idx=i, name=f"layer_{i}")(x)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                            name="final_norm")(x)
         # tied LM head: local logits against the LOCAL vocab shard
@@ -168,15 +210,30 @@ class GPTModel(nn.Module):
 
 def gpt_loss(model: GPTModel, variables, input_ids, labels,
              axis_name: str = MODEL_AXIS):
-    """Mean next-token loss from vocab-parallel logits."""
-    logits = model.apply(variables, input_ids)
+    """Mean next-token loss from vocab-parallel logits (+ MoE aux losses)."""
+    moe_aux = jnp.zeros((), jnp.float32)
+    if model.config.num_experts > 0:
+        logits, inter = model.apply(variables, input_ids,
+                                    mutable=["intermediates"])
+
+        def _collect(path, leaf):
+            nonlocal moe_aux
+            # ONLY the sown moe_aux entries: other intermediates (logging
+            # diagnostics) must not leak into the training loss
+            if any(str(getattr(k, "key", k)) == "moe_aux" for k in path):
+                moe_aux = moe_aux + leaf
+            return leaf
+
+        jax.tree_util.tree_map_with_path(_collect, inter)
+    else:
+        logits = model.apply(variables, input_ids)
     if _axis_bound(axis_name):
         per_tok = vocab_parallel_cross_entropy(
             logits.astype(jnp.float32), labels, axis_name=axis_name)
     else:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         per_tok = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    loss = per_tok.mean()
+    loss = per_tok.mean() + moe_aux
     if model.config.context_parallel and _axis_bound(CONTEXT_AXIS):
         # sequence sharded over ``context``: local means combine to the
         # global token mean (equal chunk sizes)

@@ -108,6 +108,13 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
     The ``local`` tree is one device's slice: ``{"blocks": [V?, K, ...],
     "shared": {...}}`` (chunk axis present only under VPP).
     """
+    if cfg.num_experts > 0:
+        # the scanned shared-block formulation can't express per-layer MoE
+        # selection, and block.apply here discards sown aux losses — fail
+        # loud rather than train without load balancing
+        raise NotImplementedError(
+            "pipeline stages do not support MoE blocks yet "
+            "(num_experts > 0); use the non-pipelined GPTModel")
     tp = cfg.tensor_parallel_size
     emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
                                  world_size=tp, params_dtype=cfg.param_dtype)
