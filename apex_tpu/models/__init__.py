@@ -8,6 +8,14 @@ benchmarks (BASELINE.md configs) are reproducible from the library itself.
 """
 
 from apex_tpu.models import bert  # noqa: F401
+from apex_tpu.models import gpt  # noqa: F401
+from apex_tpu.models import llama  # noqa: F401
+from apex_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaModel,
+    llama_loss,
+    llama_tiny_config,
+)
 from apex_tpu.models.bert import (  # noqa: F401
     BertConfig,
     BertForPreTraining,

@@ -208,6 +208,28 @@ class GPTModel(nn.Module):
         return emb.attend(x.astype(dt))
 
 
+def lm_token_loss(logits, labels, axis_name: str = MODEL_AXIS,
+                  context_parallel: bool = False, extra=None):
+    """Mean next-token loss from vocab-PARALLEL logits — the shared loss
+    tail for the decoder LMs (GPT, Llama): vocab-parallel CE when the model
+    axis is bound, log-softmax fallback otherwise, CP pmean of equal-size
+    sequence chunks. ``extra`` (e.g. MoE aux losses computed on this rank's
+    local tokens) is added BEFORE the CP pmean so per-rank terms combine to
+    their global mean too."""
+    if _axis_bound(axis_name):
+        per_tok = vocab_parallel_cross_entropy(
+            logits.astype(jnp.float32), labels, axis_name=axis_name)
+    else:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        per_tok = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    loss = per_tok.mean()
+    if extra is not None:
+        loss = loss + extra
+    if context_parallel and _axis_bound(CONTEXT_AXIS):
+        loss = lax.pmean(loss, CONTEXT_AXIS)
+    return loss
+
+
 def gpt_loss(model: GPTModel, variables, input_ids, labels,
              axis_name: str = MODEL_AXIS):
     """Mean next-token loss from vocab-parallel logits (+ MoE aux losses)."""
@@ -227,15 +249,6 @@ def gpt_loss(model: GPTModel, variables, input_ids, labels,
         jax.tree_util.tree_map_with_path(_collect, inter)
     else:
         logits = model.apply(variables, input_ids)
-    if _axis_bound(axis_name):
-        per_tok = vocab_parallel_cross_entropy(
-            logits.astype(jnp.float32), labels, axis_name=axis_name)
-    else:
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        per_tok = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    loss = per_tok.mean() + moe_aux
-    if model.config.context_parallel and _axis_bound(CONTEXT_AXIS):
-        # sequence sharded over ``context``: local means combine to the
-        # global token mean (equal chunk sizes)
-        loss = lax.pmean(loss, CONTEXT_AXIS)
-    return loss
+    return lm_token_loss(
+        logits, labels, axis_name=axis_name,
+        context_parallel=model.config.context_parallel, extra=moe_aux)

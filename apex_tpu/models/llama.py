@@ -1,0 +1,210 @@
+"""Llama-family decoder (RMSNorm + RoPE + SwiGLU + GQA), tensor-parallel.
+
+No reference analog (apex ships no models; its test GPT is the vendored
+Megatron driver) — this is the second first-class model family, exercising
+the components the BERT/GPT flagships don't: ``FusedRMSNorm``
+(normalization/fused_layer_norm.py), the cached-RoPE functional
+(transformer/functional/fused_rope.py, reference
+fused_rotary_positional_embedding), grouped-query attention on the flash
+kernel, and a SwiGLU MLP over the Megatron TP linears.
+
+Same parallel contract as GPTModel (models/gpt.py): runs inside shard_map
+with ``model`` bound for TP (heads AND kv-heads divide over the axis),
+``context_parallel`` opts into ring attention with the sequence sharded
+over ``context``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.ops import flash_attention, ring_attention
+from apex_tpu.transformer.functional.fused_rope import (
+    fused_apply_rotary_pos_emb_cached,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_is_bound as _axis_bound,
+)
+from apex_tpu.transformer.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008       # SwiGLU inner width
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32               # < num_heads => GQA
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tensor_parallel_size: int = 1
+    context_parallel: bool = False       # same opt-in as GPTConfig
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def llama_tiny_config(**overrides) -> LlamaConfig:
+    base = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=176,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       max_position_embeddings=128, dtype=jnp.float32)
+    return dataclasses.replace(base, **overrides)
+
+
+def _rope_cos_sin(cfg: LlamaConfig, s: int, offset):
+    """cos/sin tables for local positions [offset, offset+s), shape
+    (s, 1, 1, head_dim) — the cached-RoPE layout ([sq, b, np, hn])."""
+    d = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta
+                 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = (jnp.arange(s, dtype=jnp.float32) + offset)[:, None]  # (s, d/2)
+    ang = pos * inv[None, :]
+    # fused_rope rotate-half convention: [first-half | second-half] pairs
+    freqs = jnp.concatenate([ang, ang], axis=-1)                # (s, d)
+    return (jnp.cos(freqs)[:, None, None, :],
+            jnp.sin(freqs)[:, None, None, :])
+
+
+class LlamaDecoderBlock(nn.Module):
+    """Pre-RMSNorm block: attn (RoPE + GQA flash) -> res -> SwiGLU -> res."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos_, sin_):
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        tp = cfg.tensor_parallel_size
+        e = cfg.hidden_size
+        h_local = divide(cfg.num_heads, tp)
+        kv_local = divide(cfg.num_kv_heads, tp)
+        d = cfg.head_dim
+        b, s, _ = x.shape
+
+        h = FusedRMSNorm(e, eps=cfg.rms_eps, name="input_norm")(x)
+        h = h.astype(dt)
+        q = ColumnParallelLinear(
+            e, cfg.num_heads * d, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="q_proj")(h)
+        kv = ColumnParallelLinear(
+            e, 2 * cfg.num_kv_heads * d, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="kv_proj")(h)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def to_shd(t, nh):  # (b, s, nh*d) -> (s, b, nh, d): rope layout
+            return t.reshape(b, s, nh, d).transpose(1, 0, 2, 3)
+
+        q = fused_apply_rotary_pos_emb_cached(to_shd(q, h_local), cos_, sin_)
+        k = fused_apply_rotary_pos_emb_cached(to_shd(k, kv_local), cos_, sin_)
+
+        def to_bhsd(t):  # (s, b, nh, d) -> (b, nh, s, d): kernel layout
+            return t.transpose(1, 2, 0, 3)
+
+        q, k = to_bhsd(q), to_bhsd(k)
+        v = v.reshape(b, s, kv_local, d).transpose(0, 2, 1, 3)
+        if kv_local != h_local:
+            # GQA: each kv head serves num_heads/num_kv_heads query heads;
+            # materialize the repeat (the flash kernel takes equal head
+            # counts — a kv-indexed kernel variant is a future optimization).
+            # divide() raises on non-divisible ratios at the source instead
+            # of a shape error deep in the kernel.
+            rep = divide(h_local, kv_local)
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+            ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS, causal=True)
+        else:
+            ctx = flash_attention(q, k, v, causal=True)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
+        attn_out = RowParallelLinear(
+            e, e, bias=False, input_is_parallel=True, world_size=tp,
+            params_dtype=cfg.param_dtype, name="o_proj")(ctx)
+        x = x + attn_out.astype(x.dtype)
+
+        h = FusedRMSNorm(e, eps=cfg.rms_eps, name="post_norm")(x)
+        h = h.astype(dt)
+        # gate+up fused into ONE column-parallel GEMM (same pattern as
+        # kv_proj): one weight-load pass over h instead of two; local
+        # layout is [gate_r | up_r]
+        gate_up = ColumnParallelLinear(
+            e, 2 * cfg.intermediate_size, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="gate_up_proj")(h)
+        gate, up = jnp.split(gate_up, 2, axis=-1)
+        mlp_out = RowParallelLinear(
+            cfg.intermediate_size, e, bias=False, input_is_parallel=True,
+            world_size=tp, params_dtype=cfg.param_dtype, name="down_proj")(
+            jax.nn.silu(gate) * up)
+        return x + mlp_out.astype(x.dtype)
+
+
+class LlamaModel(nn.Module):
+    """Decoder-only LM -> vocab-PARALLEL logits [B, S, vocab/tp] (feed to
+    ``vocab_parallel_cross_entropy``). Untied LM head by default (Llama
+    convention); ``tie_word_embeddings=True`` uses the embedding transpose."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        b, s = input_ids.shape
+        emb = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            world_size=cfg.tensor_parallel_size,
+            params_dtype=cfg.param_dtype, name="embed_tokens")
+        x = emb(input_ids).astype(dt)
+
+        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+            cp = lax.axis_size(CONTEXT_AXIS)
+            if cp * s > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"global sequence cp*s = {cp}*{s} exceeds "
+                    f"max_position_embeddings={cfg.max_position_embeddings}")
+            offset = lax.axis_index(CONTEXT_AXIS) * s
+        else:
+            offset = 0
+        cos_, sin_ = _rope_cos_sin(cfg, s, offset)
+
+        for i in range(cfg.num_layers):
+            x = LlamaDecoderBlock(cfg, name=f"layer_{i}")(x, cos_, sin_)
+        x = FusedRMSNorm(cfg.hidden_size, eps=cfg.rms_eps, name="final_norm")(x)
+        x = x.astype(dt)
+        if cfg.tie_word_embeddings:
+            return emb.attend(x)
+        head = ColumnParallelLinear(
+            cfg.hidden_size, cfg.vocab_size, bias=False, gather_output=False,
+            world_size=cfg.tensor_parallel_size,
+            params_dtype=cfg.param_dtype, name="lm_head")
+        return head(x)
+
+
+def llama_loss(model: LlamaModel, variables, input_ids, labels,
+               axis_name: str = MODEL_AXIS):
+    """Mean next-token loss from vocab-parallel logits (shared LM tail)."""
+    from apex_tpu.models.gpt import lm_token_loss
+
+    logits = model.apply(variables, input_ids)
+    return lm_token_loss(logits, labels, axis_name=axis_name,
+                         context_parallel=model.config.context_parallel)

@@ -1,0 +1,143 @@
+"""Llama family: GQA/RoPE/SwiGLU correctness + TP parity + train smoke.
+
+No reference analog (apex ships no models); the TP parity harness mirrors
+tests/test_gpt_model.py, and the RoPE check pins the rotate-half convention
+against a from-scratch complex-rotation reference.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.models.llama import (LlamaModel, llama_loss, llama_tiny_config,
+                                   _rope_cos_sin)
+
+
+def test_rope_matches_complex_rotation(rng):
+    """cos/sin tables + rotate-half == complex rotation e^{i*pos*theta_j} on
+    (x_j, x_{j+d/2}) pairs (the NeoX/Llama pairing)."""
+    cfg = llama_tiny_config()
+    s, d = 8, cfg.head_dim
+    cos_, sin_ = _rope_cos_sin(cfg, s, 0)
+    x = rng.standard_normal((s, 1, 1, d)).astype(np.float32)
+
+    from apex_tpu.transformer.functional.fused_rope import (
+        fused_apply_rotary_pos_emb_cached)
+    y = np.asarray(fused_apply_rotary_pos_emb_cached(jnp.asarray(x),
+                                                     cos_, sin_))
+
+    half = d // 2
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, d, 2) / d))
+    for p in range(s):
+        zr = x[p, 0, 0, :half] + 1j * x[p, 0, 0, half:]
+        zr = zr * np.exp(1j * p * inv)
+        expect = np.concatenate([zr.real, zr.imag])
+        np.testing.assert_allclose(y[p, 0, 0], expect, rtol=1e-5, atol=1e-5)
+
+
+def test_gqa_matches_repeated_dense_attention(rng):
+    """num_kv_heads=2 < num_heads=4: model output == manually computed
+    attention with kv heads repeated."""
+    from apex_tpu.ops import flash_attention
+
+    b, h, kvh, s, d = 2, 4, 2, 16, 16
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    kr = jnp.repeat(k, h // kvh, axis=1)
+    vr = jnp.repeat(v, h // kvh, axis=1)
+    out = flash_attention(q, kr, vr, causal=True)
+    # per-head dense reference
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kr) / np.sqrt(d)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), vr)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_llama_train_smoke(rng):
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = llama_tiny_config()
+    model = LlamaModel(cfg)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    params = v["params"]
+    assert "lm_head" in params            # untied head (Llama convention)
+    assert "kv_proj" in params["layer_0"]  # GQA projections present
+    opt = FusedAdam(params, lr=3e-3)
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p: llama_loss(model, {"params": p}, ids, labels)))
+    losses = []
+    for _ in range(6):
+        loss, g = grad_fn(params)
+        params = opt.step(g)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def _shard_tree(params1, params_tp_shape, rank, tp):
+    """Slice a tp=1 Llama tree into rank's tp shard (no fused-qkv special
+    case: q/kv/gate/up are column-split, o/down row-split, vocab dims split
+    — all inferred by which dim shrank)."""
+
+    def slice_leaf(path, full, shard):
+        if full.shape == shard.shape:
+            return full
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        if "kv_proj" in name or "gate_up_proj" in name:
+            # fused 2-part projections: local layout is [A_r | B_r], so
+            # slice per-half, not contiguously
+            per = shard.shape[0] // 2
+            t = full.reshape(2, full.shape[0] // 2, *full.shape[1:])
+            return t[:, rank * per:(rank + 1) * per].reshape(shard.shape)
+        for ax in range(full.ndim):
+            if full.shape[ax] == shard.shape[ax] * tp:
+                size = shard.shape[ax]
+                idx = [slice(None)] * full.ndim
+                idx[ax] = slice(rank * size, (rank + 1) * size)
+                return full[tuple(idx)]
+        raise AssertionError(f"unsliceable {name}: {full.shape} -> {shard.shape}")
+
+    return jax.tree_util.tree_map_with_path(slice_leaf, params1,
+                                            params_tp_shape)
+
+
+@pytest.mark.slow
+def test_llama_tp2_matches_tp1(rng):
+    from apex_tpu.transformer import parallel_state
+
+    tp = 2
+    mesh = parallel_state.initialize_model_parallel(tp)
+    cfg1 = llama_tiny_config(tensor_parallel_size=1)
+    cfgt = llama_tiny_config(tensor_parallel_size=tp)
+    ids = jnp.asarray(rng.integers(0, cfg1.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    m1 = LlamaModel(cfg1)
+    v1 = m1.init(jax.random.PRNGKey(0), ids)
+    loss1 = float(llama_loss(m1, v1, ids, labels, axis_name="unbound"))
+
+    mt = LlamaModel(cfgt)
+    vt_shape = jax.eval_shape(lambda: mt.init(jax.random.PRNGKey(0), ids))
+    shards = [_shard_tree(v1["params"], vt_shape["params"], r, tp)
+              for r in range(tp)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *shards)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(MODEL_AXIS), P(), P()), out_specs=P(MODEL_AXIS),
+        check_vma=False)
+    def run(vs, ii, ll):
+        v = jax.tree.map(lambda t: t[0], vs)
+        return llama_loss(mt, {"params": v}, ii, ll).reshape(1)
+
+    losst = run(stacked, ids, labels)
+    np.testing.assert_allclose(np.asarray(losst), loss1, rtol=2e-5, atol=2e-5)
