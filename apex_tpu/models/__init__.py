@@ -9,6 +9,14 @@ benchmarks (BASELINE.md configs) are reproducible from the library itself.
 
 from apex_tpu.models import bert  # noqa: F401
 from apex_tpu.models import gpt  # noqa: F401
+from apex_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    gpt2_small_config,
+    gpt_loss,
+    gpt_tiny_config,
+    lm_token_loss,
+)
 from apex_tpu.models import llama  # noqa: F401
 from apex_tpu.models.llama import (  # noqa: F401
     LlamaConfig,

@@ -26,6 +26,7 @@ from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
 from apex_tpu.ops import flash_attention, ring_attention
 from apex_tpu.transformer.functional.fused_rope import (
@@ -122,12 +123,16 @@ class LlamaDecoderBlock(nn.Module):
 
         q, k = to_bhsd(q), to_bhsd(k)
         v = v.reshape(b, s, kv_local, d).transpose(0, 2, 1, 3)
-        if kv_local != h_local:
-            # GQA: each kv head serves num_heads/num_kv_heads query heads;
-            # materialize the repeat (the flash kernel takes equal head
-            # counts — a kv-indexed kernel variant is a future optimization).
-            # divide() raises on non-divisible ratios at the source instead
-            # of a shape error deep in the kernel.
+        # GQA: the flash kernel indexes kv heads natively (h // rep in its
+        # block index maps) — no repeated K/V in HBM. divide() raises on
+        # non-divisible ratios at the source.
+        divide(h_local, kv_local)
+        if (cfg.context_parallel and _axis_bound(CONTEXT_AXIS)
+                and kv_local != h_local):
+            # ring attention rotates K/V between ranks; keep the rotation
+            # payload small too, but its kernel path takes equal heads —
+            # repeat only here (still rep-times smaller ppermute traffic
+            # would need a GQA-aware ring; future optimization)
             rep = divide(h_local, kv_local)
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
@@ -178,13 +183,16 @@ class LlamaModel(nn.Module):
 
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             cp = lax.axis_size(CONTEXT_AXIS)
-            if cp * s > cfg.max_position_embeddings:
-                raise ValueError(
-                    f"global sequence cp*s = {cp}*{s} exceeds "
-                    f"max_position_embeddings={cfg.max_position_embeddings}")
             offset = lax.axis_index(CONTEXT_AXIS) * s
         else:
+            cp = 1
             offset = 0
+        if cp * s > cfg.max_position_embeddings:
+            # RoPE would silently extrapolate past the trained range;
+            # enforce uniformly (CP and single-device alike)
+            raise ValueError(
+                f"global sequence cp*s = {cp}*{s} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
         cos_, sin_ = _rope_cos_sin(cfg, s, offset)
 
         for i in range(cfg.num_layers):
@@ -203,8 +211,6 @@ class LlamaModel(nn.Module):
 def llama_loss(model: LlamaModel, variables, input_ids, labels,
                axis_name: str = MODEL_AXIS):
     """Mean next-token loss from vocab-parallel logits (shared LM tail)."""
-    from apex_tpu.models.gpt import lm_token_loss
-
     logits = model.apply(variables, input_ids)
     return lm_token_loss(logits, labels, axis_name=axis_name,
                          context_parallel=model.config.context_parallel)

@@ -192,10 +192,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         lse_ref[0, 0] = m_ref[...] + jnp.log(l_safe)
 
 
+def _gqa_rep(heads: int, kv_heads: int) -> int:
+    """Query-heads-per-kv-head ratio (1 = standard MHA). The kernels index
+    the kv head as ``h // rep`` in their BlockSpec index maps, so GQA/MQA
+    never materialize repeated K/V in HBM (the win over jnp.repeat)."""
+    if heads % kv_heads != 0:
+        raise ValueError(
+            f"q heads ({heads}) must be a multiple of kv heads ({kv_heads})")
+    return heads // kv_heads
+
+
 def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
             block_q, block_k):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
+    rep = _gqa_rep(heads, k.shape[1])
     bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
     d_pad = _head_pad(d)
 
@@ -209,9 +220,11 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     in_specs = [
         pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, i, j: (b, h, j, 0),
+        pl.BlockSpec((1, 1, bk, d_pad),
+                     lambda b, h, i, j: (b, h // rep, j, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, i, j: (b, h, j, 0),
+        pl.BlockSpec((1, 1, bk, d_pad),
+                     lambda b, h, i, j: (b, h // rep, j, 0),
                      memory_space=pltpu.VMEM),
     ]
     args = [qp, kp, vp]
@@ -403,6 +416,8 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                  delta_adjust=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
+    kv_heads = k.shape[1]
+    rep = _gqa_rep(heads, kv_heads)
     bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
     d_pad = _head_pad(d)
 
@@ -448,8 +463,9 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                                 memory_space=pltpu.VMEM)
 
         def kspec():
+            # kv head = q head // rep (GQA; rep=1 is standard MHA)
             return pl.BlockSpec((1, 1, bk, d_pad),
-                                lambda *g: (g[0], g[1], idx_k(g), 0),
+                                lambda *g: (g[0], g[1] // rep, idx_k(g), 0),
                                 memory_space=pltpu.VMEM)
 
         def rspec():
@@ -543,6 +559,14 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         interpret=_INTERPRET(),
     )(*base_args)
 
+    if rep > 1:
+        # per-q-head dk/dv partials -> their kv head (fp32 accumulation);
+        # identical math to jnp.repeat's VJP but without the forward ever
+        # materializing repeated K/V
+        dk = dk.astype(jnp.float32).reshape(
+            batch, kv_heads, rep, *dk.shape[2:]).sum(axis=2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(
+            batch, kv_heads, rep, *dv.shape[2:]).sum(axis=2).astype(v.dtype)
     return (dq[:, :, :q_len, :d], dk[:, :, :kv_len, :d], dv[:, :, :kv_len, :d])
 
 
@@ -638,7 +662,11 @@ def flash_attention(
 
     Args:
       q: [batch, heads, q_len, head_dim].
-      k, v: [batch, heads, kv_len, head_dim].
+      k, v: [batch, kv_heads, kv_len, head_dim] — ``kv_heads`` may DIVIDE
+        ``heads`` (grouped-query / multi-query attention, beyond the
+        reference's equal-heads kernels): the kernels index the kv head as
+        ``h // (heads/kv_heads)`` in their block index maps, so GQA never
+        materializes repeated K/V in HBM.
       bias: optional additive bias/mask broadcastable to
         [batch, heads, q_len, kv_len] (the reference's arbitrary attention
         mask, generic_scaled_masked_softmax); NOT differentiated (masks are
@@ -677,6 +705,10 @@ def mha_reference(q, k, v, bias=None, segment_ids=None, kv_segment_ids=None,
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if segment_ids is not None and kv_segment_ids is None:
         kv_segment_ids = segment_ids
+    if k.shape[1] != q.shape[1]:  # GQA ground truth: repeat kv heads
+        rep = _gqa_rep(q.shape[1], k.shape[1])
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if bias is not None:

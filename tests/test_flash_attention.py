@@ -168,3 +168,42 @@ def test_long_sequence_no_cap(rng):
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(out.astype(jnp.float32),
                                ref.astype(jnp.float32), atol=3e-2, rtol=3e-2)
+
+
+@pytest.mark.parametrize("kvh,causal", [(1, False), (2, True)])
+def test_gqa_native_kv_heads(rng, kvh, causal):
+    """GQA/MQA: kv_heads < heads handled by kernel index maps (no repeated
+    K/V in HBM). Forward vs the repeat-based reference; grads vs the
+    jnp.repeat formulation (whose VJP is the same per-group sum)."""
+    b, h, s, d = 2, 4, 64, 32
+    rep = h // kvh
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, kvh, s, d)), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=causal)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_native(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_repeat(q, k, v):
+        return jnp.sum(flash_attention(q, jnp.repeat(k, rep, axis=1),
+                                       jnp.repeat(v, rep, axis=1),
+                                       causal=causal) ** 2)
+
+    gn = jax.grad(loss_native, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_repeat, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(gn, gr):
+        assert a.shape == r.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_rejects_non_divisible(rng):
+    q = jnp.zeros((1, 6, 16, 32), jnp.float32)
+    k = jnp.zeros((1, 4, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k, k)

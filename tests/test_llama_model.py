@@ -141,3 +141,45 @@ def test_llama_tp2_matches_tp1(rng):
 
     losst = run(stacked, ids, labels)
     np.testing.assert_allclose(np.asarray(losst), loss1, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_llama_cp2_matches_single_device(rng):
+    """Sequence sharded over ``context`` (ring attention + RoPE offsets +
+    GQA repeat-before-ring) == the single-device model, same params."""
+    import dataclasses
+
+    from apex_tpu.transformer import parallel_state
+
+    cfg = llama_tiny_config()
+    model = LlamaModel(cfg)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    loss_ref = float(llama_loss(model, v, ids, labels))
+
+    mesh = parallel_state.initialize_model_parallel(
+        1, 1, context_parallel_size_=2)
+    cfg_cp = dataclasses.replace(cfg, context_parallel=True)
+    m_cp = LlamaModel(cfg_cp)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(None, "context"), P(None, "context")),
+        out_specs=P(), check_vma=False)
+    def cp_loss(p, ii, ll):
+        return llama_loss(m_cp, {"params": p}, ii, ll)
+
+    with mesh:
+        loss_cp = float(jax.jit(cp_loss)(v["params"], ids, labels))
+    np.testing.assert_allclose(loss_cp, loss_ref, rtol=2e-5, atol=2e-5)
+
+
+def test_llama_rejects_overlong_sequence(rng):
+    import dataclasses
+
+    cfg = dataclasses.replace(llama_tiny_config(), max_position_embeddings=16)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((1, 32), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        model.init(jax.random.PRNGKey(0), ids)
