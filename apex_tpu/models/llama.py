@@ -123,19 +123,11 @@ class LlamaDecoderBlock(nn.Module):
 
         q, k = to_bhsd(q), to_bhsd(k)
         v = v.reshape(b, s, kv_local, d).transpose(0, 2, 1, 3)
-        # GQA: the flash kernel indexes kv heads natively (h // rep in its
-        # block index maps) — no repeated K/V in HBM. divide() raises on
+        # GQA: both the flash kernel and the ring index kv heads natively
+        # (h // rep block index maps) — no repeated K/V in HBM, and under CP
+        # the ppermute payload stays rep-times smaller. divide() raises on
         # non-divisible ratios at the source.
         divide(h_local, kv_local)
-        if (cfg.context_parallel and _axis_bound(CONTEXT_AXIS)
-                and kv_local != h_local):
-            # ring attention rotates K/V between ranks; keep the rotation
-            # payload small too, but its kernel path takes equal heads —
-            # repeat only here (still rep-times smaller ppermute traffic
-            # would need a GQA-aware ring; future optimization)
-            rep = divide(h_local, kv_local)
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
 
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS, causal=True)

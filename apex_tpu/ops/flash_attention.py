@@ -19,8 +19,10 @@ accumulates dq over k-blocks and (dk, dv) over q-blocks in two kernels.
 All matmuls hit the MXU in the input dtype with fp32 accumulation; softmax
 math is fp32 on the VPU.
 
-Layout: q [B, H, Sq, D], k/v [B, H, Sk, D] (batch-first; module facades adapt
-the reference's seq-first [S, B, H*D] layout).
+Layout: q [B, H, Sq, D], k/v [B, Hkv, Sk, D] where Hkv divides H (GQA/MQA:
+the kernels index the kv head as ``h // (H/Hkv)`` in their block index maps
+— never materialize repeated K/V at a call site). Batch-first; module
+facades adapt the reference's seq-first [S, B, H*D] layout.
 """
 
 from __future__ import annotations

@@ -46,6 +46,24 @@ def _rotate(x, axis_name, cp):
     return lax.ppermute(x, axis_name, [(i, (i + 1) % cp) for i in range(cp)])
 
 
+def _check_ring_shapes(q, k, v, kind: str):
+    """Self-attention ring contract, GQA-aware: q [B, H, S_loc, D] with k/v
+    [B, Hkv, S_loc, D], Hkv dividing H (the flash kernel indexes kv heads
+    natively, so the ring rotates the UNEXPANDED K/V — ppermute payload
+    shrinks by H/Hkv under grouped-query attention)."""
+    if k.shape != v.shape:
+        raise ValueError(f"{kind}: k/v shapes differ: {k.shape}/{v.shape}")
+    if (q.shape[0], q.shape[2], q.shape[3]) != (k.shape[0], k.shape[2],
+                                                k.shape[3]):
+        raise ValueError(
+            f"{kind} self-attention needs matching batch/seq/head-dim, got "
+            f"q {q.shape} vs kv {k.shape}")
+    if q.shape[1] % k.shape[1] != 0:
+        raise ValueError(
+            f"{kind}: q heads ({q.shape[1]}) must be a multiple of kv heads "
+            f"({k.shape[1]})")
+
+
 def _merge(o1, lse1, o2, lse2):
     """Numerically-stable combine of two normalized partial attentions.
 
@@ -83,8 +101,10 @@ def ring_attention(
     device i holds tokens [i*S_loc, (i+1)*S_loc).
 
     Args:
-      q, k, v: local chunks [B, H, S_loc, D] (self-attention ring: q and kv
-        share the sequence sharding; cross-attention rings are out of scope).
+      q, k, v: local chunks [B, H, S_loc, D]; k/v may carry FEWER heads
+        (GQA/MQA — see ``_check_ring_shapes``). Self-attention ring: q and
+        kv share the sequence sharding; cross-attention rings are out of
+        scope.
       causal: global causal masking across the full (unsharded) sequence.
       scale: softmax scale, default 1/sqrt(D).
 
@@ -92,10 +112,7 @@ def ring_attention(
     identical (up to fp accumulation order) to single-device
     ``flash_attention`` on the gathered sequence.
     """
-    if q.shape != k.shape or k.shape != v.shape:
-        raise ValueError(
-            f"ring self-attention needs equal q/k/v chunk shapes, got "
-            f"{q.shape}/{k.shape}/{v.shape}")
+    _check_ring_shapes(q, k, v, "ring")
     d = q.shape[-1]
     scale = (1.0 / (d ** 0.5)) if scale is None else float(scale)
     cp = lax.psum(1, axis_name)  # static axis size inside shard_map
@@ -190,10 +207,7 @@ def ring_attention_zigzag(
     Fully differentiable (custom_vjp flash + jnp merges + ppermute
     transpose).
     """
-    if q.shape != k.shape or k.shape != v.shape:
-        raise ValueError(
-            f"zigzag ring needs equal q/k/v chunk shapes, got "
-            f"{q.shape}/{k.shape}/{v.shape}")
+    _check_ring_shapes(q, k, v, "zigzag ring")
     if q.shape[2] % 2:
         raise ValueError("local zigzag slice must hold two half-chunks")
     d = q.shape[-1]

@@ -145,8 +145,8 @@ def test_llama_tp2_matches_tp1(rng):
 
 @pytest.mark.slow
 def test_llama_cp2_matches_single_device(rng):
-    """Sequence sharded over ``context`` (ring attention + RoPE offsets +
-    GQA repeat-before-ring) == the single-device model, same params."""
+    """Sequence sharded over ``context`` (ring attention rotating the
+    UNEXPANDED GQA K/V + RoPE offsets) == the single-device model."""
     import dataclasses
 
     from apex_tpu.transformer import parallel_state
