@@ -15,7 +15,7 @@ Tied embeddings: every stage's local tree carries the shared params (embed /
 pos / final norm); only stage 0 (embed) and the last stage (head) produce
 nonzero grads for them, so ``psum`` of the shared-grad subtree over the
 stage axis reproduces the reference's embedding all-reduce exactly —
-``psum_shared_grads`` below does this.
+the ``.sum(0)`` over shared grads inside ``merge_pipeline_grads`` does this.
 """
 
 from __future__ import annotations
@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS, STAGE_AXIS
 from apex_tpu.models.gpt import GPTConfig, GPTModel, ParallelDecoderBlock
 from apex_tpu.normalization import FusedLayerNorm
@@ -38,16 +39,20 @@ from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
 from apex_tpu.transformer.utils import divide
 
 
-def split_gpt_params_for_pipeline(params, n_stages: int, num_layers: int,
-                                  virtual_chunks: int = 1):
-    """Partition a GPTModel param tree into the pipeline layout.
+GPT_SHARED_NAMES = ("word_embeddings", "position_embeddings", "final_norm")
+
+
+def split_params_for_pipeline(params, n_stages: int, num_layers: int,
+                              shared_names, virtual_chunks: int = 1):
+    """Partition a layer_i-structured param tree into the pipeline layout
+    (model-agnostic core; GPT/Llama wrappers below fix ``shared_names``).
 
     Returns a pytree whose leaves are stacked ``[n_stages, ...]`` for use
     with ``shard_map(in_specs=P(STAGE_AXIS))``:
 
       {"blocks": [S, V, K, ...] per-stage chunk-stacked decoder blocks,
-       "shared": [S, ...] the embed/pos/final-norm params REPLICATED to
-                 every stage (tied-embedding layout)}
+       "shared": [S, ...] the ``shared_names`` params REPLICATED to every
+                 stage (tied-embedding layout)}
 
     With ``virtual_chunks=V>1``, stage s's chunk v holds global layers of
     virtual stage ``v*S + s`` (Megatron's round-robin VPP assignment).
@@ -68,20 +73,16 @@ def split_gpt_params_for_pipeline(params, n_stages: int, num_layers: int,
         blocks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *chunks))
     blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
 
-    shared = {
-        "word_embeddings": params["word_embeddings"],
-        "position_embeddings": params["position_embeddings"],
-        "final_norm": params["final_norm"],
-    }
+    shared = {name: params[name] for name in shared_names}
     shared = jax.tree.map(
         lambda x: jnp.broadcast_to(x[None], (n_stages,) + x.shape), shared)
     return {"blocks": blocks, "shared": shared}
 
 
-def merge_pipeline_grads_to_gpt(grads, n_stages: int, num_layers: int,
-                                virtual_chunks: int = 1):
-    """Inverse of ``split_gpt_params_for_pipeline`` for STACKED grad trees
-    (leaves ``[S, ...]``): reassembles a GPTModel-layout grad tree, summing
+def merge_pipeline_grads(grads, n_stages: int, num_layers: int,
+                         shared_names, virtual_chunks: int = 1):
+    """Inverse of ``split_params_for_pipeline`` for STACKED grad trees
+    (leaves ``[S, ...]``): reassembles a model-layout grad tree, summing
     the shared-param grads over stages (the tied-embedding all-reduce)."""
     chunk_layers = divide(num_layers, n_stages * virtual_chunks)
     out = {}
@@ -91,9 +92,23 @@ def merge_pipeline_grads_to_gpt(grads, n_stages: int, num_layers: int,
             for k in range(chunk_layers):
                 out[f"layer_{vs * chunk_layers + k}"] = jax.tree.map(
                     lambda t, s=s, v=v, k=k: t[s, v, k], grads["blocks"])
-    for name in ("word_embeddings", "position_embeddings", "final_norm"):
+    for name in shared_names:
         out[name] = jax.tree.map(lambda t: t.sum(0), grads["shared"][name])
     return out
+
+
+def split_gpt_params_for_pipeline(params, n_stages: int, num_layers: int,
+                                  virtual_chunks: int = 1):
+    """GPT layout: see ``split_params_for_pipeline``."""
+    return split_params_for_pipeline(params, n_stages, num_layers,
+                                     GPT_SHARED_NAMES, virtual_chunks)
+
+
+def merge_pipeline_grads_to_gpt(grads, n_stages: int, num_layers: int,
+                                virtual_chunks: int = 1):
+    """GPT layout: see ``merge_pipeline_grads``."""
+    return merge_pipeline_grads(grads, n_stages, num_layers,
+                                GPT_SHARED_NAMES, virtual_chunks)
 
 
 def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
@@ -143,7 +158,8 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
         else:
             pos = sh["position_embeddings"][:s]
         x = x + pos[None, :, :]
-        return x.astype(cfg.dtype)
+        # amp O1 seam: same cast as the dense GPTModel
+        return x.astype(resolve_compute_dtype(cfg.dtype))
 
     def stage_fn(local, x):
         def body(h, bp):
@@ -156,7 +172,7 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
         sh = local["shared"]
         h = norm.apply({"params": sh["final_norm"]}, y)
         logits = emb.apply({"params": sh["word_embeddings"]},
-                           h.astype(cfg.dtype),
+                           h.astype(resolve_compute_dtype(cfg.dtype)),
                            method=VocabParallelEmbedding.attend)
         if axis_is_bound(MODEL_AXIS):
             per_tok = vocab_parallel_cross_entropy(

@@ -1,0 +1,121 @@
+"""Stage-partitioned Llama: pipeline parallelism for the second model
+family, composed with TP (and CP) exactly like gpt_pipeline.py.
+
+Same stage contract as the GPT composition (reference:
+apex/transformer/pipeline_parallel exercised through Megatron models):
+embedding preprocess on stage 0, the untied LM head + final RMSNorm on the
+last stage, decoder blocks scanned per stage. RoPE cos/sin tables are NOT
+parameters — each stage recomputes them from the config (with the CP
+position offset), so activations crossing stage boundaries stay a single
+[B, S, E] tensor.
+
+Shared-param layout: ``embed_tokens`` / ``final_norm`` / ``lm_head`` ride
+replicated on every stage ("shared" subtree); only the stages that use them
+produce nonzero grads, and ``merge_pipeline_grads`` sums over stages (for
+``tie_word_embeddings=True`` the embed grad gets contributions from both
+ends — the reference's embedding all-reduce).
+
+Known layout cost: the shard_map-over-``stage`` formulation requires one
+HOMOGENEOUS local tree per stage, so the untied ``lm_head`` (and the
+embedding) are replicated to stages that never touch them — at vocab 32k /
+hidden 4k that is ~125 MB fp32 per matrix per stage of idle HBM. The
+replicas cost no compute (zero grads sum away), and at large pp either tie
+the embeddings (one shared matrix) or shard the head over ``model`` (TP
+already divides it by tp). A per-stage-heterogeneous layout would need the
+schedules to drop the single-tree contract — deliberately not done.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.models.gpt import lm_token_loss
+from apex_tpu.models.gpt_pipeline import (merge_pipeline_grads,
+                                          split_params_for_pipeline)
+from apex_tpu.models.llama import (LlamaConfig, LlamaDecoderBlock,
+                                   _rope_cos_sin)
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.transformer.tensor_parallel import (ColumnParallelLinear,
+                                                  VocabParallelEmbedding)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+
+
+def llama_shared_names(cfg: LlamaConfig):
+    names = ["embed_tokens", "final_norm"]
+    if not cfg.tie_word_embeddings:
+        names.append("lm_head")
+    return tuple(names)
+
+
+def split_llama_params_for_pipeline(cfg: LlamaConfig, params, n_stages: int,
+                                    virtual_chunks: int = 1):
+    return split_params_for_pipeline(params, n_stages, cfg.num_layers,
+                                     llama_shared_names(cfg), virtual_chunks)
+
+
+def merge_pipeline_grads_to_llama(cfg: LlamaConfig, grads, n_stages: int,
+                                  virtual_chunks: int = 1):
+    return merge_pipeline_grads(grads, n_stages, cfg.num_layers,
+                                llama_shared_names(cfg), virtual_chunks)
+
+
+def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
+    """(first_fn, stage_fn, loss_fn) for the pipeline schedules
+    (use with ``loss_with_params=True``), mirroring make_gpt_pipeline_fns."""
+    tp = cfg.tensor_parallel_size
+    emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                 world_size=tp, params_dtype=cfg.param_dtype)
+    block = LlamaDecoderBlock(cfg)
+    norm = FusedRMSNorm(cfg.hidden_size, eps=cfg.rms_eps)
+    head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, bias=False,
+                                gather_output=False, world_size=tp,
+                                params_dtype=cfg.param_dtype)
+
+    def _cp_bound():
+        return cfg.context_parallel and axis_is_bound(CONTEXT_AXIS)
+
+    def _tables(s: int):
+        if _cp_bound():
+            cp = lax.axis_size(CONTEXT_AXIS)
+            offset = lax.axis_index(CONTEXT_AXIS) * s
+        else:
+            cp, offset = 1, 0
+        if cp * s > cfg.max_position_embeddings:
+            raise ValueError(
+                f"global sequence cp*s = {cp}*{s} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
+        return _rope_cos_sin(cfg, s, offset)
+
+    def first_fn(local, ids):
+        x = emb.apply({"params": local["shared"]["embed_tokens"]}, ids)
+        # amp O1 seam: same cast as the dense LlamaModel
+        return x.astype(resolve_compute_dtype(cfg.dtype))
+
+    def stage_fn(local, x):
+        cos_, sin_ = _tables(x.shape[-2])
+
+        def body(h, bp):
+            return block.apply({"params": bp}, h, cos_, sin_), None
+
+        h, _ = lax.scan(body, x, local["blocks"])
+        return h
+
+    def loss_fn(local, y, labels):
+        sh = local["shared"]
+        h = norm.apply({"params": sh["final_norm"]}, y).astype(
+            resolve_compute_dtype(cfg.dtype))
+        if cfg.tie_word_embeddings:
+            logits = emb.apply({"params": sh["embed_tokens"]}, h,
+                               method=VocabParallelEmbedding.attend)
+        else:
+            logits = head.apply({"params": sh["lm_head"]}, h)
+        return lm_token_loss(logits, labels, axis_name=MODEL_AXIS,
+                             context_parallel=cfg.context_parallel)
+
+    return first_fn, stage_fn, loss_fn

@@ -1,0 +1,95 @@
+"""Llama pipeline: PP x TP composition vs the single-device Llama model.
+
+Mirrors tests/test_gpt_pipeline.py for the second model family — loss AND
+reassembled grads must match the dense model, with the shared
+embed/norm/head grads summed over stages.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.mesh import MODEL_AXIS, STAGE_AXIS
+from apex_tpu.models.llama import LlamaModel, llama_loss, llama_tiny_config
+from apex_tpu.models.llama_pipeline import (
+    make_llama_pipeline_fns,
+    merge_pipeline_grads_to_llama,
+    split_llama_params_for_pipeline,
+)
+from tests.test_llama_model import _shard_tree
+
+pytestmark = pytest.mark.slow
+
+
+def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng):
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    mesh = mesh_tp2_pp2_dp2
+    pp, tp = 2, 2
+    n_layers = 4
+    m, b, s = 4, 2, 16
+
+    cfg1 = llama_tiny_config(tensor_parallel_size=1, num_layers=n_layers)
+    cfg2 = llama_tiny_config(tensor_parallel_size=tp, num_layers=n_layers)
+
+    mbs = jnp.asarray(rng.integers(0, cfg1.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg1.vocab_size, (m, b, s)),
+                         jnp.int32)
+
+    m1 = LlamaModel(cfg1)
+    v1 = m1.init(jax.random.PRNGKey(0), mbs[0])["params"]
+
+    def ref_loss(p):
+        per = jax.vmap(lambda ii, ll: llama_loss(
+            m1, {"params": p}, ii, ll, axis_name="unbound"))(mbs, labels)
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(v1)
+
+    m2 = LlamaModel(cfg2)
+    v2_shape = jax.eval_shape(
+        lambda: m2.init(jax.random.PRNGKey(0), mbs[0]))["params"]
+    per_rank = []
+    for r in range(tp):
+        tp_tree = _shard_tree(v1, v2_shape, r, tp)
+        per_rank.append(split_llama_params_for_pipeline(cfg2, tp_tree, pp))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *per_rank)
+
+    first_fn, stage_fn, loss_fn = make_llama_pipeline_fns(cfg2)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(STAGE_AXIS, MODEL_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS, MODEL_AXIS)),
+        check_vma=False)
+    def run(p_stacked, mb, lb):
+        local = jax.tree.map(lambda t: t[0, 0], p_stacked)
+        sched_tree = {
+            "blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
+            "shared": local["shared"]}  # drop the V=1 chunk axis
+        loss, grads = fwd_bwd(stage_fn, loss_fn, sched_tree, mb,
+                              loss_aux=lb, first_fn=first_fn,
+                              loss_with_params=True)
+        grads = {"blocks": jax.tree.map(lambda t: t[None], grads["blocks"]),
+                 "shared": grads["shared"]}
+        return loss.reshape(1), jax.tree.map(lambda t: t[None, None], grads)
+
+    losses, grads = jax.jit(run)(stacked, mbs, labels)
+    np.testing.assert_allclose(np.asarray(losses), float(ref_l),
+                               rtol=2e-5, atol=2e-5)
+
+    for r in range(tp):
+        g_rank = jax.tree.map(lambda t, r=r: t[:, r], grads)
+        back = merge_pipeline_grads_to_llama(cfg2, g_rank, pp)
+        ref_rank = _shard_tree(ref_g, v2_shape, r, tp)
+
+        def check(g_pp, g_ref):
+            np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                                       rtol=5e-3, atol=1e-4)
+
+        jax.tree.map(check, back, ref_rank)
