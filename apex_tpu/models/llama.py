@@ -17,7 +17,7 @@ over ``context``.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -59,6 +59,10 @@ class LlamaConfig:
     tensor_parallel_size: int = 1
     context_parallel: bool = False       # same opt-in as GPTConfig
     tie_word_embeddings: bool = False
+    # Mistral-style sliding-window attention: block-skipped in the flash
+    # kernel (O(S*window) compute). Not composable with context_parallel
+    # (the ring would need window-aware chunk skipping — fails loud).
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -130,9 +134,14 @@ class LlamaDecoderBlock(nn.Module):
         divide(h_local, kv_local)
 
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+            if cfg.sliding_window is not None:
+                raise NotImplementedError(
+                    "sliding_window + context_parallel needs a window-aware "
+                    "ring (chunk-skip) — not implemented; drop one of them")
             ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS, causal=True)
         else:
-            ctx = flash_attention(q, k, v, causal=True)
+            ctx = flash_attention(q, k, v, causal=True,
+                                  window=cfg.sliding_window)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, bias=False, input_is_parallel=True, world_size=tp,

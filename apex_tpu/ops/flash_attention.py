@@ -88,7 +88,7 @@ def _pad_to(x, axis, mult):
 
 
 def _mask_block(s, *, b_q, b_k, bq, bk, q_len, kv_len, causal, causal_offset,
-                q_seg, kv_seg):
+                q_seg, kv_seg, window=None):
     """Padding / causal / segment masking for one (bq, bk) score tile.
 
     Returns (s_filled, live): masked entries get the finite
@@ -103,6 +103,10 @@ def _mask_block(s, *, b_q, b_k, bq, bk, q_len, kv_len, causal, causal_offset,
     mask = cols < kv_len
     if causal:
         mask &= (rows + causal_offset) >= cols
+    if window is not None:
+        # sliding window (Mistral-style): query r sees keys in
+        # [r + offset - (window-1), r + offset]
+        mask &= cols >= (rows + causal_offset - (window - 1))
     if q_seg is not None:
         mask &= q_seg.reshape(-1, 1) == kv_seg.reshape(1, -1)
     del q_len  # padded q rows produce garbage that the caller slices away
@@ -141,7 +145,7 @@ def _dropout_keep(shape, rate, seed, bh, row0, col0):
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
                 o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale, causal, causal_offset, q_len, kv_len, bq, bk, nk,
-                dropout_rate):
+                dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
 
     @pl.when(j == 0)
@@ -150,10 +154,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: skip blocks strictly above the diagonal band
+    # causal: skip blocks strictly above the diagonal band; window: also
+    # skip blocks strictly below the band (the O(S*W) saving)
     block_live = True
     if causal:
         block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+    if window is not None:
+        block_live &= (j * bk + bk - 1
+                       >= i * bq + causal_offset - (window - 1))
 
     @pl.when(block_live)
     def _body():
@@ -169,6 +177,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
             causal=causal, causal_offset=causal_offset,
             q_seg=qseg_ref[0] if qseg_ref is not None else None,
             kv_seg=kseg_ref[0] if kseg_ref is not None else None,
+            window=window,
         )
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -205,7 +214,7 @@ def _gqa_rep(heads: int, kv_heads: int) -> int:
 
 
 def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
-            block_q, block_k):
+            block_q, block_k, window=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     rep = _gqa_rep(heads, k.shape[1])
@@ -271,7 +280,7 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
                     o_ref, lse_ref, acc_ref, m_ref, l_ref,
                     scale=scale, causal=causal, causal_offset=causal_offset,
                     q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk,
-                    dropout_rate=dropout_rate)
+                    dropout_rate=dropout_rate, window=window)
 
     o, lse = _dispatch.pallas_call(
         fn,
@@ -305,7 +314,8 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
 # =============================================================================
 
 def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
-                 scale, causal, causal_offset, kv_len, bq, bk, b_q, b_k):
+                 scale, causal, causal_offset, kv_len, bq, bk, b_q, b_k,
+                 window=None):
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     s = jax.lax.dot_general(
@@ -318,6 +328,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
         causal=causal, causal_offset=causal_offset,
         q_seg=qseg_ref[0] if qseg_ref is not None else None,
         kv_seg=kseg_ref[0] if kseg_ref is not None else None,
+        window=window,
     )
     return jnp.where(live, jnp.exp(s - lse_ref[0, 0].reshape(-1, 1)), 0.0)
 
@@ -325,7 +336,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                bias_ref, qseg_ref, kseg_ref, seed_ref, dq_ref, dq_acc, *,
                scale, causal, causal_offset, kv_len, bq, bk, nk,
-               dropout_rate):
+               dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
 
     @pl.when(j == 0)
@@ -335,13 +346,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_live = True
     if causal:
         block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+    if window is not None:
+        block_live &= (j * bk + bk - 1
+                       >= i * bq + causal_offset - (window - 1))
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
                          causal_offset=causal_offset, kv_len=kv_len,
-                         bq=bq, bk=bk, b_q=i, b_k=j)
+                         bq=bq, bk=bk, b_q=i, b_k=j, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         dp = jax.lax.dot_general(
@@ -365,7 +379,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  bias_ref, qseg_ref, kseg_ref, seed_ref, dk_ref, dv_ref,
                  dk_acc, dv_acc, *,
                  scale, causal, causal_offset, kv_len, bq, bk, nq,
-                 dropout_rate):
+                 dropout_rate, window=None):
     # NOTE grid order: (b, h, j over k-blocks, i over q-blocks)
     b, h, j, i = (pl.program_id(d) for d in range(4))
 
@@ -377,13 +391,16 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     block_live = True
     if causal:
         block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
+    if window is not None:
+        block_live &= (j * bk + bk - 1
+                       >= i * bq + causal_offset - (window - 1))
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
                          causal_offset=causal_offset, kv_len=kv_len,
-                         bq=bq, bk=bk, b_q=i, b_k=j)
+                         bq=bq, bk=bk, b_q=i, b_k=j, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         if dropout_rate > 0.0:
@@ -415,7 +432,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                  dropout_rate, block_q, block_k, o, lse, do,
-                 delta_adjust=None):
+                 delta_adjust=None, window=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
@@ -510,7 +527,7 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                    outs[0], scratch[0],
                    scale=scale, causal=causal, causal_offset=causal_offset,
                    kv_len=kv_len, bq=bq, bk=bk, nk=nk,
-                   dropout_rate=dropout_rate)
+                   dropout_rate=dropout_rate, window=window)
 
     dq = _dispatch.pallas_call(
         dq_fn,
@@ -535,7 +552,7 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                      outs[0], outs[1], scratch[0], scratch[1],
                      scale=scale, causal=causal, causal_offset=causal_offset,
                      kv_len=kv_len, bq=bq, bk=bk, nq=nq,
-                     dropout_rate=dropout_rate)
+                     dropout_rate=dropout_rate, window=window)
 
     dk, dv = _dispatch.pallas_call(
         dkdv_fn,
@@ -576,26 +593,27 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
 # custom-vjp entry
 # =============================================================================
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
-           block_q, block_k):
+           block_q, block_k, window):
     o, _ = _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
-                   dropout_rate, block_q, block_k)
+                   dropout_rate, block_q, block_k, window)
     return o
 
 
 def _flash_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
-               dropout_rate, block_q, block_k):
+               dropout_rate, block_q, block_k, window):
     o, lse = _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
-                     dropout_rate, block_q, block_k)
+                     dropout_rate, block_q, block_k, window)
     return o, (q, k, v, bias, q_seg, kv_seg, seed, o, lse)
 
 
-def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, res, do):
+def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, window,
+               res, do):
     q, k, v, bias, q_seg, kv_seg, seed, o, lse = res
     dq, dk, dv = _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale,
                               causal, dropout_rate, block_q, block_k,
-                              o, lse, do)
+                              o, lse, do, window=window)
     dbias = None if bias is None else jnp.zeros_like(bias)
     dseg = None if q_seg is None else jnp.zeros_like(q_seg)
     dkseg = None if kv_seg is None else jnp.zeros_like(kv_seg)
@@ -659,6 +677,7 @@ def flash_attention(
     dropout_seed: int = 0,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ):
     """Flash attention: softmax(scale * q @ k^T + bias [masked]) @ v.
 
@@ -682,6 +701,11 @@ def flash_attention(
       dropout_rate/dropout_seed: attention-prob dropout (multihead_attn's
         fused softmax-dropout); the keep mask is regenerated in backward from
         the seed, never materialized.
+      window: sliding-window width (Mistral-style, requires causal=True):
+        query r attends keys [r-window+1, r]. Blocks wholly outside the
+        band are SKIPPED in forward and both backward kernels, so compute
+        scales O(S*window) instead of O(S^2/2) — beyond the reference's
+        kernels (its fmha has no windowing at all).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -689,20 +713,30 @@ def flash_attention(
         kv_segment_ids = segment_ids
     if not 0.0 <= dropout_rate < 1.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (Mistral-style "
+                             "sliding window over a causal sequence)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     # seed is a *traced* (1,1) SMEM scalar so jitted training steps can vary
     # it per step without recompiling (unlike a static-arg seed)
     seed = (jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
             if dropout_rate > 0.0 else None)
     return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
                   float(scale), bool(causal), float(dropout_rate),
-                  block_q, block_k)
+                  block_q, block_k,
+                  None if window is None else int(window))
 
 
 def mha_reference(q, k, v, bias=None, segment_ids=None, kv_segment_ids=None,
                   *, causal=False, scale=None, dropout_rate=0.0,
-                  dropout_seed=0):
+                  dropout_seed=0, window=None):
     """Pure-jnp unfused reference (the 'impl=default' ground-truth path that
     the reference's tests compare the fast kernels against)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (same contract as "
+                         "flash_attention)")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if segment_ids is not None and kv_segment_ids is None:
@@ -720,6 +754,8 @@ def mha_reference(q, k, v, bias=None, segment_ids=None, kv_segment_ids=None,
     if causal:
         rows = jnp.arange(q_len)[:, None] + (kv_len - q_len)
         mask &= rows >= jnp.arange(kv_len)[None, :]
+        if window is not None:
+            mask &= jnp.arange(kv_len)[None, :] >= rows - (window - 1)
     mask = mask[None, None]
     if segment_ids is not None:
         mask = mask & (segment_ids[:, None, :, None]

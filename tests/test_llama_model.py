@@ -183,3 +183,24 @@ def test_llama_rejects_overlong_sequence(rng):
     ids = jnp.zeros((1, 32), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
         model.init(jax.random.PRNGKey(0), ids)
+
+
+def test_llama_sliding_window_trains_and_differs(rng):
+    """sliding_window wires through to the kernel: output differs from the
+    full-causal model (long-range key cut off) and still trains."""
+    import dataclasses
+
+    cfg_full = llama_tiny_config()
+    cfg_win = dataclasses.replace(cfg_full, sliding_window=8)
+    ids = jnp.asarray(rng.integers(0, cfg_full.vocab_size, (2, 64)),
+                      jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    m_full, m_win = LlamaModel(cfg_full), LlamaModel(cfg_win)
+    v = m_full.init(jax.random.PRNGKey(0), ids)
+    l_full = float(llama_loss(m_full, v, ids, labels))
+    l_win = float(llama_loss(m_win, v, ids, labels))
+    assert abs(l_full - l_win) > 1e-6  # the window actually bites
+    g = jax.grad(lambda p: llama_loss(m_win, {"params": p}, ids, labels))(
+        v["params"])
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
