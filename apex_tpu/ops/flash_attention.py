@@ -142,11 +142,54 @@ def _dropout_keep(shape, rate, seed, bh, row0, col0):
 # forward
 # =============================================================================
 
+def _band_width_blocks(span: int, other_block: int, n_total: int) -> int:
+    """Blocks needed to cover a sliding band of ``span`` positions when the
+    band start is not block-aligned: ceil(span/blk) + 1, capped at n_total."""
+    return min(n_total, (span + other_block - 1) // other_block + 1)
+
+
+def _global_block_ids(i_grid, j_grid, *, bq, bk, nq, nk, causal_offset,
+                      window, band_over):
+    """Map grid ids to GLOBAL (q-block, k-block) ids.
+
+    With ``window`` set, the dead-block grid dimension is shrunk to the
+    band (``band_over`` = "k" for fwd/dq, "q" for dkdv) and the band-local
+    id offsets by the band start — so skipped blocks cost neither FLOPs
+    nor DMA (grid cells outside the band simply don't exist). Callers
+    clamp the returned ids in their BlockSpec index maps; the kernels use
+    the UNclamped ids to compute liveness."""
+    if window is None or band_over is None:
+        return i_grid, j_grid
+    if band_over == "k":
+        lo = jnp.maximum(
+            0, (i_grid * bq + causal_offset - (window - 1)) // bk)
+        return i_grid, lo + j_grid
+    lo = jnp.maximum(0, (j_grid * bk - causal_offset) // bq)
+    return lo + i_grid, j_grid
+
+
+def _block_live(i_g, j_g, *, bq, bk, nq, nk, causal, causal_offset, window):
+    """Liveness of global block (i_g, j_g): inside array bounds, on/below
+    the causal diagonal, and inside the sliding-window band."""
+    live = True
+    if causal:
+        live = (i_g * bq + bq - 1 + causal_offset) >= j_g * bk
+    if window is not None:
+        live &= (j_g * bk + bk - 1
+                 >= i_g * bq + causal_offset - (window - 1))
+        live &= (i_g < nq) & (j_g < nk)   # band ids can run past the edge
+    return live
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
                 o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale, causal, causal_offset, q_len, kv_len, bq, bk, nk,
-                dropout_rate, window=None):
+                nq, dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
+    # under a window the j grid spans only the band; recover global ids
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                                 causal_offset=causal_offset, window=window,
+                                 band_over="k")
 
     @pl.when(j == 0)
     def _init():
@@ -154,14 +197,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # causal: skip blocks strictly above the diagonal band; window: also
-    # skip blocks strictly below the band (the O(S*W) saving)
-    block_live = True
-    if causal:
-        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
-    if window is not None:
-        block_live &= (j * bk + bk - 1
-                       >= i * bq + causal_offset - (window - 1))
+    block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
+                             causal=causal, causal_offset=causal_offset,
+                             window=window)
 
     @pl.when(block_live)
     def _body():
@@ -173,7 +211,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         if bias_ref is not None:
             s += bias_ref[0, 0].astype(jnp.float32)
         s, live = _mask_block(
-            s, b_q=i, b_k=j, bq=bq, bk=bk, q_len=q_len, kv_len=kv_len,
+            s, b_q=i_g, b_k=j_g, bq=bq, bk=bk, q_len=q_len, kv_len=kv_len,
             causal=causal, causal_offset=causal_offset,
             q_seg=qseg_ref[0] if qseg_ref is not None else None,
             kv_seg=kseg_ref[0] if kseg_ref is not None else None,
@@ -188,14 +226,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             p = p * _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
-                                  bh, i * bq, j * bk)
+                                  bh, i_g * bq, j_g * bk)
         v = v_ref[0, 0]
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(j == nk - 1)
+    @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
         l = l_ref[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → output 0
@@ -228,14 +266,30 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     nq, nk = sq_p // bq, sk_p // bk
     causal_offset = kv_len - q_len
 
+    if window is None:
+        nk_grid = nk
+
+        def jmap(i, j):
+            return j
+    else:
+        # band-restricted k grid: dead blocks don't exist, so windowed
+        # attention is O(S*window) in DMA as well as FLOPs
+        nk_grid = _band_width_blocks(bq + window - 1, bk, nk)
+
+        def jmap(i, j):
+            _, j_g = _global_block_ids(
+                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                causal_offset=causal_offset, window=window, band_over="k")
+            return jnp.minimum(j_g, nk - 1)
+
     in_specs = [
         pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, bk, d_pad),
-                     lambda b, h, i, j: (b, h // rep, j, 0),
+                     lambda b, h, i, j: (b, h // rep, jmap(i, j), 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((1, 1, bk, d_pad),
-                     lambda b, h, i, j: (b, h // rep, j, 0),
+                     lambda b, h, i, j: (b, h // rep, jmap(i, j), 0),
                      memory_space=pltpu.VMEM),
     ]
     args = [qp, kp, vp]
@@ -246,7 +300,7 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
         bb, bh = bias.shape[0], bias.shape[1]
         in_specs.append(pl.BlockSpec(
             (1, 1, bq, bk),
-            lambda b, h, i, j, bb=bb, bh=bh: (b % bb, h % bh, i, j),
+            lambda b, h, i, j, bb=bb, bh=bh: (b % bb, h % bh, i, jmap(i, j)),
             memory_space=pltpu.VMEM))
         args.append(bias)
     if q_seg is not None:
@@ -259,8 +313,9 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
         # satisfies Mosaic's (8, 128)-or-full-dim rule
         in_specs.append(pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, 0, i),
                                      memory_space=pltpu.VMEM))
-        in_specs.append(pl.BlockSpec((1, 1, bk), lambda b, h, i, j: (b, 0, j),
-                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda b, h, i, j: (b, 0, jmap(i, j)),
+            memory_space=pltpu.VMEM))
         args.extend([qsp[:, None], ksp[:, None]])
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
@@ -279,12 +334,12 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
         _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
                     o_ref, lse_ref, acc_ref, m_ref, l_ref,
                     scale=scale, causal=causal, causal_offset=causal_offset,
-                    q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk,
+                    q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk, nq=nq,
                     dropout_rate=dropout_rate, window=window)
 
     o, lse = _dispatch.pallas_call(
         fn,
-        grid=(batch, heads, nq, nk),
+        grid=(batch, heads, nq, nk_grid),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
@@ -335,27 +390,27 @@ def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                bias_ref, qseg_ref, kseg_ref, seed_ref, dq_ref, dq_acc, *,
-               scale, causal, causal_offset, kv_len, bq, bk, nk,
+               scale, causal, causal_offset, kv_len, bq, bk, nk, nq,
                dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                                 causal_offset=causal_offset, window=window,
+                                 band_over="k")
 
     @pl.when(j == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    block_live = True
-    if causal:
-        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
-    if window is not None:
-        block_live &= (j * bk + bk - 1
-                       >= i * bq + causal_offset - (window - 1))
+    block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
+                             causal=causal, causal_offset=causal_offset,
+                             window=window)
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
                          causal_offset=causal_offset, kv_len=kv_len,
-                         bq=bq, bk=bk, b_q=i, b_k=j, window=window)
+                         bq=bq, bk=bk, b_q=i_g, b_k=j_g, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         dp = jax.lax.dot_general(
@@ -363,14 +418,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             dp = dp * _dropout_keep(dp.shape, dropout_rate, seed_ref[0, 0],
-                                    bh, i * bq, j * bk)
+                                    bh, i_g * bq, j_g * bk)
         ds = p * (dp - delta_ref[0, 0].reshape(-1, 1)) * scale
         k = k_ref[0, 0]
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == nk - 1)
+    @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
@@ -378,35 +433,35 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  bias_ref, qseg_ref, kseg_ref, seed_ref, dk_ref, dv_ref,
                  dk_acc, dv_acc, *,
-                 scale, causal, causal_offset, kv_len, bq, bk, nq,
+                 scale, causal, causal_offset, kv_len, bq, bk, nq, nk,
                  dropout_rate, window=None):
     # NOTE grid order: (b, h, j over k-blocks, i over q-blocks)
     b, h, j, i = (pl.program_id(d) for d in range(4))
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                                 causal_offset=causal_offset, window=window,
+                                 band_over="q")
 
     @pl.when(i == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    block_live = True
-    if causal:
-        block_live = (i * bq + bq - 1 + causal_offset) >= j * bk
-    if window is not None:
-        block_live &= (j * bk + bk - 1
-                       >= i * bq + causal_offset - (window - 1))
+    block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
+                             causal=causal, causal_offset=causal_offset,
+                             window=window)
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
                          causal_offset=causal_offset, kv_len=kv_len,
-                         bq=bq, bk=bk, b_q=i, b_k=j, window=window)
+                         bq=bq, bk=bk, b_q=i_g, b_k=j_g, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             keep = _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
-                                 bh, i * bq, j * bk)
+                                 bh, i_g * bq, j_g * bk)
             p_dropped = p * keep
         else:
             keep = None
@@ -424,7 +479,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == nq - 1)
+    @pl.when(i == pl.num_programs(3) - 1)
     def _finish():
         dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
@@ -457,6 +512,30 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
     deltap = _pad_to(delta, 2, bq)[..., None]
     nq, nk = sq_p // bq, sk_p // bk
     causal_offset = kv_len - q_len
+
+    if window is None:
+        nkg_dq, nig_dkdv = nk, nq
+
+        def jmap_dq(i, j):
+            return j
+
+        def imap_dkdv(j, i):
+            return i
+    else:
+        nkg_dq = _band_width_blocks(bq + window - 1, bk, nk)
+        nig_dkdv = _band_width_blocks(bk + window - 1, bq, nq)
+
+        def jmap_dq(i, j):
+            _, j_g = _global_block_ids(
+                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                causal_offset=causal_offset, window=window, band_over="k")
+            return jnp.minimum(j_g, nk - 1)
+
+        def imap_dkdv(j, i):
+            i_g, _ = _global_block_ids(
+                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+                causal_offset=causal_offset, window=window, band_over="q")
+            return jnp.minimum(i_g, nq - 1)
 
     base_args = [qp, kp, vp, dop, lsep, deltap]
     if bias is not None:
@@ -526,13 +605,13 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         _dq_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
                    outs[0], scratch[0],
                    scale=scale, causal=causal, causal_offset=causal_offset,
-                   kv_len=kv_len, bq=bq, bk=bk, nk=nk,
+                   kv_len=kv_len, bq=bq, bk=bk, nk=nk, nq=nq,
                    dropout_rate=dropout_rate, window=window)
 
     dq = _dispatch.pallas_call(
         dq_fn,
-        grid=(batch, heads, nq, nk),
-        in_specs=make_specs(lambda g: g[2], lambda g: g[3]),
+        grid=(batch, heads, nq, nkg_dq),
+        in_specs=make_specs(lambda g: g[2], lambda g: jmap_dq(g[2], g[3])),
         out_specs=[pl.BlockSpec((1, 1, bq, d_pad),
                                 lambda b, h, i, j: (b, h, i, 0),
                                 memory_space=pltpu.VMEM)],
@@ -551,13 +630,14 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         _dkdv_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
                      outs[0], outs[1], scratch[0], scratch[1],
                      scale=scale, causal=causal, causal_offset=causal_offset,
-                     kv_len=kv_len, bq=bq, bk=bk, nq=nq,
+                     kv_len=kv_len, bq=bq, bk=bk, nq=nq, nk=nk,
                      dropout_rate=dropout_rate, window=window)
 
     dk, dv = _dispatch.pallas_call(
         dkdv_fn,
-        grid=(batch, heads, nk, nq),
-        in_specs=make_specs(lambda g: g[3], lambda g: g[2]),
+        grid=(batch, heads, nk, nig_dkdv),
+        in_specs=make_specs(lambda g: imap_dkdv(g[2], g[3]),
+                            lambda g: g[2]),
         out_specs=[
             pl.BlockSpec((1, 1, bk, d_pad), lambda b, h, j, i: (b, h, j, 0),
                          memory_space=pltpu.VMEM),
@@ -702,10 +782,12 @@ def flash_attention(
         fused softmax-dropout); the keep mask is regenerated in backward from
         the seed, never materialized.
       window: sliding-window width (Mistral-style, requires causal=True):
-        query r attends keys [r-window+1, r]. Blocks wholly outside the
-        band are SKIPPED in forward and both backward kernels, so compute
-        scales O(S*window) instead of O(S^2/2) — beyond the reference's
-        kernels (its fmha has no windowing at all).
+        query r attends keys [r-window+1, r]. The kernels' k/q grid
+        dimension is RESTRICTED to the live band (``_global_block_ids``),
+        so out-of-band blocks don't exist at all — neither their FLOPs nor
+        their HBM->VMEM copies happen, and end-to-end cost scales
+        O(S*window) instead of O(S^2/2). Beyond the reference's kernels
+        (its fmha has no windowing at all).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)

@@ -94,6 +94,25 @@ def compute_dispatch_combine(probs: jnp.ndarray, k: int, capacity: int,
     return combine, dispatch, jnp.minimum(expert_mask, 1.0)
 
 
+def slice_expert_shards(params, e_local: int, axis_name: str = DATA_AXIS):
+    """Per-rank view of a FULL-expert-stack param tree: inside shard_map,
+    dynamic-slice every MoE expert leaf (``moe_mlp``'s w1/b1/w2/b2) down to
+    this rank's ``e_local`` experts; all other leaves pass through. The
+    slice's transpose scatters grads back to the right expert rows, so a
+    host-side full tree + ``pmean`` over ``axis_name`` is an exact
+    data+expert-parallel step (see examples/moe/train_moe_ep.py)."""
+
+    def f(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        if "moe_mlp" in names and names[-1] in ("w1", "b1", "w2", "b2"):
+            r = lax.axis_index(axis_name)
+            return lax.dynamic_slice_in_dim(leaf, r * e_local, e_local,
+                                            axis=0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(f, params)
+
+
 class MoEMLP(nn.Module):
     """Top-k routed mixture-of-experts FFN (GELU two-layer experts).
 

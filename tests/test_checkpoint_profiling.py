@@ -91,3 +91,40 @@ def test_annotate_and_time_fn():
     dt, out = time_fn(f, x, iters=3, warmup=1)
     assert dt > 0
     np.testing.assert_allclose(np.asarray(out), 64.0 * np.ones((64, 64)))
+
+
+def test_llama_moe_resume_roundtrip(tmp_path, rng):
+    """Round-3 model families resume bit-identically: Llama params +
+    FusedAdam state and a GPT-MoE tree (router + stacked experts) both
+    roundtrip through orbax."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+    from apex_tpu.models.llama import LlamaModel, llama_tiny_config
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.utils import restore_checkpoint, save_checkpoint
+    from apex_tpu.utils.checkpoint import (load_optimizer_state_dict,
+                                           optimizer_state_dict)
+
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+
+    lcfg = llama_tiny_config()
+    lparams = LlamaModel(lcfg).init(jax.random.PRNGKey(0), ids)["params"]
+    opt = FusedAdam(lparams, lr=1e-3)
+    lparams = opt.step(jax.tree.map(jnp.ones_like, lparams))
+
+    mcfg = gpt_tiny_config(num_experts=4, moe_layer_freq=2)
+    mparams = GPTModel(mcfg).init(jax.random.PRNGKey(1), ids)["params"]
+
+    state = {"llama": lparams, "opt": optimizer_state_dict(opt),
+             "moe": mparams}
+    save_checkpoint(str(tmp_path / "families"), state)
+    out = restore_checkpoint(str(tmp_path / "families"), like=state)
+
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.dtype == b.dtype if hasattr(a, "dtype") else True
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    load_optimizer_state_dict(opt, out["opt"])  # restores cleanly
+    assert "moe_mlp" in out["moe"]["layer_1"]

@@ -96,3 +96,9 @@ def test_long_context_ring_attention_trains():
     np.testing.assert_allclose(float(loss), float(ref_l), rtol=2e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5), grads, ref_g)
+
+
+def test_moe_expert_parallel_trains():
+    mod = _load("example_moe_ep", "examples/moe/train_moe_ep.py")
+    losses = mod.run_training(steps=6, verbose=_quiet)
+    assert losses[-1] < losses[0], losses
