@@ -148,7 +148,7 @@ def _band_width_blocks(span: int, other_block: int, n_total: int) -> int:
     return min(n_total, (span + other_block - 1) // other_block + 1)
 
 
-def _global_block_ids(i_grid, j_grid, *, bq, bk, nq, nk, causal_offset,
+def _global_block_ids(i_grid, j_grid, *, bq, bk, causal_offset,
                       window, band_over):
     """Map grid ids to GLOBAL (q-block, k-block) ids.
 
@@ -166,6 +166,24 @@ def _global_block_ids(i_grid, j_grid, *, bq, bk, nq, nk, causal_offset,
         return i_grid, lo + j_grid
     lo = jnp.maximum(0, (j_grid * bk - causal_offset) // bq)
     return lo + i_grid, j_grid
+
+
+def _band_index_map(*, bq, bk, n_limit, causal_offset, window, band_over):
+    """Clamped grid->global block map for BlockSpec index maps: identity
+    when no window, else the band-offset id clamped into [0, n_limit-1]
+    (dead cells may DMA a duplicate edge block; the kernels' UNclamped ids
+    mark them dead so they never contribute)."""
+    if window is None:
+        return lambda i_grid, j_grid: (j_grid if band_over == "k"
+                                       else i_grid)
+
+    def f(i_grid, j_grid):
+        i_g, j_g = _global_block_ids(
+            i_grid, j_grid, bq=bq, bk=bk, causal_offset=causal_offset,
+            window=window, band_over=band_over)
+        return jnp.minimum(j_g if band_over == "k" else i_g, n_limit - 1)
+
+    return f
 
 
 def _block_live(i_g, j_g, *, bq, bk, nq, nk, causal, causal_offset, window):
@@ -187,7 +205,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
                 nq, dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
     # under a window the j grid spans only the band; recover global ids
-    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
                                  causal_offset=causal_offset, window=window,
                                  band_over="k")
 
@@ -266,21 +284,13 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     nq, nk = sq_p // bq, sk_p // bk
     causal_offset = kv_len - q_len
 
-    if window is None:
-        nk_grid = nk
-
-        def jmap(i, j):
-            return j
-    else:
-        # band-restricted k grid: dead blocks don't exist, so windowed
-        # attention is O(S*window) in DMA as well as FLOPs
-        nk_grid = _band_width_blocks(bq + window - 1, bk, nk)
-
-        def jmap(i, j):
-            _, j_g = _global_block_ids(
-                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
-                causal_offset=causal_offset, window=window, band_over="k")
-            return jnp.minimum(j_g, nk - 1)
+    # band-restricted k grid under a window: dead blocks don't exist, so
+    # windowed attention is O(S*window) in DMA as well as FLOPs
+    nk_grid = (nk if window is None
+               else _band_width_blocks(bq + window - 1, bk, nk))
+    jmap = _band_index_map(bq=bq, bk=bk, n_limit=nk,
+                           causal_offset=causal_offset, window=window,
+                           band_over="k")
 
     in_specs = [
         pl.BlockSpec((1, 1, bq, d_pad), lambda b, h, i, j: (b, h, i, 0),
@@ -393,7 +403,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                scale, causal, causal_offset, kv_len, bq, bk, nk, nq,
                dropout_rate, window=None):
     b, h, i, j = (pl.program_id(d) for d in range(4))
-    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
                                  causal_offset=causal_offset, window=window,
                                  band_over="k")
 
@@ -437,7 +447,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                  dropout_rate, window=None):
     # NOTE grid order: (b, h, j over k-blocks, i over q-blocks)
     b, h, j, i = (pl.program_id(d) for d in range(4))
-    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk, nq=nq, nk=nk,
+    i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
                                  causal_offset=causal_offset, window=window,
                                  band_over="q")
 
@@ -515,27 +525,18 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
 
     if window is None:
         nkg_dq, nig_dkdv = nk, nq
-
-        def jmap_dq(i, j):
-            return j
-
-        def imap_dkdv(j, i):
-            return i
     else:
         nkg_dq = _band_width_blocks(bq + window - 1, bk, nk)
         nig_dkdv = _band_width_blocks(bk + window - 1, bq, nq)
+    jmap_dq = _band_index_map(bq=bq, bk=bk, n_limit=nk,
+                              causal_offset=causal_offset, window=window,
+                              band_over="k")
+    _imap = _band_index_map(bq=bq, bk=bk, n_limit=nq,
+                            causal_offset=causal_offset, window=window,
+                            band_over="q")
 
-        def jmap_dq(i, j):
-            _, j_g = _global_block_ids(
-                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
-                causal_offset=causal_offset, window=window, band_over="k")
-            return jnp.minimum(j_g, nk - 1)
-
-        def imap_dkdv(j, i):
-            i_g, _ = _global_block_ids(
-                i, j, bq=bq, bk=bk, nq=nq, nk=nk,
-                causal_offset=causal_offset, window=window, band_over="q")
-            return jnp.minimum(i_g, nq - 1)
+    def imap_dkdv(j, i):
+        return _imap(i, j)
 
     base_args = [qp, kp, vp, dop, lsep, deltap]
     if bias is not None:

@@ -25,12 +25,16 @@ from tests.test_llama_model import _shard_tree
 pytestmark = pytest.mark.slow
 
 
-def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng):
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng,
+                                             schedule):
     from apex_tpu.transformer.pipeline_parallel import (
-        forward_backward_pipelining_without_interleaving as fwd_bwd)
+        forward_backward_pipelining_with_interleaving,
+        forward_backward_pipelining_without_interleaving)
 
     mesh = mesh_tp2_pp2_dp2
     pp, tp = 2, 2
+    vpp = 2 if schedule == "interleaved" else 1
     n_layers = 4
     m, b, s = 4, 2, 16
 
@@ -57,10 +61,35 @@ def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng):
     per_rank = []
     for r in range(tp):
         tp_tree = _shard_tree(v1, v2_shape, r, tp)
-        per_rank.append(split_llama_params_for_pipeline(cfg2, tp_tree, pp))
+        per_rank.append(split_llama_params_for_pipeline(
+            cfg2, tp_tree, pp, virtual_chunks=vpp))
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *per_rank)
 
     first_fn, stage_fn, loss_fn = make_llama_pipeline_fns(cfg2)
+    if schedule == "interleaved":
+        fwd_bwd = forward_backward_pipelining_with_interleaving
+
+        def to_sched_tree(local):
+            # chunk axis must lead EVERY leaf: broadcast shared across V
+            return {"blocks": local["blocks"],
+                    "shared": jax.tree.map(
+                        lambda x: jnp.broadcast_to(x[None],
+                                                   (vpp,) + x.shape),
+                        local["shared"])}
+
+        def from_sched_tree(g):
+            return {"blocks": g["blocks"],
+                    "shared": jax.tree.map(lambda x: x.sum(0), g["shared"])}
+    else:
+        fwd_bwd = forward_backward_pipelining_without_interleaving
+
+        def to_sched_tree(local):
+            return {"blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
+                    "shared": local["shared"]}  # drop the V=1 chunk axis
+
+        def from_sched_tree(g):
+            return {"blocks": jax.tree.map(lambda t: t[None], g["blocks"]),
+                    "shared": g["shared"]}
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -69,14 +98,10 @@ def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng):
         check_vma=False)
     def run(p_stacked, mb, lb):
         local = jax.tree.map(lambda t: t[0, 0], p_stacked)
-        sched_tree = {
-            "blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
-            "shared": local["shared"]}  # drop the V=1 chunk axis
-        loss, grads = fwd_bwd(stage_fn, loss_fn, sched_tree, mb,
+        loss, grads = fwd_bwd(stage_fn, loss_fn, to_sched_tree(local), mb,
                               loss_aux=lb, first_fn=first_fn,
                               loss_with_params=True)
-        grads = {"blocks": jax.tree.map(lambda t: t[None], grads["blocks"]),
-                 "shared": grads["shared"]}
+        grads = from_sched_tree(grads)
         return loss.reshape(1), jax.tree.map(lambda t: t[None, None], grads)
 
     losses, grads = jax.jit(run)(stacked, mbs, labels)
@@ -85,7 +110,8 @@ def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng):
 
     for r in range(tp):
         g_rank = jax.tree.map(lambda t, r=r: t[:, r], grads)
-        back = merge_pipeline_grads_to_llama(cfg2, g_rank, pp)
+        back = merge_pipeline_grads_to_llama(cfg2, g_rank, pp,
+                                             virtual_chunks=vpp)
         ref_rank = _shard_tree(ref_g, v2_shape, r, tp)
 
         def check(g_pp, g_ref):
