@@ -82,3 +82,43 @@ def mesh_tp2_pp2_dp2():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def make_sched_adapters(schedule: str, vpp: int):
+    """(fwd_bwd, to_sched_tree, from_sched_tree) for a pipeline parity
+    test over {"1f1b", "interleaved"} — shared by the GPT and Llama
+    pipeline suites (the stage-local tree has a leading [V] chunk axis on
+    blocks; interleaved wants shared params broadcast across V, 1f1b wants
+    the V=1 axis dropped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_with_interleaving,
+        forward_backward_pipelining_without_interleaving)
+
+    if schedule == "interleaved":
+        def to_sched_tree(local):
+            return {"blocks": local["blocks"],
+                    "shared": jax.tree.map(
+                        lambda x: jnp.broadcast_to(x[None],
+                                                   (vpp,) + x.shape),
+                        local["shared"])}
+
+        def from_sched_tree(g):
+            return {"blocks": g["blocks"],
+                    "shared": jax.tree.map(lambda x: x.sum(0), g["shared"])}
+
+        return (forward_backward_pipelining_with_interleaving,
+                to_sched_tree, from_sched_tree)
+
+    def to_sched_tree(local):
+        return {"blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
+                "shared": local["shared"]}
+
+    def from_sched_tree(g):
+        return {"blocks": jax.tree.map(lambda t: t[None], g["blocks"]),
+                "shared": g["shared"]}
+
+    return (forward_backward_pipelining_without_interleaving,
+            to_sched_tree, from_sched_tree)

@@ -28,10 +28,6 @@ pytestmark = pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
 def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng,
                                              schedule):
-    from apex_tpu.transformer.pipeline_parallel import (
-        forward_backward_pipelining_with_interleaving,
-        forward_backward_pipelining_without_interleaving)
-
     mesh = mesh_tp2_pp2_dp2
     pp, tp = 2, 2
     vpp = 2 if schedule == "interleaved" else 1
@@ -66,30 +62,9 @@ def test_llama_pp2_tp2_matches_single_device(mesh_tp2_pp2_dp2, rng,
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *per_rank)
 
     first_fn, stage_fn, loss_fn = make_llama_pipeline_fns(cfg2)
-    if schedule == "interleaved":
-        fwd_bwd = forward_backward_pipelining_with_interleaving
-
-        def to_sched_tree(local):
-            # chunk axis must lead EVERY leaf: broadcast shared across V
-            return {"blocks": local["blocks"],
-                    "shared": jax.tree.map(
-                        lambda x: jnp.broadcast_to(x[None],
-                                                   (vpp,) + x.shape),
-                        local["shared"])}
-
-        def from_sched_tree(g):
-            return {"blocks": g["blocks"],
-                    "shared": jax.tree.map(lambda x: x.sum(0), g["shared"])}
-    else:
-        fwd_bwd = forward_backward_pipelining_without_interleaving
-
-        def to_sched_tree(local):
-            return {"blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
-                    "shared": local["shared"]}  # drop the V=1 chunk axis
-
-        def from_sched_tree(g):
-            return {"blocks": jax.tree.map(lambda t: t[None], g["blocks"]),
-                    "shared": g["shared"]}
+    from tests.conftest import make_sched_adapters
+    fwd_bwd, to_sched_tree, from_sched_tree = make_sched_adapters(
+        schedule, vpp)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
