@@ -59,9 +59,9 @@ class LlamaConfig:
     tensor_parallel_size: int = 1
     context_parallel: bool = False       # same opt-in as GPTConfig
     tie_word_embeddings: bool = False
-    # Mistral-style sliding-window attention: block-skipped in the flash
-    # kernel (O(S*window) compute). Not composable with context_parallel
-    # (the ring would need window-aware chunk skipping — fails loud).
+    # Mistral-style sliding-window attention: band-restricted in the flash
+    # kernel (O(S*window) compute+DMA); under context_parallel the ring is
+    # statically shortened to the chunks the band reaches (fewer ppermutes).
     sliding_window: Optional[int] = None
 
     @property
@@ -134,11 +134,10 @@ class LlamaDecoderBlock(nn.Module):
         divide(h_local, kv_local)
 
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
-            if cfg.sliding_window is not None:
-                raise NotImplementedError(
-                    "sliding_window + context_parallel needs a window-aware "
-                    "ring (chunk-skip) — not implemented; drop one of them")
-            ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS, causal=True)
+            # window-aware ring: statically shortened to the chunks the
+            # band reaches (ops/ring_attention.py)
+            ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS,
+                                 causal=True, window=cfg.sliding_window)
         else:
             ctx = flash_attention(q, k, v, causal=True,
                                   window=cfg.sliding_window)

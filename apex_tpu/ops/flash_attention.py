@@ -270,7 +270,7 @@ def _gqa_rep(heads: int, kv_heads: int) -> int:
 
 
 def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
-            block_q, block_k, window=None):
+            block_q, block_k, window=None, causal_offset=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     rep = _gqa_rep(heads, k.shape[1])
@@ -282,7 +282,8 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     vp = _pad_to(_pad_to(v, 2, bk), 3, d_pad)
     sq_p, sk_p = qp.shape[2], kp.shape[2]
     nq, nk = sq_p // bq, sk_p // bk
-    causal_offset = kv_len - q_len
+    if causal_offset is None:
+        causal_offset = kv_len - q_len   # cross-attention diagonal default
 
     # band-restricted k grid under a window: dead blocks don't exist, so
     # windowed attention is O(S*window) in DMA as well as FLOPs
@@ -497,7 +498,7 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                  dropout_rate, block_q, block_k, o, lse, do,
-                 delta_adjust=None, window=None):
+                 delta_adjust=None, window=None, causal_offset=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
@@ -521,7 +522,8 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                    constant_values=jnp.inf)[..., None]
     deltap = _pad_to(delta, 2, bq)[..., None]
     nq, nk = sq_p // bq, sk_p // bk
-    causal_offset = kv_len - q_len
+    if causal_offset is None:
+        causal_offset = kv_len - q_len
 
     if window is None:
         nkg_dq, nig_dkdv = nk, nq
@@ -704,28 +706,35 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, window,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_with_lse(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_with_lse(q, k, v, scale, causal, block_q, block_k, window,
+                    causal_offset):
     """(o, lse) variant for blockwise/ring composition: callers that merge
     partial attention results (ring attention over a context-sharded
     sequence) need the per-row logsumexp, and its cotangent folds into the
-    backward's delta correction (see _fa_bwd_impl.delta_adjust)."""
+    backward's delta correction (see _fa_bwd_impl.delta_adjust).
+    ``causal_offset`` overrides the cross-attention diagonal — a ring step
+    attending an upstream chunk passes the global row offset so causal /
+    window masking applies at GLOBAL positions."""
     return _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
-                   block_q, block_k)
+                   block_q, block_k, window, causal_offset)
 
 
-def _flash_with_lse_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_with_lse_fwd(q, k, v, scale, causal, block_q, block_k, window,
+                        causal_offset):
     o, lse = _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
-                     block_q, block_k)
+                     block_q, block_k, window, causal_offset)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_with_lse_bwd(scale, causal, block_q, block_k, res, cts):
+def _flash_with_lse_bwd(scale, causal, block_q, block_k, window,
+                        causal_offset, res, cts):
     q, k, v, o, lse = res
     do, dlse = cts
     dq, dk, dv = _fa_bwd_impl(q, k, v, None, None, None, None, scale,
                               causal, 0.0, block_q, block_k, o, lse, do,
-                              delta_adjust=-dlse.astype(jnp.float32))
+                              delta_adjust=-dlse.astype(jnp.float32),
+                              window=window, causal_offset=causal_offset)
     return dq, dk, dv
 
 
@@ -735,13 +744,22 @@ _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
                              causal: bool = False,
                              block_q: Optional[int] = None,
-                             block_k: Optional[int] = None):
+                             block_k: Optional[int] = None,
+                             window: Optional[int] = None,
+                             causal_offset: Optional[int] = None):
     """Flash attention returning ``(o, lse)`` — the building block for
     ring/blockwise attention (apex_tpu/ops/ring_attention.py). Fully
-    differentiable including through the lse."""
+    differentiable including through the lse. ``window``/``causal_offset``
+    let a ring step apply GLOBAL-position causal+window masking to an
+    upstream chunk (window requires causal)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     d = q.shape[-1]
     scale = (1.0 / (d ** 0.5)) if scale is None else scale
-    return _flash_with_lse(q, k, v, float(scale), causal, block_q, block_k)
+    return _flash_with_lse(
+        q, k, v, float(scale), causal, block_q, block_k,
+        None if window is None else int(window),
+        None if causal_offset is None else int(causal_offset))
 
 
 def flash_attention(

@@ -93,6 +93,7 @@ def ring_attention(
     scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ):
     """Flash attention over a sequence sharded on ``axis_name``.
 
@@ -113,17 +114,41 @@ def ring_attention(
     ``flash_attention`` on the gathered sequence.
     """
     _check_ring_shapes(q, k, v, "ring")
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (same contract as "
+                         "flash_attention)")
     d = q.shape[-1]
+    s_loc = q.shape[2]
     scale = (1.0 / (d ** 0.5)) if scale is None else float(scale)
     cp = lax.psum(1, axis_name)  # static axis size inside shard_map
     idx = lax.axis_index(axis_name)
 
     # step 0: own chunk — for causal layouts this IS the diagonal block
     o0, lse0 = flash_attention_with_lse(
-        q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        q, k, v, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, window=window)
     o, lse = o0.astype(jnp.float32), lse0
     if cp == 1:
         return o0
+
+    if window is not None:
+        # window-aware ring: at step r the received chunk sits r*s_loc rows
+        # upstream — a STATIC offset — and chunks wholly outside the band
+        # need neither compute nor further rotation, so the ring is
+        # statically SHORTENED to ceil((window-1)/s_loc) hops (fewer
+        # ppermutes, the CP analog of the kernel's band-restricted grid).
+        n_hops = min(int(cp) - 1, (window - 2 + s_loc) // s_loc)
+        kc, vc = k, v
+        for r in range(1, n_hops + 1):
+            kc, vc = _rotate(kc, axis_name, cp), _rotate(vc, axis_name, cp)
+            o_r, lse_r = flash_attention_with_lse(
+                q, kc, vc, scale=scale, causal=True,
+                causal_offset=r * s_loc, window=window,
+                block_q=block_q, block_k=block_k)
+            # ring wrap: chunks logically AFTER ours (r > idx) are excluded
+            lse_r = jnp.where(r <= idx, lse_r, -jnp.inf)
+            o, lse = _merge(o, lse, o_r.astype(jnp.float32), lse_r)
+        return o.astype(q.dtype)
 
     kc, vc = _rotate(k, axis_name, cp), _rotate(v, axis_name, cp)
 

@@ -204,3 +204,33 @@ def test_llama_sliding_window_trains_and_differs(rng):
         v["params"])
     assert all(np.isfinite(np.asarray(x)).all()
                for x in jax.tree_util.tree_leaves(g))
+
+
+@pytest.mark.slow
+def test_llama_sliding_window_cp_matches_single_device(rng):
+    """sliding_window composes with context_parallel (window-aware ring)."""
+    import dataclasses
+
+    from apex_tpu.transformer import parallel_state
+
+    cfg = dataclasses.replace(llama_tiny_config(), sliding_window=24)
+    model = LlamaModel(cfg)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 64)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    loss_ref = float(llama_loss(model, v, ids, labels))
+
+    mesh = parallel_state.initialize_model_parallel(
+        1, 1, context_parallel_size_=2)
+    m_cp = LlamaModel(dataclasses.replace(cfg, context_parallel=True))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P(None, "context"), P(None, "context")),
+        out_specs=P(), check_vma=False)
+    def cp_loss(p, ii, ll):
+        return llama_loss(m_cp, {"params": p}, ii, ll)
+
+    with mesh:
+        loss_cp = float(jax.jit(cp_loss)(v["params"], ids, labels))
+    np.testing.assert_allclose(loss_cp, loss_ref, rtol=2e-5, atol=2e-5)
