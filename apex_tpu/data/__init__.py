@@ -1,0 +1,6 @@
+"""Host input pipeline — native C++ prefetch path (reference: the apex
+examples' DALI / torch-DataLoader native loaders)."""
+
+from apex_tpu.data.loader import FastLoader, write_token_shard
+
+__all__ = ["FastLoader", "write_token_shard"]
