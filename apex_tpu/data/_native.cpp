@@ -109,6 +109,18 @@ PyObject* loader_open(PyObject*, PyObject* args) {
     PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
     return nullptr;
   }
+  if (batch <= 0 || seq_len <= 0) {
+    close(fd);
+    PyErr_SetString(PyExc_ValueError, "batch and seq_len must be positive");
+    return nullptr;
+  }
+  if (st.st_size % static_cast<off_t>(sizeof(int32_t)) != 0) {
+    close(fd);
+    PyErr_SetString(PyExc_ValueError,
+                    "shard size is not a multiple of int32 (corrupt/truncated"
+                    " file) — parity with the numpy memmap path");
+    return nullptr;
+  }
   size_t n_tokens = static_cast<size_t>(st.st_size) / sizeof(int32_t);
   if (n_tokens < static_cast<size_t>(seq_len)) {
     close(fd);

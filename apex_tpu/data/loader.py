@@ -22,6 +22,7 @@ import numpy as np
 
 _NATIVE = None
 _NATIVE_TRIED = False
+_BUILD_ERROR: Optional[str] = None
 
 
 def _build_native() -> Optional[object]:
@@ -30,6 +31,7 @@ def _build_native() -> Optional[object]:
     if _NATIVE_TRIED:
         return _NATIVE
     _NATIVE_TRIED = True
+    global _BUILD_ERROR
     src = os.path.join(os.path.dirname(__file__), "_native.cpp")
     out_dir = os.path.join(os.path.dirname(__file__), "_build")
     ext = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
@@ -55,8 +57,13 @@ def _build_native() -> Optional[object]:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         _NATIVE = mod
-    except Exception:
-        _NATIVE = None  # no toolchain / sandboxed: numpy fallback
+    except Exception as e:  # no toolchain / sandboxed: numpy fallback
+        stderr = getattr(e, "stderr", b"")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        _BUILD_ERROR = f"{type(e).__name__}: {e}" + (
+            f"\n{stderr[-1500:]}" if stderr else "")
+        _NATIVE = None
     return _NATIVE
 
 
@@ -97,10 +104,16 @@ class FastLoader:
                  native: Optional[bool] = None):
         self.path, self.batch, self.seq_len = path, int(batch), int(seq_len)
         self.seed = int(seed)
+        if self.batch <= 0 or self.seq_len <= 0:
+            # validated HERE so both paths fail identically (the C++ side
+            # double-checks; an unchecked negative would std::terminate in
+            # the worker thread)
+            raise ValueError("batch and seq_len must be positive")
         mod = _build_native() if native in (None, True) else None
         if native is True and mod is None:
-            raise RuntimeError("native loader requested but the extension "
-                               "failed to build (g++ missing?)")
+            raise RuntimeError(
+                "native loader requested but the extension failed to "
+                f"build:\n{_BUILD_ERROR}")
         self._mod = mod
         if mod is not None:
             self._handle = mod.loader_open(path, self.batch, self.seq_len,

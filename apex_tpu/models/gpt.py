@@ -66,6 +66,7 @@ class GPTConfig:
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coeff: float = 1e-2
+    moe_z_loss_coeff: float = 0.0    # ST-MoE router z-loss
     expert_parallel: bool = False
 
     @property
@@ -148,6 +149,7 @@ class ParallelDecoderBlock(nn.Module):
                 num_experts=cfg.num_experts, k=cfg.moe_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 aux_loss_coeff=cfg.moe_aux_loss_coeff,
+                z_loss_coeff=cfg.moe_z_loss_coeff,
                 params_dtype=cfg.param_dtype,
                 expert_world_size=None if use_ep else 1,
                 axis_name=DATA_AXIS if use_ep else "unbound_ep",
@@ -235,18 +237,11 @@ def gpt_loss(model: GPTModel, variables, input_ids, labels,
     """Mean next-token loss from vocab-parallel logits (+ MoE aux losses)."""
     moe_aux = jnp.zeros((), jnp.float32)
     if model.config.num_experts > 0:
+        from apex_tpu.transformer.moe import collect_sown_aux
+
         logits, inter = model.apply(variables, input_ids,
                                     mutable=["intermediates"])
-
-        def _collect(path, leaf):
-            nonlocal moe_aux
-            # ONLY the sown moe_aux entries: other intermediates (logging
-            # diagnostics) must not leak into the training loss
-            if any(str(getattr(k, "key", k)) == "moe_aux" for k in path):
-                moe_aux = moe_aux + leaf
-            return leaf
-
-        jax.tree_util.tree_map_with_path(_collect, inter)
+        moe_aux = collect_sown_aux(inter)
     else:
         logits = model.apply(variables, input_ids)
     return lm_token_loss(

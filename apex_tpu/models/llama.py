@@ -63,6 +63,17 @@ class LlamaConfig:
     # kernel (O(S*window) compute+DMA); under context_parallel the ring is
     # statically shortened to the chunks the band reaches (fewer ppermutes).
     sliding_window: Optional[int] = None
+    # --- mixture-of-experts (Mixtral family = GQA + window + MoE) ---------
+    # Same contract as GPTConfig: num_experts > 0 routes every
+    # moe_layer_freq-th block's MLP through MoEMLP — with SWIGLU experts
+    # (Mixtral's expert FFN); expert_parallel opts into EP over ``data``.
+    num_experts: int = 0
+    moe_layer_freq: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coeff: float = 1e-2
+    moe_z_loss_coeff: float = 0.0
+    expert_parallel: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -91,9 +102,20 @@ def _rope_cos_sin(cfg: LlamaConfig, s: int, offset):
 
 
 class LlamaDecoderBlock(nn.Module):
-    """Pre-RMSNorm block: attn (RoPE + GQA flash) -> res -> SwiGLU -> res."""
+    """Pre-RMSNorm block: attn (RoPE + GQA flash) -> res -> SwiGLU -> res.
+
+    ``config.num_experts > 0`` + this block selected by ``moe_layer_freq``
+    routes the MLP through MoEMLP with SwiGLU experts (Mixtral); the aux
+    loss is sown into ``intermediates`` (collected by ``llama_loss``)."""
 
     config: LlamaConfig
+    layer_idx: int = 0
+
+    def _is_moe_layer(self) -> bool:
+        cfg = self.config
+        return (cfg.num_experts > 0
+                and self.layer_idx % cfg.moe_layer_freq
+                == cfg.moe_layer_freq - 1)
 
     @nn.compact
     def __call__(self, x, cos_, sin_):
@@ -149,17 +171,37 @@ class LlamaDecoderBlock(nn.Module):
 
         h = FusedRMSNorm(e, eps=cfg.rms_eps, name="post_norm")(x)
         h = h.astype(dt)
-        # gate+up fused into ONE column-parallel GEMM (same pattern as
-        # kv_proj): one weight-load pass over h instead of two; local
-        # layout is [gate_r | up_r]
-        gate_up = ColumnParallelLinear(
-            e, 2 * cfg.intermediate_size, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="gate_up_proj")(h)
-        gate, up = jnp.split(gate_up, 2, axis=-1)
-        mlp_out = RowParallelLinear(
-            cfg.intermediate_size, e, bias=False, input_is_parallel=True,
-            world_size=tp, params_dtype=cfg.param_dtype, name="down_proj")(
-            jax.nn.silu(gate) * up)
+        if self._is_moe_layer():
+            from apex_tpu.mesh import DATA_AXIS
+            from apex_tpu.transformer.moe import MoEMLP
+
+            use_ep = cfg.expert_parallel and _axis_bound(DATA_AXIS)
+            moe = MoEMLP(
+                hidden_size=e, ffn_hidden_size=cfg.intermediate_size,
+                num_experts=cfg.num_experts, k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                aux_loss_coeff=cfg.moe_aux_loss_coeff,
+                z_loss_coeff=cfg.moe_z_loss_coeff,
+                activation="swiglu",              # Mixtral expert FFN
+                params_dtype=cfg.param_dtype,
+                expert_world_size=None if use_ep else 1,
+                axis_name=DATA_AXIS if use_ep else "unbound_ep",
+                name="moe_mlp")
+            mlp_out, aux = moe(h)
+            self.sow("intermediates", "moe_aux", aux.total)
+        else:
+            # gate+up fused into ONE column-parallel GEMM (same pattern as
+            # kv_proj): one weight-load pass over h instead of two; local
+            # layout is [gate_r | up_r]
+            gate_up = ColumnParallelLinear(
+                e, 2 * cfg.intermediate_size, bias=False,
+                gather_output=False, world_size=tp,
+                params_dtype=cfg.param_dtype, name="gate_up_proj")(h)
+            gate, up = jnp.split(gate_up, 2, axis=-1)
+            mlp_out = RowParallelLinear(
+                cfg.intermediate_size, e, bias=False, input_is_parallel=True,
+                world_size=tp, params_dtype=cfg.param_dtype,
+                name="down_proj")(jax.nn.silu(gate) * up)
         return x + mlp_out.astype(x.dtype)
 
 
@@ -196,7 +238,8 @@ class LlamaModel(nn.Module):
         cos_, sin_ = _rope_cos_sin(cfg, s, offset)
 
         for i in range(cfg.num_layers):
-            x = LlamaDecoderBlock(cfg, name=f"layer_{i}")(x, cos_, sin_)
+            x = LlamaDecoderBlock(cfg, layer_idx=i,
+                                  name=f"layer_{i}")(x, cos_, sin_)
         x = FusedRMSNorm(cfg.hidden_size, eps=cfg.rms_eps, name="final_norm")(x)
         x = x.astype(dt)
         if cfg.tie_word_embeddings:
@@ -210,7 +253,17 @@ class LlamaModel(nn.Module):
 
 def llama_loss(model: LlamaModel, variables, input_ids, labels,
                axis_name: str = MODEL_AXIS):
-    """Mean next-token loss from vocab-parallel logits (shared LM tail)."""
-    logits = model.apply(variables, input_ids)
+    """Mean next-token loss from vocab-parallel logits (shared LM tail,
+    + sown MoE aux losses for Mixtral-style configs)."""
+    moe_aux = None
+    if model.config.num_experts > 0:
+        from apex_tpu.transformer.moe import collect_sown_aux
+
+        logits, inter = model.apply(variables, input_ids,
+                                    mutable=["intermediates"])
+        moe_aux = collect_sown_aux(inter)
+    else:
+        logits = model.apply(variables, input_ids)
     return lm_token_loss(logits, labels, axis_name=axis_name,
-                         context_parallel=model.config.context_parallel)
+                         context_parallel=model.config.context_parallel,
+                         extra=moe_aux)

@@ -68,6 +68,13 @@ def merge_pipeline_grads_to_llama(cfg: LlamaConfig, grads, n_stages: int,
 def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
     """(first_fn, stage_fn, loss_fn) for the pipeline schedules
     (use with ``loss_with_params=True``), mirroring make_gpt_pipeline_fns."""
+    if cfg.num_experts > 0:
+        # same constraint as make_gpt_pipeline_fns: the scanned shared-block
+        # formulation can't express per-layer MoE selection and would
+        # silently drop the sown aux losses
+        raise NotImplementedError(
+            "pipeline stages do not support MoE blocks yet "
+            "(num_experts > 0); use the non-pipelined LlamaModel")
     tp = cfg.tensor_parallel_size
     emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
                                  world_size=tp, params_dtype=cfg.param_dtype)

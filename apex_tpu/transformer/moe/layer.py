@@ -94,6 +94,22 @@ def compute_dispatch_combine(probs: jnp.ndarray, k: int, capacity: int,
     return combine, dispatch, jnp.minimum(expert_mask, 1.0)
 
 
+def collect_sown_aux(intermediates) -> jnp.ndarray:
+    """Sum ONLY the ``moe_aux`` entries of a flax ``intermediates``
+    collection (other sown diagnostics must not leak into the loss) —
+    shared by the GPT and Llama loss tails."""
+    total = jnp.zeros((), jnp.float32)
+
+    def _collect(path, leaf):
+        nonlocal total
+        if any(str(getattr(k, "key", k)) == "moe_aux" for k in path):
+            total = total + leaf
+        return leaf
+
+    jax.tree_util.tree_map_with_path(_collect, intermediates)
+    return total
+
+
 def slice_expert_shards(params, e_local: int, axis_name: str = DATA_AXIS):
     """Per-rank view of a FULL-expert-stack param tree: inside shard_map,
     dynamic-slice every MoE expert leaf (``moe_mlp``'s w1/b1/w2/b2) down to
@@ -133,6 +149,7 @@ class MoEMLP(nn.Module):
     normalize_gates: bool = True          # Mixtral-style renormalize top-k
     aux_loss_coeff: float = 1e-2
     z_loss_coeff: float = 0.0
+    activation: str = "gelu"              # "gelu" | "swiglu" (Mixtral experts)
     params_dtype: jnp.dtype = jnp.float32
     expert_world_size: Optional[int] = None   # default: axis size if bound
     axis_name: str = DATA_AXIS
@@ -193,16 +210,27 @@ class MoEMLP(nn.Module):
                 return base(key, shape, dtype)
             return f
 
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(f"unsupported expert activation "
+                             f"{self.activation!r} (gelu | swiglu)")
+        swiglu = self.activation == "swiglu"
+        # swiglu experts fuse gate+up in w1 (same [gate|up] layout as the
+        # Llama block's gate_up_proj); bias-free like Mixtral
+        w1_cols = (2 if swiglu else 1) * self.ffn_hidden_size
         w1 = self.param("w1", shard_init(init),
-                        (e_local, d, self.ffn_hidden_size), self.params_dtype)
+                        (e_local, d, w1_cols), self.params_dtype)
         b1 = self.param("b1", shard_init(nn.initializers.zeros),
-                        (e_local, self.ffn_hidden_size), self.params_dtype)
+                        (e_local, w1_cols), self.params_dtype)
         w2 = self.param("w2", shard_init(init),
                         (e_local, self.ffn_hidden_size, d), self.params_dtype)
         b2 = self.param("b2", shard_init(nn.initializers.zeros),
                         (e_local, d), self.params_dtype)
         h = jnp.einsum("ecd,edf->ecf", xd, w1.astype(dt)) + b1[:, None].astype(dt)
-        h = nn.gelu(h)
+        if swiglu:
+            gate, up = jnp.split(h, 2, axis=-1)
+            h = jax.nn.silu(gate) * up
+        else:
+            h = nn.gelu(h)
         yd = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) + b2[:, None].astype(dt)
 
         if bound:

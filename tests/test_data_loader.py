@@ -99,3 +99,26 @@ def test_last_token_is_reachable(tmp_path):
     ld = FastLoader(path, batch=64, seq_len=16, seed=3, native=False)
     seen_last = any(int(next(ld).max()) == 16 for _ in range(20))
     assert seen_last
+
+
+def test_invalid_batch_raises_not_aborts(shard):
+    from apex_tpu.data import FastLoader
+
+    path, _ = shard
+    for native in (False, None):
+        with pytest.raises(ValueError, match="positive"):
+            FastLoader(path, batch=-1, seq_len=32, native=native)
+
+
+def test_corrupt_shard_rejected_on_both_paths(tmp_path):
+    from apex_tpu.data import FastLoader
+    from apex_tpu.data.loader import _build_native
+
+    path = str(tmp_path / "corrupt.bin")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 4097)  # not a multiple of int32
+    with pytest.raises(ValueError):
+        FastLoader(path, batch=2, seq_len=16, native=False)
+    if _build_native() is not None:
+        with pytest.raises(ValueError):
+            FastLoader(path, batch=2, seq_len=16, native=True)

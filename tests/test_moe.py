@@ -159,3 +159,36 @@ def test_moe_grads_flow_and_balance_loss_differentiable(rng):
     assert float(jnp.sum(jnp.abs(g["router"]["weight"]))) > 0.0
     # every expert weight tensor must receive gradient
     assert float(jnp.sum(jnp.abs(g["w1"]))) > 0.0
+
+
+def test_swiglu_experts_match_manual(rng):
+    """activation='swiglu' experts: dropless MoE output == manual top-k
+    routing through silu(x@gate)*(x@up) @ down per expert."""
+    from apex_tpu.transformer.moe import MoEMLP
+
+    d, ff, e, k, t = 8, 16, 4, 2, 12
+    layer = MoEMLP(hidden_size=d, ffn_hidden_size=ff, num_experts=e, k=k,
+                   capacity_factor=_ample_capacity(e, k),
+                   activation="swiglu", expert_world_size=1,
+                   axis_name="nope")
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+    v = layer.init(jax.random.PRNGKey(0), x)
+    y, _ = layer.apply(v, x)
+
+    p = v["params"]
+    logits = np.asarray(x, np.float32) @ np.asarray(
+        p["router"]["weight"]).T
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+    top_idx = np.argsort(-probs, axis=-1)[:, :k]
+    out = np.zeros((t, d), np.float32)
+    w1, b1 = np.asarray(p["w1"]), np.asarray(p["b1"])
+    w2, b2 = np.asarray(p["w2"]), np.asarray(p["b2"])
+    for ti in range(t):
+        gates = probs[ti, top_idx[ti]]
+        gates = gates / gates.sum()
+        for gi, ei in zip(gates, top_idx[ti]):
+            hh = np.asarray(x[ti]) @ w1[ei] + b1[ei]
+            gate_h, up_h = hh[:ff], hh[ff:]
+            act = np.asarray(jax.nn.silu(jnp.asarray(gate_h))) * up_h
+            out[ti] += gi * (act @ w2[ei] + b2[ei])
+    np.testing.assert_allclose(np.asarray(y), out, rtol=2e-4, atol=2e-4)
