@@ -97,10 +97,9 @@ class ParallelDecoderBlock(nn.Module):
     layer_idx: int = 0
 
     def _is_moe_layer(self) -> bool:
-        cfg = self.config
-        return (cfg.num_experts > 0
-                and self.layer_idx % cfg.moe_layer_freq
-                == cfg.moe_layer_freq - 1)
+        from apex_tpu.transformer.moe import moe_layer_selected
+
+        return moe_layer_selected(self.config, self.layer_idx)
 
     @nn.compact
     def __call__(self, x):
@@ -141,20 +140,9 @@ class ParallelDecoderBlock(nn.Module):
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
         h = h.astype(dt)
         if self._is_moe_layer():
-            from apex_tpu.transformer.moe import MoEMLP
+            from apex_tpu.transformer.moe import make_moe_mlp
 
-            use_ep = cfg.expert_parallel and _axis_bound(DATA_AXIS)
-            moe = MoEMLP(
-                hidden_size=e, ffn_hidden_size=4 * e,
-                num_experts=cfg.num_experts, k=cfg.moe_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                aux_loss_coeff=cfg.moe_aux_loss_coeff,
-                z_loss_coeff=cfg.moe_z_loss_coeff,
-                params_dtype=cfg.param_dtype,
-                expert_world_size=None if use_ep else 1,
-                axis_name=DATA_AXIS if use_ep else "unbound_ep",
-                name="moe_mlp")
-            mlp_out, aux = moe(h)
+            mlp_out, aux = make_moe_mlp(cfg, e, 4 * e, "gelu")(h)
             self.sow("intermediates", "moe_aux", aux.total)
         else:
             h = ColumnParallelLinear(

@@ -112,10 +112,9 @@ class LlamaDecoderBlock(nn.Module):
     layer_idx: int = 0
 
     def _is_moe_layer(self) -> bool:
-        cfg = self.config
-        return (cfg.num_experts > 0
-                and self.layer_idx % cfg.moe_layer_freq
-                == cfg.moe_layer_freq - 1)
+        from apex_tpu.transformer.moe import moe_layer_selected
+
+        return moe_layer_selected(self.config, self.layer_idx)
 
     @nn.compact
     def __call__(self, x, cos_, sin_):
@@ -172,22 +171,11 @@ class LlamaDecoderBlock(nn.Module):
         h = FusedRMSNorm(e, eps=cfg.rms_eps, name="post_norm")(x)
         h = h.astype(dt)
         if self._is_moe_layer():
-            from apex_tpu.mesh import DATA_AXIS
-            from apex_tpu.transformer.moe import MoEMLP
+            from apex_tpu.transformer.moe import make_moe_mlp
 
-            use_ep = cfg.expert_parallel and _axis_bound(DATA_AXIS)
-            moe = MoEMLP(
-                hidden_size=e, ffn_hidden_size=cfg.intermediate_size,
-                num_experts=cfg.num_experts, k=cfg.moe_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                aux_loss_coeff=cfg.moe_aux_loss_coeff,
-                z_loss_coeff=cfg.moe_z_loss_coeff,
-                activation="swiglu",              # Mixtral expert FFN
-                params_dtype=cfg.param_dtype,
-                expert_world_size=None if use_ep else 1,
-                axis_name=DATA_AXIS if use_ep else "unbound_ep",
-                name="moe_mlp")
-            mlp_out, aux = moe(h)
+            # Mixtral expert FFN: swiglu, bias-free
+            mlp_out, aux = make_moe_mlp(
+                cfg, e, cfg.intermediate_size, "swiglu")(h)
             self.sow("intermediates", "moe_aux", aux.total)
         else:
             # gate+up fused into ONE column-parallel GEMM (same pattern as

@@ -7,12 +7,15 @@ surface (SURVEY.md §2.4 footnote). See layer.py for the TPU-first design.
 from apex_tpu.transformer.moe.layer import (MoEAuxLosses, MoEMLP,
                                             collect_sown_aux,
                                             compute_dispatch_combine,
+                                            make_moe_mlp,
+                                            moe_layer_selected,
                                             slice_expert_shards)
 from apex_tpu.transformer.moe.router import (TopKRouter, load_balancing_loss,
                                              router_z_loss)
 
 __all__ = [
     "MoEAuxLosses", "MoEMLP", "collect_sown_aux",
-    "compute_dispatch_combine", "slice_expert_shards",
+    "compute_dispatch_combine", "make_moe_mlp", "moe_layer_selected",
+    "slice_expert_shards",
     "TopKRouter", "load_balancing_loss", "router_z_loss",
 ]

@@ -94,6 +94,36 @@ def compute_dispatch_combine(probs: jnp.ndarray, k: int, capacity: int,
     return combine, dispatch, jnp.minimum(expert_mask, 1.0)
 
 
+def moe_layer_selected(cfg, layer_idx: int) -> bool:
+    """Shared routing predicate for model configs carrying the MoE knobs
+    (GPTConfig / LlamaConfig): block ``layer_idx`` is routed iff
+    ``num_experts > 0`` and the index lands on the ``moe_layer_freq``
+    stride (last block of each stride group, Switch convention)."""
+    return (cfg.num_experts > 0
+            and layer_idx % cfg.moe_layer_freq == cfg.moe_layer_freq - 1)
+
+
+def make_moe_mlp(cfg, hidden_size: int, ffn_hidden_size: int,
+                 activation: str, name: str = "moe_mlp") -> "MoEMLP":
+    """Build the routed MLP for a decoder block from a model config's MoE
+    knobs — ONE place owns the expert-parallel opt-in wiring (use_ep /
+    expert_world_size / axis_name) for every model family."""
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
+
+    use_ep = cfg.expert_parallel and axis_is_bound(DATA_AXIS)
+    return MoEMLP(
+        hidden_size=hidden_size, ffn_hidden_size=ffn_hidden_size,
+        num_experts=cfg.num_experts, k=cfg.moe_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        aux_loss_coeff=cfg.moe_aux_loss_coeff,
+        z_loss_coeff=cfg.moe_z_loss_coeff,
+        activation=activation,
+        params_dtype=cfg.param_dtype,
+        expert_world_size=None if use_ep else 1,
+        axis_name=DATA_AXIS if use_ep else "unbound_ep",
+        name=name)
+
+
 def collect_sown_aux(intermediates) -> jnp.ndarray:
     """Sum ONLY the ``moe_aux`` entries of a flax ``intermediates``
     collection (other sown diagnostics must not leak into the loss) —
@@ -215,23 +245,26 @@ class MoEMLP(nn.Module):
                              f"{self.activation!r} (gelu | swiglu)")
         swiglu = self.activation == "swiglu"
         # swiglu experts fuse gate+up in w1 (same [gate|up] layout as the
-        # Llama block's gate_up_proj); bias-free like Mixtral
+        # Llama block's gate_up_proj) and are BIAS-FREE like Mixtral's
+        # w1/w3/w2 — no extra tensors vs the upstream expert format
         w1_cols = (2 if swiglu else 1) * self.ffn_hidden_size
         w1 = self.param("w1", shard_init(init),
                         (e_local, d, w1_cols), self.params_dtype)
-        b1 = self.param("b1", shard_init(nn.initializers.zeros),
-                        (e_local, w1_cols), self.params_dtype)
         w2 = self.param("w2", shard_init(init),
                         (e_local, self.ffn_hidden_size, d), self.params_dtype)
-        b2 = self.param("b2", shard_init(nn.initializers.zeros),
-                        (e_local, d), self.params_dtype)
-        h = jnp.einsum("ecd,edf->ecf", xd, w1.astype(dt)) + b1[:, None].astype(dt)
+        h = jnp.einsum("ecd,edf->ecf", xd, w1.astype(dt))
         if swiglu:
             gate, up = jnp.split(h, 2, axis=-1)
             h = jax.nn.silu(gate) * up
         else:
-            h = nn.gelu(h)
-        yd = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) + b2[:, None].astype(dt)
+            b1 = self.param("b1", shard_init(nn.initializers.zeros),
+                            (e_local, w1_cols), self.params_dtype)
+            h = nn.gelu(h + b1[:, None].astype(dt))
+        yd = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt))
+        if not swiglu:
+            b2 = self.param("b2", shard_init(nn.initializers.zeros),
+                            (e_local, d), self.params_dtype)
+            yd = yd + b2[:, None].astype(dt)
 
         if bound:
             # inverse: (E_local, ep*C, d) -> (E, C, d) back on token owners

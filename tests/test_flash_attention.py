@@ -209,6 +209,7 @@ def test_gqa_rejects_non_divisible(rng):
         flash_attention(q, k, k)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("window,s", [(16, 128), (64, 200), (1, 64)])
 def test_sliding_window_matches_reference(rng, window, s):
     """Mistral-style causal sliding window: parity vs the masked dense
@@ -254,6 +255,7 @@ def test_sliding_window_requires_causal(rng):
         flash_attention(q, k, v, window=8)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("window", [8, 24, 56, 200])
 def test_sliding_window_banded_grid_small_blocks(rng, window):
     """Small blocks force multi-block bands with edge clamping: the

@@ -25,6 +25,7 @@ def _moe_cfg(**over):
     return gpt_tiny_config(**base)
 
 
+@pytest.mark.slow
 def test_gpt_moe_has_routed_layers_and_grads_flow(rng):
     from apex_tpu.models.gpt import GPTModel, gpt_loss
 

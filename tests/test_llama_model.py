@@ -61,6 +61,7 @@ def test_gqa_matches_repeated_dense_attention(rng):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_llama_train_smoke(rng):
     from apex_tpu.optimizers import FusedAdam
 
@@ -185,6 +186,7 @@ def test_llama_rejects_overlong_sequence(rng):
         model.init(jax.random.PRNGKey(0), ids)
 
 
+@pytest.mark.slow
 def test_llama_sliding_window_trains_and_differs(rng):
     """sliding_window wires through to the kernel: output differs from the
     full-causal model (long-range key cut off) and still trains."""
@@ -236,6 +238,7 @@ def test_llama_sliding_window_cp_matches_single_device(rng):
     np.testing.assert_allclose(loss_cp, loss_ref, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_mixtral_style_moe_llama_trains(rng):
     """Mixtral family = GQA + sliding window + SwiGLU MoE experts: routed
     layers get router+expert grads, aux in the loss, loss decreases."""

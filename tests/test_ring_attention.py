@@ -214,6 +214,7 @@ def test_ring_gqa_kv_heads(rng, causal):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_gqa_grads_match_single_device(rng):
     """GQA K/V gradients through the ring (rep-sum composing with the
     ppermute transpose) == single-device GQA flash grads."""
@@ -237,6 +238,7 @@ def test_ring_gqa_grads_match_single_device(rng):
                                    rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_zigzag_gqa_matches_single_device(rng):
     """Zigzag causal ring with unexpanded GQA K/V (half-chunk lax.cond
     branches + merges) == single-device GQA flash."""
@@ -255,6 +257,7 @@ def test_zigzag_gqa_matches_single_device(rng):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cp,window", [(2, 24), (4, 48), (4, 300), (2, 1)])
 def test_ring_sliding_window_matches_single_device(rng, cp, window):
     """Window-aware ring: parity vs single-device windowed flash across
@@ -278,6 +281,7 @@ def test_ring_sliding_window_matches_single_device(rng, cp, window):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_ring_sliding_window_grads_match(rng):
     """Grads through the statically-shortened windowed ring (unrolled
     rotation + ppermute transpose) == single-device windowed flash."""
