@@ -68,6 +68,13 @@ class GPTConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0    # ST-MoE router z-loss
     expert_parallel: bool = False
+    # activation rematerialization: recompute each decoder block in
+    # backward instead of saving its activations (flax nn.remat, the
+    # lifted jax.checkpoint; in pipeline stages: jax.checkpoint around the
+    # scanned block apply) — the reference's
+    # activations_checkpoint_method="uniform" with one block per chunk;
+    # trades ~1/3 more FLOPs for O(layers) less activation HBM
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -190,8 +197,12 @@ class GPTModel(nn.Module):
         else:
             pos_s = pos[:s]
         x = (x + pos_s[None, :, :]).astype(dt)
+        # nn.remat (lifted jax.checkpoint): same param tree, same sown
+        # intermediates, recompute-in-backward per block
+        block_cls = nn.remat(ParallelDecoderBlock) if cfg.remat \
+            else ParallelDecoderBlock
         for i in range(cfg.num_layers):
-            x = ParallelDecoderBlock(cfg, layer_idx=i, name=f"layer_{i}")(x)
+            x = block_cls(cfg, layer_idx=i, name=f"layer_{i}")(x)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                            name="final_norm")(x)
         # tied LM head: local logits against the LOCAL vocab shard

@@ -161,9 +161,16 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
         # amp O1 seam: same cast as the dense GPTModel
         return x.astype(resolve_compute_dtype(cfg.dtype))
 
+    # cfg.remat: recompute each block in backward (jax.checkpoint on the
+    # PURE block.apply — no flax scoping involved), bounding within-stage
+    # residuals; the 1F1B schedule already rematerializes whole stages
+    # from their saved inputs, so this nests per-block inside that
+    block_apply = (jax.checkpoint(block.apply) if cfg.remat
+                   else block.apply)
+
     def stage_fn(local, x):
         def body(h, bp):
-            return block.apply({"params": bp}, h), None
+            return block_apply({"params": bp}, h), None
 
         h, _ = lax.scan(body, x, local["blocks"])
         return h

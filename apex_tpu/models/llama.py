@@ -74,6 +74,8 @@ class LlamaConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
     expert_parallel: bool = False
+    # activation rematerialization per decoder block (same as GPTConfig)
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -225,9 +227,11 @@ class LlamaModel(nn.Module):
                 f"max_position_embeddings={cfg.max_position_embeddings}")
         cos_, sin_ = _rope_cos_sin(cfg, s, offset)
 
+        block_cls = nn.remat(LlamaDecoderBlock) if cfg.remat \
+            else LlamaDecoderBlock
         for i in range(cfg.num_layers):
-            x = LlamaDecoderBlock(cfg, layer_idx=i,
-                                  name=f"layer_{i}")(x, cos_, sin_)
+            x = block_cls(cfg, layer_idx=i,
+                          name=f"layer_{i}")(x, cos_, sin_)
         x = FusedRMSNorm(cfg.hidden_size, eps=cfg.rms_eps, name="final_norm")(x)
         x = x.astype(dt)
         if cfg.tie_word_embeddings:

@@ -104,11 +104,15 @@ def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
         # amp O1 seam: same cast as the dense LlamaModel
         return x.astype(resolve_compute_dtype(cfg.dtype))
 
+    # cfg.remat: per-block recompute inside the stage (see gpt_pipeline)
+    block_apply = (jax.checkpoint(block.apply) if cfg.remat
+                   else block.apply)
+
     def stage_fn(local, x):
         cos_, sin_ = _tables(x.shape[-2])
 
         def body(h, bp):
-            return block.apply({"params": bp}, h, cos_, sin_), None
+            return block_apply({"params": bp}, h, cos_, sin_), None
 
         h, _ = lax.scan(body, x, local["blocks"])
         return h

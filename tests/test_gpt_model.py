@@ -157,3 +157,47 @@ def test_gpt_train_smoke(rng):
         params = opt.step(g)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_remat_same_loss_and_grads(rng):
+    """cfg.remat recomputes blocks in backward: loss AND grads must be
+    bit-compatible with the non-remat model (same params, same tree)."""
+    import dataclasses
+
+    cfg = gpt_tiny_config()
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    m, mr = GPTModel(cfg), GPTModel(cfg_r)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    # identical param tree (remat must not rewrap/rename)
+    vr = mr.init(jax.random.PRNGKey(0), ids)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(vr)
+
+    l, g = jax.value_and_grad(
+        lambda p: gpt_loss(m, {"params": p}, ids, labels))(v["params"])
+    lr_, gr_ = jax.value_and_grad(
+        lambda p: gpt_loss(mr, {"params": p}, ids, labels))(v["params"])
+    np.testing.assert_allclose(float(l), float(lr_), rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(gr_)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_remat_with_moe_keeps_aux(rng):
+    """remat + MoE: the sown aux must survive the lifted checkpoint (a
+    zeroed aux would silently disable load balancing)."""
+    import dataclasses
+
+    cfg = gpt_tiny_config(num_experts=4, moe_capacity_factor=3.0,
+                          moe_aux_loss_coeff=0.0)
+    cfg1 = dataclasses.replace(cfg, moe_aux_loss_coeff=1.0, remat=True)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    m0 = GPTModel(dataclasses.replace(cfg, remat=True))
+    m1 = GPTModel(cfg1)
+    v = m0.init(jax.random.PRNGKey(0), ids)
+    l0 = float(gpt_loss(m0, v, ids, labels))
+    l1 = float(gpt_loss(m1, v, ids, labels))
+    assert l1 > l0 + 0.5  # balance loss >= 1 at any routing

@@ -192,3 +192,49 @@ def test_get_forward_backward_func_interleaved_dispatch():
 
     assert (get_forward_backward_func(2, 4)
             is forward_backward_pipelining_with_interleaving)
+
+
+def test_pipeline_remat_matches_no_remat(mesh_tp2_pp2_dp2, rng):
+    """cfg.remat inside stage_fn (jax.checkpoint on the scanned block
+    apply): identical loss + grads to the non-remat pipeline."""
+    import dataclasses
+
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    mesh = mesh_tp2_pp2_dp2
+    pp, n_layers, m, b, s = 2, 4, 4, 2, 8
+    cfg = gpt_tiny_config(num_layers=n_layers)
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.roll(mbs, -1, axis=-1)
+    v = GPTModel(cfg).init(jax.random.PRNGKey(0), mbs[0])["params"]
+    stacked = split_gpt_params_for_pipeline(v, pp, n_layers)
+
+    def run_with(cfg_x):
+        first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg_x)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+            out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)), check_vma=False)
+        def run(p, mb, lb):
+            local = jax.tree.map(lambda t: t[0], p)
+            sched = {"blocks": jax.tree.map(lambda t: t[0],
+                                            local["blocks"]),
+                     "shared": local["shared"]}
+            loss, g = fwd_bwd(stage_fn, loss_fn, sched, mb, loss_aux=lb,
+                              first_fn=first_fn, loss_with_params=True)
+            g = {"blocks": jax.tree.map(lambda t: t[None], g["blocks"]),
+                 "shared": g["shared"]}
+            return loss.reshape(1), jax.tree.map(lambda t: t[None], g)
+
+        return jax.jit(run)(stacked, mbs, labels)
+
+    l0, g0 = run_with(cfg)
+    l1, g1 = run_with(cfg_r)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                               rtol=1e-6, atol=1e-6)
+    for a, c in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-5, atol=1e-6)

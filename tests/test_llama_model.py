@@ -285,3 +285,17 @@ def test_llama_moe_pipeline_rejected():
     cfg = dataclasses.replace(llama_tiny_config(), num_experts=4)
     with pytest.raises(NotImplementedError, match="MoE"):
         make_llama_pipeline_fns(cfg)
+
+
+def test_llama_remat_same_loss(rng):
+    import dataclasses
+
+    cfg = llama_tiny_config()
+    m = LlamaModel(cfg)
+    mr = LlamaModel(dataclasses.replace(cfg, remat=True))
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    np.testing.assert_allclose(
+        float(llama_loss(m, v, ids, labels)),
+        float(llama_loss(mr, v, ids, labels)), rtol=1e-6, atol=1e-6)
