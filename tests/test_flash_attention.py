@@ -278,3 +278,40 @@ def test_sliding_window_banded_grid_small_blocks(rng, window):
     for a, r in zip(gk, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_flash_property_fuzz_vs_reference(rng):
+    """Property fuzz (hypothesis): random (shape, causal, window, kv_heads,
+    block sizes) must match the dense reference in forward. Catches band /
+    GQA / padding edge interactions no enumerated grid covers."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=25, deadline=None, derandomize=True)
+    @given(
+        b=st.integers(1, 2),
+        h_pow=st.integers(0, 2),          # heads in {1, 2, 4}
+        kv_div=st.integers(0, 2),         # kv_heads = heads / 2**kv_div
+        sq=st.integers(9, 150),
+        d=st.sampled_from([8, 32, 40]),
+        causal=st.booleans(),
+        window=st.one_of(st.none(), st.integers(1, 200)),
+        bq=st.sampled_from([None, 16, 32]),
+    )
+    def check(b, h_pow, kv_div, sq, d, causal, window, bq):
+        h = 2 ** h_pow
+        kvh = max(1, h >> kv_div)   # power-of-two divisor of h by construction
+        if window is not None and not causal:
+            causal = True
+        local = np.random.default_rng(b * 1000 + sq)
+        q = jnp.asarray(local.standard_normal((b, h, sq, d)), jnp.float32)
+        k = jnp.asarray(local.standard_normal((b, kvh, sq, d)), jnp.float32)
+        v = jnp.asarray(local.standard_normal((b, kvh, sq, d)), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal, window=window,
+                              block_q=bq, block_k=bq)
+        ref = mha_reference(q, k, v, causal=causal, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    check()

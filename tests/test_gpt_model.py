@@ -201,3 +201,8 @@ def test_remat_with_moe_keeps_aux(rng):
     l0 = float(gpt_loss(m0, v, ids, labels))
     l1 = float(gpt_loss(m1, v, ids, labels))
     assert l1 > l0 + 0.5  # balance loss >= 1 at any routing
+    # the aux must be counted EXACTLY once under remat: equal to the
+    # non-remat MoE model's loss (a doubled sow would inflate it)
+    l1_plain = float(gpt_loss(GPTModel(dataclasses.replace(
+        cfg1, remat=False)), v, ids, labels))
+    np.testing.assert_allclose(l1, l1_plain, rtol=1e-6, atol=1e-6)

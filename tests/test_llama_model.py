@@ -287,7 +287,9 @@ def test_llama_moe_pipeline_rejected():
         make_llama_pipeline_fns(cfg)
 
 
-def test_llama_remat_same_loss(rng):
+def test_llama_remat_same_loss_and_grads(rng):
+    """remat only changes the backward — grads must match, not just loss
+    (cos_/sin_ extra args + variable lifting ride through the recompute)."""
     import dataclasses
 
     cfg = llama_tiny_config()
@@ -296,6 +298,12 @@ def test_llama_remat_same_loss(rng):
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
     labels = jnp.roll(ids, -1, axis=1)
     v = m.init(jax.random.PRNGKey(0), ids)
-    np.testing.assert_allclose(
-        float(llama_loss(m, v, ids, labels)),
-        float(llama_loss(mr, v, ids, labels)), rtol=1e-6, atol=1e-6)
+    l0, g0 = jax.value_and_grad(
+        lambda p: llama_loss(m, {"params": p}, ids, labels))(v["params"])
+    l1, g1 = jax.value_and_grad(
+        lambda p: llama_loss(mr, {"params": p}, ids, labels))(v["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
