@@ -102,3 +102,25 @@ def test_moe_expert_parallel_trains():
     mod = _load("example_moe_ep", "examples/moe/train_moe_ep.py")
     losses = mod.run_training(steps=6, verbose=_quiet)
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.slow
+def test_amp_opt_level_cross_consistency():
+    """L1-tier analog (reference tests/L1/common/run_test.sh + compare.py):
+    the SAME model/data trained under O0 / O1 / O2 must produce close loss
+    curves — bf16 compute (O1) and bf16 params (O2) may drift only within
+    half-precision tolerance of the fp32 run."""
+    imagenet = _load("example_imagenet_xc", "examples/imagenet/main_amp.py")
+
+    curves = {}
+    for lvl in ("O0", "O1", "O2"):
+        model = imagenet.resnet_tiny()
+        curves[lvl] = imagenet.run_training(
+            model, steps=6, batch_size=8, image_size=16, opt_level=lvl,
+            lr=0.05, verbose=_quiet)
+    import numpy as np
+    o0 = np.asarray(curves["O0"])
+    for lvl in ("O1", "O2"):
+        drift = np.max(np.abs(np.asarray(curves[lvl]) - o0))
+        assert drift < 0.25, (lvl, curves[lvl], curves["O0"])
+        assert curves[lvl][-1] < curves[lvl][0]
