@@ -7,12 +7,26 @@ TAG="${1:-r03}"
 DEADLINE="${2:-28800}"
 START=$(date +%s)
 cd "$(dirname "$0")"
+bench_ok() {
+  python - <<'EOF'
+import json, sys
+try:
+    with open("BENCH_r03.json.local") as f:
+        sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
 while true; do
   now=$(date +%s)
   if [ $((now - START)) -ge "$DEADLINE" ]; then
     echo "[watch] deadline reached"; exit 1
   fi
+  if bench_ok; then echo "[watch] bench nonzero; done"; exit 0; fi
   bash run_tpu_round.sh "$TAG" && {
     echo "[watch] TPU round completed"; exit 0; }
-  sleep 900
+  # each attempt already spends ~15 min probing; short gap keeps the duty
+  # cycle high against a tunnel that comes back on minute timescales
+  sleep 240
 done
