@@ -21,6 +21,7 @@ the ``.sum(0)`` over shared grads inside ``merge_pipeline_grads`` does this.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -42,6 +43,15 @@ from apex_tpu.transformer.utils import divide
 GPT_SHARED_NAMES = ("word_embeddings", "position_embeddings", "final_norm")
 
 
+def is_per_position_layout(blocks_tree) -> bool:
+    """Exact detection of the heterogeneous per-position block layout the
+    split writes: a dict keyed ``k0..k{K-1}`` (one entry per within-stage
+    layer position). The scanned layout is a dict of PARAM names instead."""
+    return (isinstance(blocks_tree, dict)
+            and set(blocks_tree) == {f"k{i}"
+                                     for i in range(len(blocks_tree))})
+
+
 def split_params_for_pipeline(params, n_stages: int, num_layers: int,
                               shared_names, virtual_chunks: int = 1):
     """Partition a layer_i-structured param tree into the pipeline layout
@@ -58,6 +68,42 @@ def split_params_for_pipeline(params, n_stages: int, num_layers: int,
     virtual stage ``v*S + s`` (Megatron's round-robin VPP assignment).
     """
     chunk_layers = divide(num_layers, n_stages * virtual_chunks)
+    structs = [jax.tree_util.tree_structure(params[f"layer_{i}"])
+               for i in range(num_layers)]
+    homogeneous = all(st == structs[0] for st in structs)
+
+    if not homogeneous:
+        # heterogeneous layers (MoE every Nth block): per-POSITION dict
+        # layout {"k0": tree, "k1": tree, ...} — positions keep their own
+        # structure, leaves stack over stages only. Stage-position k must
+        # have the SAME structure on every stage (SPMD runs one program),
+        # which holds iff the MoE stride divides the layers-per-stage —
+        # the split itself verifies it structurally below.
+        if virtual_chunks != 1:
+            raise NotImplementedError(
+                "virtual pipeline chunks with heterogeneous (MoE) layers "
+                "are not supported; use virtual_chunks=1")
+        per_stage = []
+        for s in range(n_stages):
+            per_stage.append({
+                f"k{k}": params[f"layer_{s * chunk_layers + k}"]
+                for k in range(chunk_layers)})
+        for s in range(1, n_stages):
+            for k in range(chunk_layers):
+                if (jax.tree_util.tree_structure(per_stage[s][f"k{k}"])
+                        != jax.tree_util.tree_structure(
+                            per_stage[0][f"k{k}"])):
+                    raise NotImplementedError(
+                        "per-stage layer structures differ (the MoE stride "
+                        "does not divide layers-per-stage); choose "
+                        "moe_layer_freq so it divides "
+                        f"{chunk_layers} layers/stage")
+        blocks = jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+        shared = {name: params[name] for name in shared_names}
+        shared = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (n_stages,) + x.shape),
+            shared)
+        return {"blocks": blocks, "shared": shared}
 
     def stack_layers(idxs):
         trees = [params[f"layer_{i}"] for i in idxs]
@@ -83,15 +129,23 @@ def merge_pipeline_grads(grads, n_stages: int, num_layers: int,
                          shared_names, virtual_chunks: int = 1):
     """Inverse of ``split_params_for_pipeline`` for STACKED grad trees
     (leaves ``[S, ...]``): reassembles a model-layout grad tree, summing
-    the shared-param grads over stages (the tied-embedding all-reduce)."""
+    the shared-param grads over stages (the tied-embedding all-reduce).
+    Handles both block layouts (scanned layer-stacked and the
+    heterogeneous per-position ``k<i>`` dicts — see the split)."""
     chunk_layers = divide(num_layers, n_stages * virtual_chunks)
     out = {}
+    blocks = grads["blocks"]
+    het = is_per_position_layout(blocks)
     for s in range(n_stages):
         for v in range(virtual_chunks):
             vs = v * n_stages + s
             for k in range(chunk_layers):
-                out[f"layer_{vs * chunk_layers + k}"] = jax.tree.map(
-                    lambda t, s=s, v=v, k=k: t[s, v, k], grads["blocks"])
+                if het:
+                    out[f"layer_{vs * chunk_layers + k}"] = jax.tree.map(
+                        lambda t, s=s: t[s], blocks[f"k{k}"])
+                else:
+                    out[f"layer_{vs * chunk_layers + k}"] = jax.tree.map(
+                        lambda t, s=s, v=v, k=k: t[s, v, k], blocks)
     for name in shared_names:
         out[name] = jax.tree.map(lambda t: t.sum(0), grads["shared"][name])
     return out
@@ -123,13 +177,7 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
     The ``local`` tree is one device's slice: ``{"blocks": [V?, K, ...],
     "shared": {...}}`` (chunk axis present only under VPP).
     """
-    if cfg.num_experts > 0:
-        # the scanned shared-block formulation can't express per-layer MoE
-        # selection, and block.apply here discards sown aux losses — fail
-        # loud rather than train without load balancing
-        raise NotImplementedError(
-            "pipeline stages do not support MoE blocks yet "
-            "(num_experts > 0); use the non-pipelined GPTModel")
+    moe = cfg.num_experts > 0
     tp = cfg.tensor_parallel_size
     emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
                                  world_size=tp, params_dtype=cfg.param_dtype)
@@ -159,7 +207,13 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
             pos = sh["position_embeddings"][:s]
         x = x + pos[None, :, :]
         # amp O1 seam: same cast as the dense GPTModel
-        return x.astype(resolve_compute_dtype(cfg.dtype))
+        x = x.astype(resolve_compute_dtype(cfg.dtype))
+        if moe:
+            # MoE payload: the running aux-loss scalar rides the pipeline
+            # with the activation (pytree payloads are autodiff-schedule
+            # only — the dispatcher routes them there)
+            return (x, jnp.zeros((), jnp.float32))
+        return x
 
     # cfg.remat: recompute each block in backward (jax.checkpoint on the
     # PURE block.apply — no flax scoping involved), bounding within-stage
@@ -168,30 +222,69 @@ def make_gpt_pipeline_fns(cfg: GPTConfig) -> Tuple:
     block_apply = (jax.checkpoint(block.apply) if cfg.remat
                    else block.apply)
 
-    def stage_fn(local, x):
-        def body(h, bp):
-            return block_apply({"params": bp}, h), None
+    def stage_fn(local, payload):
+        if not moe:
+            def body(h, bp):
+                return block_apply({"params": bp}, h), None
 
-        h, _ = lax.scan(body, x, local["blocks"])
-        return h
+            h, _ = lax.scan(body, payload, local["blocks"])
+            return h
+
+        from apex_tpu.transformer.moe import collect_sown_aux
+
+        h, aux = payload
+        blocks_tree = local["blocks"]
+        if not is_per_position_layout(blocks_tree):
+            # homogeneous MoE (moe_layer_freq=1: every block routed, or a
+            # stride selecting none): the split kept the scanned layout —
+            # scan with the aux in the carry (mutable returns {} for
+            # non-routed blocks, collect yields 0). ``mutable`` is bound
+            # BEFORE jax.checkpoint: it is a static kwarg, not a tracer.
+            apply_m = functools.partial(block.apply,
+                                        mutable=["intermediates"])
+            if cfg.remat:
+                apply_m = jax.checkpoint(apply_m)
+
+            def body(carry, bp):
+                hh, ax = carry
+                out, upd = apply_m({"params": bp}, hh)
+                return (out, ax + collect_sown_aux(upd)), None
+
+            (h, aux), _ = lax.scan(body, (h, aux), blocks_tree)
+            return h, aux
+
+        # heterogeneous per-position layout (split_params_for_pipeline):
+        # python loop over the K within-stage positions; position k's
+        # MoE-vs-dense choice is stage-uniform (the split verified the
+        # stride divides layers/stage), so layer_idx=k selects correctly
+        for key in sorted(blocks_tree, key=lambda n: int(n[1:])):
+            blk = ParallelDecoderBlock(cfg, layer_idx=int(key[1:]))
+            if blk._is_moe_layer():
+                apply_k = functools.partial(blk.apply,
+                                            mutable=["intermediates"])
+                if cfg.remat:
+                    apply_k = jax.checkpoint(apply_k)
+                h, upd = apply_k({"params": blocks_tree[key]}, h)
+                aux = aux + collect_sown_aux(upd)
+            else:
+                apply_k = (jax.checkpoint(blk.apply) if cfg.remat
+                           else blk.apply)
+                h = apply_k({"params": blocks_tree[key]}, h)
+        return h, aux
 
     def loss_fn(local, y, labels):
+        from apex_tpu.models.gpt import lm_token_loss
+
         sh = local["shared"]
+        moe_aux = None
+        if moe:
+            y, moe_aux = y
         h = norm.apply({"params": sh["final_norm"]}, y)
         logits = emb.apply({"params": sh["word_embeddings"]},
                            h.astype(resolve_compute_dtype(cfg.dtype)),
                            method=VocabParallelEmbedding.attend)
-        if axis_is_bound(MODEL_AXIS):
-            per_tok = vocab_parallel_cross_entropy(
-                logits.astype(jnp.float32), labels, axis_name=MODEL_AXIS)
-        else:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            per_tok = -jnp.take_along_axis(
-                logp, labels[..., None], axis=-1)[..., 0]
-        loss = per_tok.mean()
-        if _cp_bound():
-            # chunk means combine to the global token mean (equal chunks)
-            loss = lax.pmean(loss, CONTEXT_AXIS)
-        return loss
+        return lm_token_loss(logits, labels, axis_name=MODEL_AXIS,
+                             context_parallel=cfg.context_parallel,
+                             extra=moe_aux)
 
     return first_fn, stage_fn, loss_fn

@@ -68,13 +68,7 @@ def merge_pipeline_grads_to_llama(cfg: LlamaConfig, grads, n_stages: int,
 def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
     """(first_fn, stage_fn, loss_fn) for the pipeline schedules
     (use with ``loss_with_params=True``), mirroring make_gpt_pipeline_fns."""
-    if cfg.num_experts > 0:
-        # same constraint as make_gpt_pipeline_fns: the scanned shared-block
-        # formulation can't express per-layer MoE selection and would
-        # silently drop the sown aux losses
-        raise NotImplementedError(
-            "pipeline stages do not support MoE blocks yet "
-            "(num_experts > 0); use the non-pipelined LlamaModel")
+    moe = cfg.num_experts > 0
     tp = cfg.tensor_parallel_size
     emb = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
                                  world_size=tp, params_dtype=cfg.param_dtype)
@@ -102,23 +96,74 @@ def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
     def first_fn(local, ids):
         x = emb.apply({"params": local["shared"]["embed_tokens"]}, ids)
         # amp O1 seam: same cast as the dense LlamaModel
-        return x.astype(resolve_compute_dtype(cfg.dtype))
+        x = x.astype(resolve_compute_dtype(cfg.dtype))
+        if moe:
+            # aux-loss scalar rides the payload (autodiff schedule only —
+            # the dispatcher routes pytree payloads there)
+            return (x, jnp.zeros((), jnp.float32))
+        return x
 
     # cfg.remat: per-block recompute inside the stage (see gpt_pipeline)
     block_apply = (jax.checkpoint(block.apply) if cfg.remat
                    else block.apply)
 
-    def stage_fn(local, x):
-        cos_, sin_ = _tables(x.shape[-2])
+    def stage_fn(local, payload):
+        if not moe:
+            cos_, sin_ = _tables(payload.shape[-2])
 
-        def body(h, bp):
-            return block_apply({"params": bp}, h, cos_, sin_), None
+            def body(h, bp):
+                return block_apply({"params": bp}, h, cos_, sin_), None
 
-        h, _ = lax.scan(body, x, local["blocks"])
-        return h
+            h, _ = lax.scan(body, payload, local["blocks"])
+            return h
+
+        import functools
+
+        from apex_tpu.models.gpt_pipeline import is_per_position_layout
+        from apex_tpu.models.llama import LlamaDecoderBlock as _Blk
+        from apex_tpu.transformer.moe import collect_sown_aux
+
+        h, aux = payload
+        cos_, sin_ = _tables(h.shape[-2])
+        blocks_tree = local["blocks"]
+        if not is_per_position_layout(blocks_tree):
+            # homogeneous MoE (freq=1 all routed / stride selecting none):
+            # scanned layout, aux in the carry; mutable bound pre-checkpoint
+            apply_m = functools.partial(block.apply,
+                                        mutable=["intermediates"])
+            if cfg.remat:
+                apply_m = jax.checkpoint(apply_m)
+
+            def body(carry, bp):
+                hh, ax = carry
+                out, upd = apply_m({"params": bp}, hh, cos_, sin_)
+                return (out, ax + collect_sown_aux(upd)), None
+
+            (h, aux), _ = lax.scan(body, (h, aux), blocks_tree)
+            return h, aux
+
+        # heterogeneous per-position layout (see gpt_pipeline.stage_fn)
+        for key in sorted(blocks_tree, key=lambda n: int(n[1:])):
+            blk = _Blk(cfg, layer_idx=int(key[1:]))
+            if blk._is_moe_layer():
+                apply_k = functools.partial(blk.apply,
+                                            mutable=["intermediates"])
+                if cfg.remat:
+                    apply_k = jax.checkpoint(apply_k)
+                h, upd = apply_k({"params": blocks_tree[key]}, h, cos_,
+                                 sin_)
+                aux = aux + collect_sown_aux(upd)
+            else:
+                apply_k = (jax.checkpoint(blk.apply) if cfg.remat
+                           else blk.apply)
+                h = apply_k({"params": blocks_tree[key]}, h, cos_, sin_)
+        return h, aux
 
     def loss_fn(local, y, labels):
         sh = local["shared"]
+        moe_aux = None
+        if moe:
+            y, moe_aux = y
         h = norm.apply({"params": sh["final_norm"]}, y).astype(
             resolve_compute_dtype(cfg.dtype))
         if cfg.tie_word_embeddings:
@@ -127,6 +172,7 @@ def make_llama_pipeline_fns(cfg: LlamaConfig) -> Tuple:
         else:
             logits = head.apply({"params": sh["lm_head"]}, h)
         return lm_token_loss(logits, labels, axis_name=MODEL_AXIS,
-                             context_parallel=cfg.context_parallel)
+                             context_parallel=cfg.context_parallel,
+                             extra=moe_aux)
 
     return first_fn, stage_fn, loss_fn

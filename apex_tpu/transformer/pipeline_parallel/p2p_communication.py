@@ -29,7 +29,9 @@ def _shift(x, axis_name: str, offset: int, wrap: bool):
         perm = [(i, (i + offset) % n) for i in range(n)]
     else:
         perm = [(i, i + offset) for i in range(n) if 0 <= i + offset < n]
-    return lax.ppermute(x, axis_name, perm)
+    # pytree payloads supported (e.g. (activation, moe_aux) tuples): one
+    # ppermute per leaf, scheduled concurrently by XLA
+    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
 
 
 def send_forward_recv_forward(x, axis_name: str = STAGE_AXIS, wrap: bool = False):

@@ -91,20 +91,22 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
         # stage 0 picks up microbatch t (clamped; beyond M it computes
         # garbage that never reaches a valid output slot)
         x0 = entry(stage_params, _index_mb(microbatches, t, m))
-        x = jnp.where(s == 0, x0.astype(buf.dtype), buf)
+        x = jax.tree.map(
+            lambda a, b: jnp.where(s == 0, a.astype(b.dtype), b), x0, buf)
         y = body(stage_params, x)
         return p2p.send_forward_recv_forward(y, axis_name), y
 
     # activation shape probe: traced (so collectives see the bound axes —
     # jax.eval_shape would drop the shard_map axis env) but DCE'd, since only
-    # its static shape is used. Stages map the activation shape to itself
-    # (the reference's fixed tensor_shape contract), so the entry output IS
-    # the carry shape.
+    # its static shape is used. Stages map the activation STRUCTURE to
+    # itself (the reference's fixed tensor_shape contract); the payload may
+    # be any pytree (e.g. (activation, moe_aux)) — every leaf rides the
+    # scan carry and the per-tick ppermute.
     x0_probe = entry(stage_params, _index_mb(microbatches, 0, m))
-    buf0 = jnp.zeros(x0_probe.shape, x0_probe.dtype)
+    buf0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), x0_probe)
     _, ys = lax.scan(tick, buf0, jnp.arange(t_total))
     # last stage emits microbatch mb at tick mb + (S-1)
-    return ys[n_stages - 1:]
+    return jax.tree.map(lambda t: t[n_stages - 1:], ys)
 
 
 def _jaxpr_has_ppermute(jaxpr) -> bool:
@@ -389,11 +391,19 @@ def forward_backward_pipelining_without_interleaving(
 
     if forward_only:
         return mean_loss_of(stage_params), None
+    # the explicit 1F1B's ring buffer and zero-cotangent plumbing assume a
+    # SINGLE-array activation; pytree payloads (e.g. MoE's
+    # (activation, aux) tuples) route to the uniform autodiff schedule
+    entry0 = first_fn if first_fn is not None else (lambda p, mb: mb)
+    payload0 = entry0(stage_params,
+                      _index_mb(microbatches, 0, _mb_count(microbatches)))
+    single_array_payload = not isinstance(payload0, (tuple, list, dict))
     # pp=1 has no pipeline to interleave: the autodiff scan handles it (the
     # pre-round-3 behavior for direct callers on a size-1 stage axis).
     # Ring-attention/halo stages (they emit ppermute, a GLOBAL collective)
     # also route to autodiff — see _stage_issues_ppermute.
     if (implementation == "1f1b" and n_stages >= 2
+            and single_array_payload
             and _use_explicit_schedule(stage_fn, stage_params, first_fn,
                                        loss_fn, loss_aux, loss_with_params,
                                        microbatches)):
@@ -620,6 +630,18 @@ def forward_backward_pipelining_with_interleaving(
         raise RuntimeError(
             "pipeline schedules must run inside shard_map with the "
             f"'{axis_name}' axis bound")
+    if first_fn is not None:
+        # probe with chunk 0's params — exactly what the schedule itself
+        # feeds first_fn, so a raising first_fn here is a REAL error and
+        # propagates (no blanket except that could mute the guard)
+        _entry0 = first_fn(
+            jax.tree.map(lambda t: t[0], chunk_params),
+            _index_mb(microbatches, 0, _mb_count(microbatches)))
+        if isinstance(_entry0, (tuple, list, dict)):
+            raise NotImplementedError(
+                "the interleaved schedule takes a single-array activation; "
+                "pytree payloads (MoE aux) are only supported by the "
+                "non-interleaved schedules")
     n_stages = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
 

@@ -64,13 +64,69 @@ def test_gpt_moe_aux_loss_included(rng):
     assert l1 > l0 + 0.5
 
 
-def test_gpt_moe_pipeline_rejected():
-    """Pipeline stages can't express MoE yet — must fail loud, not train
-    silently without the aux loss."""
-    from apex_tpu.models.gpt_pipeline import make_gpt_pipeline_fns
+@pytest.mark.slow
+def test_gpt_moe_pipeline_matches_dense(rng):
+    """MoE through the pipeline: the aux loss rides the activation payload
+    (pytree payload -> autodiff schedule), heterogeneous per-position
+    block layout; loss + merged grads match the non-pipelined GPT-MoE."""
+    from apex_tpu.mesh import STAGE_AXIS
+    from apex_tpu.models.gpt import GPTModel, gpt_loss
+    from apex_tpu.models.gpt_pipeline import (
+        make_gpt_pipeline_fns, merge_pipeline_grads_to_gpt,
+        split_gpt_params_for_pipeline)
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
 
-    with pytest.raises(NotImplementedError, match="MoE"):
-        make_gpt_pipeline_fns(_moe_cfg())
+    pp, n_layers, m, b, s = 2, 4, 4, 2, 16
+    cfg = _moe_cfg(num_layers=n_layers)
+    mesh = parallel_state.initialize_model_parallel(1, pp)
+
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.roll(mbs, -1, axis=-1)
+    model = GPTModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), mbs[0])["params"]
+
+    def ref_loss(p):
+        per = jax.vmap(lambda ii, ll: gpt_loss(
+            model, {"params": p}, ii, ll, axis_name="unbound"))(mbs, labels)
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(v)
+
+    stacked = split_gpt_params_for_pipeline(v, pp, n_layers)
+    first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)), check_vma=False)
+    def run(p, mb, lb):
+        local = jax.tree.map(lambda t: t[0], p)
+        loss, g = fwd_bwd(stage_fn, loss_fn, local, mb, loss_aux=lb,
+                          first_fn=first_fn, loss_with_params=True)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None], g)
+
+    loss_pp, g_pp = jax.jit(run)(stacked, mbs, labels)
+    np.testing.assert_allclose(np.asarray(loss_pp), float(ref_l),
+                               rtol=2e-5, atol=2e-5)
+    merged = merge_pipeline_grads_to_gpt(g_pp, pp, n_layers)
+    for a, r in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-3, atol=1e-4)
+
+
+def test_gpt_moe_pipeline_rejects_bad_stride():
+    """MoE stride must divide layers-per-stage (SPMD needs a stage-uniform
+    block pattern) — fail loud at split time."""
+    from apex_tpu.models.gpt import GPTModel
+    from apex_tpu.models.gpt_pipeline import split_gpt_params_for_pipeline
+
+    cfg = _moe_cfg(num_layers=6, moe_layer_freq=4)  # 3 layers/stage, freq 4
+    ids = jnp.zeros((1, 8), jnp.int32)
+    v = GPTModel(cfg).init(jax.random.PRNGKey(0), ids)["params"]
+    with pytest.raises(NotImplementedError, match="stride"):
+        split_gpt_params_for_pipeline(v, 2, 6)
 
 
 @pytest.mark.slow
@@ -113,3 +169,52 @@ def test_gpt_moe_expert_parallel_matches_dense(rng):
 
     loss_ep = float(jax.jit(ep_loss)(v["params"], ids, labels))
     np.testing.assert_allclose(loss_ep, loss_ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_gpt_moe_pipeline_freq1_all_routed(rng):
+    """moe_layer_freq=1 (every block MoE): layers stay structurally
+    homogeneous, so the split keeps the scanned layout and stage_fn carries
+    the aux through the scan — parity vs the dense model."""
+    from apex_tpu.mesh import STAGE_AXIS
+    from apex_tpu.models.gpt import GPTModel, gpt_loss
+    from apex_tpu.models.gpt_pipeline import (
+        make_gpt_pipeline_fns, split_gpt_params_for_pipeline)
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    pp, n_layers, m, b, s = 2, 4, 2, 2, 16
+    cfg = _moe_cfg(num_layers=n_layers, moe_layer_freq=1)
+    mesh = parallel_state.initialize_model_parallel(1, pp)
+
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.roll(mbs, -1, axis=-1)
+    model = GPTModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), mbs[0])["params"]
+
+    ref = float(jax.vmap(lambda ii, ll: gpt_loss(
+        model, {"params": v}, ii, ll, axis_name="unbound"))(
+        mbs, labels).mean())
+
+    stacked = split_gpt_params_for_pipeline(v, pp, n_layers)
+    # homogeneous layers -> scanned layout, NOT the per-position dict
+    assert "k0" not in stacked["blocks"]
+    first_fn, stage_fn, loss_fn = make_gpt_pipeline_fns(cfg)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=P(STAGE_AXIS), check_vma=False)
+    def run(p, mb, lb):
+        local = jax.tree.map(lambda t: t[0], p)
+        # scanned layout carries the V=1 chunk axis — drop it (the
+        # heterogeneous k-dict layout has none)
+        sched = {"blocks": jax.tree.map(lambda t: t[0], local["blocks"]),
+                 "shared": local["shared"]}
+        loss, _ = fwd_bwd(stage_fn, loss_fn, sched, mb, loss_aux=lb,
+                          first_fn=first_fn, loss_with_params=True)
+        return loss.reshape(1)
+
+    loss_pp = jax.jit(run)(stacked, mbs, labels)
+    np.testing.assert_allclose(np.asarray(loss_pp), ref,
+                               rtol=2e-5, atol=2e-5)

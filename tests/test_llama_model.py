@@ -277,14 +277,61 @@ def test_mixtral_style_moe_llama_trains(rng):
     assert losses[-1] < losses[0], losses
 
 
-def test_llama_moe_pipeline_rejected():
+@pytest.mark.slow
+def test_llama_moe_pipeline_matches_dense(rng):
+    """Mixtral + PP: SwiGLU MoE blocks through the pipeline (aux rides the
+    payload, autodiff schedule) == the non-pipelined model."""
     import dataclasses
+    import functools
 
-    from apex_tpu.models.llama_pipeline import make_llama_pipeline_fns
+    from jax.sharding import PartitionSpec as P
 
-    cfg = dataclasses.replace(llama_tiny_config(), num_experts=4)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        make_llama_pipeline_fns(cfg)
+    from apex_tpu.mesh import STAGE_AXIS
+    from apex_tpu.models.llama_pipeline import (
+        make_llama_pipeline_fns, merge_pipeline_grads_to_llama,
+        split_llama_params_for_pipeline)
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_without_interleaving as fwd_bwd)
+
+    pp, n_layers, m, b, s = 2, 4, 4, 2, 16
+    cfg = dataclasses.replace(
+        llama_tiny_config(num_layers=n_layers), num_experts=4,
+        moe_capacity_factor=3.0, sliding_window=8)
+    mesh = parallel_state.initialize_model_parallel(1, pp)
+
+    mbs = jnp.asarray(rng.integers(0, cfg.vocab_size, (m, b, s)), jnp.int32)
+    labels = jnp.roll(mbs, -1, axis=-1)
+    model = LlamaModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), mbs[0])["params"]
+
+    def ref_loss(p):
+        per = jax.vmap(lambda ii, ll: llama_loss(
+            model, {"params": p}, ii, ll, axis_name="unbound"))(mbs, labels)
+        return per.mean()
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(v)
+
+    stacked = split_llama_params_for_pipeline(cfg, v, pp)
+    first_fn, stage_fn, loss_fn = make_llama_pipeline_fns(cfg)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(P(STAGE_AXIS), P(), P()),
+        out_specs=(P(STAGE_AXIS), P(STAGE_AXIS)), check_vma=False)
+    def run(p, mb, lb):
+        local = jax.tree.map(lambda t: t[0], p)
+        loss, g = fwd_bwd(stage_fn, loss_fn, local, mb, loss_aux=lb,
+                          first_fn=first_fn, loss_with_params=True)
+        return loss.reshape(1), jax.tree.map(lambda t: t[None], g)
+
+    loss_pp, g_pp = jax.jit(run)(stacked, mbs, labels)
+    np.testing.assert_allclose(np.asarray(loss_pp), float(ref_l),
+                               rtol=2e-5, atol=2e-5)
+    merged = merge_pipeline_grads_to_llama(cfg, g_pp, pp)
+    for a, r in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-3, atol=1e-4)
 
 
 def test_llama_remat_same_loss_and_grads(rng):
