@@ -17,7 +17,12 @@ from apex_tpu.models.gpt import (  # noqa: F401
     gpt_tiny_config,
     lm_token_loss,
 )
+from apex_tpu.models import hf_convert  # noqa: F401
 from apex_tpu.models import llama  # noqa: F401
+from apex_tpu.models.hf_convert import (  # noqa: F401
+    llama_config_from_hf,
+    llama_params_from_hf,
+)
 from apex_tpu.models.llama import (  # noqa: F401
     LlamaConfig,
     LlamaModel,

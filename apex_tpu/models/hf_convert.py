@@ -1,0 +1,119 @@
+"""HuggingFace → apex_tpu checkpoint conversion (Llama family).
+
+Beyond-reference interop: load a ``transformers`` Llama/Mistral checkpoint
+into :class:`apex_tpu.models.llama.LlamaModel`. Pure tensor relayout — the
+numerics are asserted identical (tests/test_hf_convert.py compares logits
+against ``LlamaForCausalLM`` bit-for-float): both sides use NeoX-style
+rotate-half RoPE, fp32 RMSNorm accumulation, and 1/sqrt(d) attention
+scaling, so a converted model reproduces the torch forward to float32
+tolerance.
+
+Layout notes (HF name -> ours):
+- ``self_attn.{k,v}_proj.weight``  -> ``kv_proj/weight`` rows ``[K | V]``
+  (our fused projection's per-rank layout)
+- ``mlp.{gate,up}_proj.weight``    -> ``gate_up_proj/weight`` ``[gate | up]``
+- everything else maps 1:1 (torch linear weights are (out, in), the same
+  Megatron layout our TP linears use).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.llama import LlamaConfig
+
+
+def llama_config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig``-like object to ours (fp32 —
+    checkpoint conversion is a precision-sensitive context). Raises on
+    config features our model does not express (rope scaling, biases,
+    non-derived head_dim) instead of silently converting to wrong
+    numerics."""
+    if getattr(hf_config, "rope_scaling", None):
+        raise NotImplementedError(
+            "rope_scaling (Llama-3.x scaled RoPE) is not supported by "
+            "apex_tpu's _rope_cos_sin — converting would silently change "
+            "the numerics")
+    for bias_flag in ("attention_bias", "mlp_bias"):
+        if getattr(hf_config, bias_flag, False):
+            raise NotImplementedError(
+                f"{bias_flag}=True checkpoints carry bias tensors our "
+                "bias-free Llama blocks cannot hold")
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit = getattr(hf_config, "head_dim", None)
+    if explicit is not None and explicit != derived:
+        raise NotImplementedError(
+            f"head_dim={explicit} != hidden_size/num_heads={derived}; "
+            "LlamaConfig derives head_dim and has no override")
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        rms_eps=hf_config.rms_norm_eps,
+        dtype=jnp.float32,
+        tie_word_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", False)),
+        sliding_window=getattr(hf_config, "sliding_window", None),
+    )
+
+
+def llama_params_from_hf(state_dict: Dict[str, Any],
+                         cfg: LlamaConfig) -> dict:
+    """Convert a ``LlamaForCausalLM.state_dict()`` (torch tensors or numpy
+    arrays) into the ``LlamaModel`` param tree (tp=1 layout — shard with
+    the TP slicers afterwards if needed)."""
+    if cfg.tensor_parallel_size != 1:
+        raise NotImplementedError(
+            "llama_params_from_hf emits the tp=1 layout; convert at tp=1 "
+            "and slice per rank (fused projections need per-shard "
+            "[K_r|V_r]/[gate_r|up_r] interleaving, not a global concat)")
+    consumed = set()
+
+    def t(name):
+        consumed.add(name)
+        x = state_dict[name]
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().numpy()
+        return jnp.asarray(np.asarray(x), jnp.float32)
+
+    params = {
+        "embed_tokens": {"weight": t("model.embed_tokens.weight")},
+        "final_norm": {"weight": t("model.norm.weight")},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"weight": t("lm_head.weight")}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "input_norm": {"weight": t(p + "input_layernorm.weight")},
+            "q_proj": {"weight": t(p + "self_attn.q_proj.weight")},
+            "kv_proj": {"weight": jnp.concatenate(
+                [t(p + "self_attn.k_proj.weight"),
+                 t(p + "self_attn.v_proj.weight")], axis=0)},
+            "o_proj": {"weight": t(p + "self_attn.o_proj.weight")},
+            "post_norm": {"weight": t(p + "post_attention_layernorm.weight")},
+            "gate_up_proj": {"weight": jnp.concatenate(
+                [t(p + "mlp.gate_proj.weight"),
+                 t(p + "mlp.up_proj.weight")], axis=0)},
+            "down_proj": {"weight": t(p + "mlp.down_proj.weight")},
+        }
+    # every checkpoint tensor must have landed somewhere: silently dropped
+    # weights (e.g. bias tensors) mean silently wrong numerics
+    ignorable = {k for k in state_dict
+                 if k.endswith("rotary_emb.inv_freq")
+                 or (cfg.tie_word_embeddings and k == "lm_head.weight")}
+    leftover = set(state_dict) - consumed - ignorable
+    if leftover:
+        raise ValueError(
+            f"unconsumed checkpoint tensors (conversion would silently "
+            f"drop them): {sorted(leftover)[:8]}")
+    return params
