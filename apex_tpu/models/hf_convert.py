@@ -1,4 +1,4 @@
-"""HuggingFace → apex_tpu checkpoint conversion (Llama family).
+"""HuggingFace → apex_tpu checkpoint conversion (Llama/Mistral + GPT-2).
 
 Beyond-reference interop: load a ``transformers`` Llama/Mistral checkpoint
 into :class:`apex_tpu.models.llama.LlamaModel`. Pure tensor relayout — the
@@ -26,6 +26,20 @@ import numpy as np
 from apex_tpu.models.llama import LlamaConfig
 
 
+def _fetch(state_dict, consumed, name, transpose=False):
+    """state_dict tensor -> fp32 jnp array (torch tensors detached; the
+    consumed-set powers the leftover check in both converters)."""
+    consumed.add(name)
+    x = state_dict[name]
+    if hasattr(x, "detach"):
+        # .float() first: numpy cannot represent torch bf16 directly
+        x = x.detach().cpu().float().numpy()
+    x = np.asarray(x)
+    if transpose:
+        x = x.T
+    return jnp.asarray(x, jnp.float32)
+
+
 def llama_config_from_hf(hf_config) -> LlamaConfig:
     """Map a ``transformers.LlamaConfig``-like object to ours (fp32 —
     checkpoint conversion is a precision-sensitive context). Raises on
@@ -42,6 +56,11 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
             raise NotImplementedError(
                 f"{bias_flag}=True checkpoints carry bias tensors our "
                 "bias-free Llama blocks cannot hold")
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise NotImplementedError(
+            f"hidden_act={act!r}: LlamaDecoderBlock hardcodes SwiGLU "
+            "(silu) — converting would silently change the numerics")
     derived = hf_config.hidden_size // hf_config.num_attention_heads
     explicit = getattr(hf_config, "head_dim", None)
     if explicit is not None and explicit != derived:
@@ -79,11 +98,7 @@ def llama_params_from_hf(state_dict: Dict[str, Any],
     consumed = set()
 
     def t(name):
-        consumed.add(name)
-        x = state_dict[name]
-        if hasattr(x, "detach"):
-            x = x.detach().cpu().numpy()
-        return jnp.asarray(np.asarray(x), jnp.float32)
+        return _fetch(state_dict, consumed, name)
 
     params = {
         "embed_tokens": {"weight": t("model.embed_tokens.weight")},
@@ -116,4 +131,80 @@ def llama_params_from_hf(state_dict: Dict[str, Any],
         raise ValueError(
             f"unconsumed checkpoint tensors (conversion would silently "
             f"drop them): {sorted(leftover)[:8]}")
+    return params
+
+
+def gpt2_config_from_hf(hf_config):
+    """Map a ``transformers.GPT2Config`` to :class:`GPTConfig` (fp32).
+    Fails loud on config variants GPTModel does not express."""
+    from apex_tpu.models.gpt import GPTConfig
+
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise NotImplementedError(
+            f"activation_function={act!r}: GPTModel hardcodes tanh-GELU "
+            "(gelu_new) — converting would silently change the numerics")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise NotImplementedError(
+                f"{flag}=True has no GPTModel analog")
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        max_position_embeddings=hf_config.n_positions,
+        layernorm_eps=hf_config.layer_norm_epsilon,
+        dtype=jnp.float32,
+    )
+
+
+def gpt2_params_from_hf(state_dict, cfg) -> dict:
+    """Convert a ``GPT2LMHeadModel.state_dict()`` into the ``GPTModel``
+    param tree. GPT-2's Conv1D weights are (in, out) — transposed to the
+    Megatron (out, in) layout; the fused c_attn [q|k|v] column order
+    matches our qkv row thirds after the transpose. GPT-2 ties its head
+    (``GPTModel`` is always tied), so ``lm_head.weight`` is ignorable."""
+    if cfg.tensor_parallel_size != 1:
+        raise NotImplementedError(
+            "gpt2_params_from_hf emits the tp=1 layout (per-rank qkv needs "
+            "per-third interleaving)")
+    consumed = set()
+
+    def t(name, transpose=False):
+        return _fetch(state_dict, consumed, name, transpose)
+
+    params = {
+        "word_embeddings": {"weight": t("transformer.wte.weight")},
+        "position_embeddings": t("transformer.wpe.weight"),
+        "final_norm": {"weight": t("transformer.ln_f.weight"),
+                       "bias": t("transformer.ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        params[f"layer_{i}"] = {
+            "input_norm": {"weight": t(p + "ln_1.weight"),
+                           "bias": t(p + "ln_1.bias")},
+            "qkv": {"weight": t(p + "attn.c_attn.weight", transpose=True),
+                    "bias": t(p + "attn.c_attn.bias")},
+            "out_proj": {"weight": t(p + "attn.c_proj.weight",
+                                     transpose=True),
+                         "bias": t(p + "attn.c_proj.bias")},
+            "post_norm": {"weight": t(p + "ln_2.weight"),
+                          "bias": t(p + "ln_2.bias")},
+            "mlp_in": {"weight": t(p + "mlp.c_fc.weight", transpose=True),
+                       "bias": t(p + "mlp.c_fc.bias")},
+            "mlp_out": {"weight": t(p + "mlp.c_proj.weight",
+                                    transpose=True),
+                        "bias": t(p + "mlp.c_proj.bias")},
+        }
+    ignorable = {k for k in state_dict
+                 if k == "lm_head.weight"                     # tied to wte
+                 or k.endswith(".attn.bias")                  # causal mask
+                 or k.endswith(".attn.masked_bias")}
+    leftover = set(state_dict) - consumed - ignorable
+    if leftover:
+        raise ValueError(
+            f"unconsumed checkpoint tensors: {sorted(leftover)[:8]}")
     return params

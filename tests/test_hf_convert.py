@@ -112,3 +112,28 @@ def test_unsupported_configs_fail_loud():
                     attention_bias=True)
     with pytest.raises(NotImplementedError, match="attention_bias"):
         llama_config_from_hf(bad2)
+
+
+def test_gpt2_logits_match_transformers(rng):
+    """GPT-2 cross-framework parity: Conv1D transposes, fused qkv order,
+    learned positions, tanh-approx GELU, tied head."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    from apex_tpu.models.gpt import GPTModel
+    from apex_tpu.models.hf_convert import (gpt2_config_from_hf,
+                                            gpt2_params_from_hf)
+
+    hf_cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=64,
+                        n_layer=2, n_head=4,
+                        attn_implementation="eager")
+    torch.manual_seed(2)
+    hf = GPT2LMHeadModel(hf_cfg).eval()
+    cfg = gpt2_config_from_hf(hf_cfg)
+    params = gpt2_params_from_hf(hf.state_dict(), cfg)
+
+    ids = rng.integers(0, hf_cfg.vocab_size, (2, 24))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids)).logits.numpy()
+    ours = np.asarray(GPTModel(cfg).apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
