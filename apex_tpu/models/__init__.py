@@ -20,6 +20,8 @@ from apex_tpu.models.gpt import (  # noqa: F401
 from apex_tpu.models import hf_convert  # noqa: F401
 from apex_tpu.models import llama  # noqa: F401
 from apex_tpu.models.hf_convert import (  # noqa: F401
+    bert_config_from_hf,
+    bert_params_from_hf,
     gpt2_config_from_hf,
     gpt2_params_from_hf,
     llama_config_from_hf,

@@ -43,6 +43,9 @@ class BertConfig:
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     layernorm_eps: float = 1e-12
+    # tanh-approx GELU (default: TPU-friendly, matches Megatron);
+    # False = exact erf GELU (HF BERT checkpoints' hidden_act='gelu')
+    gelu_approximate: bool = True
     dtype: Any = jnp.bfloat16        # compute dtype (amp O1/O2 analog)
     param_dtype: Any = jnp.float32
 
@@ -130,7 +133,7 @@ class BertLayer(nn.Module):
         b2 = self.param("mlp_bias2", nn.initializers.zeros,
                         (cfg.hidden_size,), cfg.param_dtype)
         hmid = jax.nn.gelu(x @ w1.astype(dt) + b1.astype(dt),
-                           approximate=True)
+                           approximate=cfg.gelu_approximate)
         mlp_out = hmid @ w2.astype(dt) + b2.astype(dt)
         if not deterministic and cfg.hidden_dropout > 0.0:
             mlp_out = nn.Dropout(cfg.hidden_dropout)(
@@ -205,7 +208,7 @@ class BertForPreTraining(nn.Module):
         mlm_out_b = self.param("mlm_output_bias", nn.initializers.zeros,
                                (cfg.vocab_size,), cfg.param_dtype)
         hmlm = jax.nn.gelu(x @ mlm_w.astype(dt) + mlm_b.astype(dt),
-                           approximate=True)
+                           approximate=cfg.gelu_approximate)
         hmlm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                               name="mlm_norm")(hmlm).astype(dt)
         mlm_logits = hmlm @ word_emb.T.astype(dt) + mlm_out_b.astype(dt)

@@ -208,3 +208,105 @@ def gpt2_params_from_hf(state_dict, cfg) -> dict:
         raise ValueError(
             f"unconsumed checkpoint tensors: {sorted(leftover)[:8]}")
     return params
+
+
+def bert_config_from_hf(hf_config):
+    """Map a ``transformers.BertConfig`` to :class:`BertConfig` (fp32).
+    Fails loud on activations the model cannot express."""
+    from apex_tpu.models.bert import BertConfig
+
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new"):
+        raise NotImplementedError(
+            f"hidden_act={act!r}: BertForPreTraining supports exact "
+            "('gelu') or tanh ('gelu_new') GELU only")
+    pet = getattr(hf_config, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise NotImplementedError(
+            f"position_embedding_type={pet!r}: only learned absolute "
+            "positions are expressed by BertForPreTraining")
+    if getattr(hf_config, "is_decoder", False):
+        raise NotImplementedError(
+            "is_decoder=True (cross-attention BERT) has no analog here")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        hidden_dropout=hf_config.hidden_dropout_prob,
+        attention_dropout=hf_config.attention_probs_dropout_prob,
+        layernorm_eps=hf_config.layer_norm_eps,
+        gelu_approximate=(act == "gelu_new"),
+        dtype=jnp.float32,
+    )
+
+
+def bert_params_from_hf(state_dict, cfg) -> dict:
+    """Convert a ``BertForPreTraining.state_dict()`` into the
+    ``BertForPreTraining`` (ours) param tree. Our BERT stores (in, out)
+    activation-major weights (``x @ W``), so every HF (out, in) linear
+    transposes; q/k/v fuse column-wise into ``qkv_weight`` ``[Q|K|V]``.
+    The tied MLM decoder weight and its alias bias are ignorable."""
+    consumed = set()
+
+    def t(name, transpose=False):
+        return _fetch(state_dict, consumed, name, transpose)
+
+    params = {
+        "word_embeddings": t("bert.embeddings.word_embeddings.weight"),
+        "position_embeddings": t("bert.embeddings.position_embeddings.weight"),
+        "token_type_embeddings": t("bert.embeddings.token_type_embeddings.weight"),
+        "embedding_norm": {"weight": t("bert.embeddings.LayerNorm.weight"),
+                           "bias": t("bert.embeddings.LayerNorm.bias")},
+        "pooler_weight": t("bert.pooler.dense.weight", transpose=True),
+        "pooler_bias": t("bert.pooler.dense.bias"),
+        "mlm_dense_weight": t("cls.predictions.transform.dense.weight",
+                              transpose=True),
+        "mlm_dense_bias": t("cls.predictions.transform.dense.bias"),
+        "mlm_norm": {
+            "weight": t("cls.predictions.transform.LayerNorm.weight"),
+            "bias": t("cls.predictions.transform.LayerNorm.bias")},
+        "mlm_output_bias": t("cls.predictions.bias"),
+        "nsp_weight": t("cls.seq_relationship.weight", transpose=True),
+        "nsp_bias": t("cls.seq_relationship.bias"),
+    }
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        params[f"layer_{i}"] = {
+            "attention": {
+                "qkv_weight": jnp.concatenate(
+                    [t(p + "attention.self.query.weight", transpose=True),
+                     t(p + "attention.self.key.weight", transpose=True),
+                     t(p + "attention.self.value.weight", transpose=True)],
+                    axis=1),
+                "qkv_bias": jnp.concatenate(
+                    [t(p + "attention.self.query.bias"),
+                     t(p + "attention.self.key.bias"),
+                     t(p + "attention.self.value.bias")]),
+                "out_weight": t(p + "attention.output.dense.weight",
+                                transpose=True),
+                "out_bias": t(p + "attention.output.dense.bias"),
+            },
+            "attention_norm": {
+                "weight": t(p + "attention.output.LayerNorm.weight"),
+                "bias": t(p + "attention.output.LayerNorm.bias")},
+            "mlp_weight1": t(p + "intermediate.dense.weight",
+                             transpose=True),
+            "mlp_bias1": t(p + "intermediate.dense.bias"),
+            "mlp_weight2": t(p + "output.dense.weight", transpose=True),
+            "mlp_bias2": t(p + "output.dense.bias"),
+            "mlp_norm": {"weight": t(p + "output.LayerNorm.weight"),
+                         "bias": t(p + "output.LayerNorm.bias")},
+        }
+    ignorable = {k for k in state_dict
+                 if k == "cls.predictions.decoder.weight"   # tied to wte
+                 or k == "cls.predictions.decoder.bias"     # alias of .bias
+                 or k.endswith("position_ids")}
+    leftover = set(state_dict) - consumed - ignorable
+    if leftover:
+        raise ValueError(
+            f"unconsumed checkpoint tensors: {sorted(leftover)[:8]}")
+    return params

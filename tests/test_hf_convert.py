@@ -137,3 +137,45 @@ def test_gpt2_logits_match_transformers(rng):
     ours = np.asarray(GPTModel(cfg).apply(
         {"params": params}, jnp.asarray(ids, jnp.int32)))
     np.testing.assert_allclose(ours, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_bert_logits_match_transformers(rng):
+    """The bench FLAGSHIP cross-checked: BertForPreTraining (ours) vs
+    transformers' — MLM and NSP logits from the same converted weights
+    (exact-GELU checkpoint => gelu_approximate=False)."""
+    from transformers import BertConfig as HFBertConfig, BertForPreTraining
+
+    from apex_tpu.models import BertForPreTraining as OurBert
+    from apex_tpu.models.hf_convert import (bert_config_from_hf,
+                                            bert_params_from_hf)
+
+    hf_cfg = HFBertConfig(vocab_size=512, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=256,
+                          max_position_embeddings=128, type_vocab_size=2,
+                          attn_implementation="eager")
+    torch.manual_seed(4)
+    hf = BertForPreTraining(hf_cfg).eval()
+    cfg = bert_config_from_hf(hf_cfg)
+    assert not cfg.gelu_approximate  # HF default hidden_act='gelu' (erf)
+    params = bert_params_from_hf(hf.state_dict(), cfg)
+
+    ids = rng.integers(0, hf_cfg.vocab_size, (2, 24))
+    tt = rng.integers(0, 2, (2, 24))
+    mask = np.ones((2, 24), np.int32)
+    mask[:, -5:] = 0  # padded tail: key masking must agree too
+    with torch.no_grad():
+        out = hf(torch.from_numpy(ids),
+                 attention_mask=torch.from_numpy(mask),
+                 token_type_ids=torch.from_numpy(tt))
+    mlm, nsp = OurBert(cfg).apply(
+        {"params": params}, jnp.asarray(ids, jnp.int32),
+        jnp.asarray(tt, jnp.int32), jnp.asarray(mask, jnp.int32))
+    valid = mask[:, :, None].astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(mlm)[valid[..., 0]],
+        out.prediction_logits.numpy()[valid[..., 0]],
+        rtol=3e-4, atol=3e-4)
+    np.testing.assert_allclose(np.asarray(nsp),
+                               out.seq_relationship_logits.numpy(),
+                               rtol=3e-4, atol=3e-4)
