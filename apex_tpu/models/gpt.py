@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
-from apex_tpu.mesh import CONTEXT_AXIS, DATA_AXIS, MODEL_AXIS
+from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import flash_attention, ring_attention
 from apex_tpu.transformer.tensor_parallel import (

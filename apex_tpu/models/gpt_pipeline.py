@@ -20,7 +20,6 @@ the ``.sum(0)`` over shared grads inside ``merge_pipeline_grads`` does this.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Tuple
 
@@ -34,7 +33,6 @@ from apex_tpu.models.gpt import GPTConfig, GPTModel, ParallelDecoderBlock
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer.tensor_parallel import (
     VocabParallelEmbedding,
-    vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.tensor_parallel.mappings import axis_is_bound
 from apex_tpu.transformer.utils import divide
