@@ -140,13 +140,24 @@ def collect_sown_aux(intermediates) -> jnp.ndarray:
     return total
 
 
-def slice_expert_shards(params, e_local: int, axis_name: str = DATA_AXIS):
+def slice_expert_shards(params, e_local: int, axis_name: str = DATA_AXIS,
+                        tensor_world_size: int = 1):
     """Per-rank view of a FULL-expert-stack param tree: inside shard_map,
     dynamic-slice every MoE expert leaf (``moe_mlp``'s w1/b1/w2/b2) down to
     this rank's ``e_local`` experts; all other leaves pass through. The
     slice's transpose scatters grads back to the right expert rows, so a
     host-side full tree + ``pmean`` over ``axis_name`` is an exact
-    data+expert-parallel step (see examples/moe/train_moe_ep.py)."""
+    data+expert-parallel step (see examples/moe/train_moe_ep.py).
+
+    Expert-TP (``MoEMLP.tensor_world_size > 1``) is NOT composed here:
+    slicing the FFN dim needs the activation layout ([gate|up] fused for
+    swiglu) — pass ``tensor_world_size`` so the mismatch fails loud."""
+    if tensor_world_size != 1:
+        raise NotImplementedError(
+            "slice_expert_shards emits full-FFN expert shards; expert "
+            "tensor parallelism needs activation-aware FFN slicing (see "
+            "tests/test_moe.py::test_expert_tensor_parallel_... for the "
+            "manual layout)")
 
     def f(path, leaf):
         names = [str(getattr(k, "key", k)) for k in path]
@@ -183,6 +194,13 @@ class MoEMLP(nn.Module):
     params_dtype: jnp.dtype = jnp.float32
     expert_world_size: Optional[int] = None   # default: axis size if bound
     axis_name: str = DATA_AXIS
+    # expert TENSOR parallelism (opt-in — default keeps experts replicated
+    # across the model axis, the GPT/Llama block behavior): each (ep, tp)
+    # rank holds (E/ep) experts with their FFN dim split tp ways; the w2
+    # partial sums psum over ``tensor_parallel_axis`` (RowParallel
+    # convention, bias added after the reduction)
+    tensor_world_size: int = 1
+    tensor_parallel_axis: str = "model"
 
     def _world(self) -> int:
         if self.expert_world_size is not None:
@@ -209,6 +227,19 @@ class MoEMLP(nn.Module):
                 f"expert_world_size={ep} != size of bound axis "
                 f"'{self.axis_name}' ({lax.axis_size(self.axis_name)})")
         e_local = divide(self.num_experts, ep)
+        tw = self.tensor_world_size
+        if tw > 1 and not axis_is_bound(self.tensor_parallel_axis):
+            raise RuntimeError(
+                f"tensor_world_size={tw} but axis "
+                f"'{self.tensor_parallel_axis}' is not bound")
+        if tw > 1 and tw != lax.axis_size(self.tensor_parallel_axis):
+            # a mismatch would psum the wrong number of partials --
+            # silently wrong output, not a shape error
+            raise RuntimeError(
+                f"tensor_world_size={tw} != size of bound axis "
+                f"'{self.tensor_parallel_axis}' "
+                f"({lax.axis_size(self.tensor_parallel_axis)})")
+        ff_local = divide(self.ffn_hidden_size, tw)
         dt = resolve_compute_dtype(x.dtype)
 
         probs, logits = TopKRouter(self.num_experts,
@@ -232,11 +263,14 @@ class MoEMLP(nn.Module):
         # --- local experts: one batched einsum over the expert dim
         init = nn.initializers.lecun_normal()
 
-        def shard_init(base):
+        def shard_init(base, fold_tensor=True):
             def f(key, shape, dtype):
                 if axis_is_bound(self.axis_name):
                     key = jax.random.fold_in(
                         key, lax.axis_index(self.axis_name))
+                if fold_tensor and tw > 1:
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.tensor_parallel_axis))
                 return base(key, shape, dtype)
             return f
 
@@ -246,12 +280,13 @@ class MoEMLP(nn.Module):
         swiglu = self.activation == "swiglu"
         # swiglu experts fuse gate+up in w1 (same [gate|up] layout as the
         # Llama block's gate_up_proj) and are BIAS-FREE like Mixtral's
-        # w1/w3/w2 — no extra tensors vs the upstream expert format
-        w1_cols = (2 if swiglu else 1) * self.ffn_hidden_size
+        # w1/w3/w2 — no extra tensors vs the upstream expert format.
+        # Under expert-TP the local layout is [gate_r | up_r].
+        w1_cols = (2 if swiglu else 1) * ff_local
         w1 = self.param("w1", shard_init(init),
                         (e_local, d, w1_cols), self.params_dtype)
         w2 = self.param("w2", shard_init(init),
-                        (e_local, self.ffn_hidden_size, d), self.params_dtype)
+                        (e_local, ff_local, d), self.params_dtype)
         h = jnp.einsum("ecd,edf->ecf", xd, w1.astype(dt))
         if swiglu:
             gate, up = jnp.split(h, 2, axis=-1)
@@ -261,8 +296,16 @@ class MoEMLP(nn.Module):
                             (e_local, w1_cols), self.params_dtype)
             h = nn.gelu(h + b1[:, None].astype(dt))
         yd = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt))
+        if tw > 1:
+            # RowParallel reduction over the experts' split FFN dim
+            yd = lax.psum(yd, self.tensor_parallel_axis)
         if not swiglu:
-            b2 = self.param("b2", shard_init(nn.initializers.zeros),
+            # b2 is REPLICATED across the tensor axis (added once to the
+            # post-psum replicated output) — fold only the expert axis so
+            # tp replicas stay identical
+            b2 = self.param("b2",
+                            shard_init(nn.initializers.zeros,
+                                       fold_tensor=False),
                             (e_local, d), self.params_dtype)
             yd = yd + b2[:, None].astype(dt)
 

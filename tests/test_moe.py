@@ -238,3 +238,83 @@ def test_moe_under_gspmd_jit_sharded_experts(rng):
     np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                rtol=2e-4, atol=2e-4)
     assert np.isfinite(float(aux))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("activation", ["gelu", "swiglu"])
+def test_expert_tensor_parallel_matches_single_rank(rng, activation):
+    """EP x expert-TP: (data=2, model=2) mesh — experts split over data AND
+    their FFN dim over model (w2 partials psum'd) == the single-rank
+    full-expert module."""
+    from apex_tpu.transformer.moe import MoEMLP
+
+    d, ff, e, k = 8, 16, 4, 2
+    ep, tp = 2, 2
+    t_per = 8
+    t = t_per * ep
+    cf = _ample_capacity(e, k)
+    ffl = ff // tp
+    e_loc = e // ep
+
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+    single = MoEMLP(hidden_size=d, ffn_hidden_size=ff, num_experts=e, k=k,
+                    capacity_factor=cf, activation=activation,
+                    expert_world_size=1, axis_name="nope")
+    v = single.init(jax.random.PRNGKey(2), x)
+    y_ref, _ = single.apply(v, x)
+    p = v["params"]
+
+    # slice: expert rows over ep; FFN cols over tp ([gate_r|up_r] for swiglu)
+    def w1_slice(er, tr):
+        w = np.asarray(p["w1"])[er * e_loc:(er + 1) * e_loc]
+        if activation == "swiglu":
+            gate, up = w[..., :ff], w[..., ff:]
+            return np.concatenate([gate[..., tr * ffl:(tr + 1) * ffl],
+                                   up[..., tr * ffl:(tr + 1) * ffl]], -1)
+        return w[..., tr * ffl:(tr + 1) * ffl]
+
+    def w2_slice(er, tr):
+        return np.asarray(p["w2"])[er * e_loc:(er + 1) * e_loc,
+                                   tr * ffl:(tr + 1) * ffl]
+
+    stacked = {
+        "w1": np.stack([[w1_slice(er, tr) for tr in range(tp)]
+                        for er in range(ep)]),
+        "w2": np.stack([[w2_slice(er, tr) for tr in range(tp)]
+                        for er in range(ep)]),
+    }
+    if activation == "gelu":
+        b1 = np.asarray(p["b1"])
+        stacked["b1"] = np.stack(
+            [[b1[er * e_loc:(er + 1) * e_loc, tr * ffl:(tr + 1) * ffl]
+              for tr in range(tp)] for er in range(ep)])
+        # b2 replicated over tp (added after the psum)
+        stacked["b2"] = np.stack(
+            [[np.asarray(p["b2"])[er * e_loc:(er + 1) * e_loc]
+              for _ in range(tp)] for er in range(ep)])
+
+    par = MoEMLP(hidden_size=d, ffn_hidden_size=ff, num_experts=e, k=k,
+                 capacity_factor=cf, activation=activation,
+                 expert_world_size=ep, axis_name="data",
+                 tensor_world_size=tp, tensor_parallel_axis="model")
+
+    from jax.sharding import Mesh
+    devs = jax.devices()[:ep * tp]
+    mesh = Mesh(np.asarray(devs).reshape(ep, 1, 1, tp),
+                ("data", "stage", "context", "model"))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("data"), P("data", "model"), P()),
+        out_specs=P("data"), check_vma=False)
+    def run(xx, ws, rw):
+        variables = {"params": dict(
+            {"router": {"weight": rw}},
+            **{kk: ws[kk][0, 0] for kk in ws})}
+        y, _ = par.apply(variables, xx)
+        return y
+
+    ws = {kk: jnp.asarray(vv) for kk, vv in stacked.items()}
+    y_par = run(x, ws, p["router"]["weight"])
+    np.testing.assert_allclose(np.asarray(y_par), np.asarray(y_ref),
+                               rtol=2e-4, atol=2e-4)
