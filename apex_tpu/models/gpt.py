@@ -59,8 +59,9 @@ class GPTConfig:
     # same explicit opt-in discipline as context_parallel: it asserts the
     # caller runs inside shard_map with tokens SHARDED over ``data`` so the
     # experts can shard over that axis (ep = data axis size). Experts are
-    # replicated across TP ranks (each model rank runs the identical MoE —
-    # redundant but consistent; expert-TP composition is a future extension).
+    # replicated across TP ranks by default (each model rank runs the
+    # identical MoE — redundant but consistent); MoEMLP's opt-in
+    # tensor_world_size shards the experts' FFN dim over ``model``.
     num_experts: int = 0
     moe_layer_freq: int = 2          # every Nth block (1 = all blocks)
     moe_k: int = 2
