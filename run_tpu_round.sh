@@ -6,8 +6,19 @@
 # replaces a previous one if it reached a pytest summary. Usage:
 #   bash run_tpu_round.sh [round_tag]   # e.g. r03
 set -u
-TAG="${1:-r03}"
+TAG="${1:-r04}"
 cd "$(dirname "$0")"
+
+bench_done() {
+  BENCH_FILE="BENCH_${TAG}.json.local" python - <<'EOF'
+import json, os, sys
+try:
+    with open(os.environ["BENCH_FILE"]) as f:
+        sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
 
 PROBE_ERR="probe_${TAG}.stderr"
 probe() {
@@ -29,12 +40,19 @@ if [ "$ok" != 1 ]; then
 fi
 rm -f "$PROBE_ERR"
 
-echo "[$(date +%H:%M:%S)] benchmark (bench.py retries init+compile itself)..."
-timeout 5400 python bench.py 2> "bench_${TAG}.stderr.log" | tee "BENCH_${TAG}.json.local"
-tail -3 "bench_${TAG}.stderr.log"
+if bench_done; then
+  echo "[$(date +%H:%M:%S)] bench already nonzero for ${TAG}; skipping to suite"
+else
+  echo "[$(date +%H:%M:%S)] benchmark (bench.py retries init+compile itself)..."
+  timeout 5400 python bench.py 2> "bench_${TAG}.stderr.log" | tee "BENCH_${TAG}.json.local"
+  tail -3 "bench_${TAG}.stderr.log"
+fi
 
 echo "[$(date +%H:%M:%S)] on-chip kernel suite (Mosaic compile of every Pallas kernel)..."
-APEX_TPU_REAL=1 timeout 3600 python -m pytest tests/test_real_tpu_kernels.py -v \
+# APEX_TPU_TAG: conftest appends one JSON line per finished test to
+# TPU_TESTS_${TAG}.jsonl — a 30-second tunnel window banks whatever ran
+APEX_TPU_REAL=1 APEX_TPU_TAG="$TAG" timeout 3600 \
+  python -m pytest tests/test_real_tpu_kernels.py -v \
   2>&1 | tee "TPU_TESTS_${TAG}.log.tmp" | tail -8
 # any completed pytest summary (passed/failed/errors/skipped/no tests)
 # replaces the previous log; only a TRUNCATED run (timeout mid-suite, no

@@ -33,6 +33,27 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# On-chip suite ordered by information value (VERDICT r3 next-round #1c):
+# never-run-post-fix kernels first, long-compiling full train step last, so a
+# short tunnel window proves the most. Names not listed keep collection order
+# after the listed ones.
+_ONCHIP_PRIORITY = [
+    "test_fused_optimizer_kernels_bert_large_size",  # held the 86 GB bug
+    "test_flash_attention_tight_head_dim",
+    "test_group_norm_backward_kernel_path",
+    "test_group_norm_kernel_path",
+    "test_flash_attention_sliding_window",
+    "test_moe_dense_dispatch_compiles",
+    "test_flash_attention_with_lse_on_chip",
+    "test_scaled_masked_softmax_seq512",
+    "test_layer_norm_fwd_bwd_bench_shapes",
+    "test_flash_attention_fwd_bwd_seq512",
+    "test_flash_attention_causal_and_dropout_compile",
+    "test_xentropy_vocab30528",
+    "test_bert_large_single_train_step",  # 15+ min compile — always last
+]
+
+
 def pytest_collection_modifyitems(config, items):
     """Two-tier suite: anything not marked ``slow`` is the smoke tier, so
     both ``-m smoke`` and ``-m "not slow"`` select the <2-min fast set
@@ -40,6 +61,49 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" not in item.keywords:
             item.add_marker(pytest.mark.smoke)
+    if REAL_TPU:
+        rank = {n: i for i, n in enumerate(_ONCHIP_PRIORITY)}
+        items.sort(key=lambda it: rank.get(it.name.split("[")[0],
+                                           len(_ONCHIP_PRIORITY)))
+
+
+def pytest_runtest_logreport(report):
+    """Per-test artifact checkpointing for the on-chip suite (VERDICT r3
+    weak #3): append one JSON line the moment a test finishes, so a tunnel
+    window that dies mid-suite still banks every completed test."""
+    if not REAL_TPU:
+        return
+    if report.when != "call" and not (report.when == "setup"
+                                      and report.outcome != "passed"):
+        return
+    import json
+    import subprocess
+    import time
+
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        try:
+            _GIT_SHA = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:
+            _GIT_SHA = "unknown"
+    tag = os.environ.get("APEX_TPU_TAG", "session")
+    line = {
+        "test": report.nodeid.split("::")[-1],
+        "outcome": report.outcome,
+        "when": report.when,
+        "duration_s": round(report.duration, 1),
+        "sha": _GIT_SHA,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    with open(f"TPU_TESTS_{tag}.jsonl", "a") as f:
+        f.write(json.dumps(line) + "\n")
+        f.flush()
+
+
+_GIT_SHA = None
 
 
 @pytest.fixture(autouse=True)

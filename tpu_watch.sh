@@ -3,19 +3,25 @@
 # may return at any time). Stops after a successful bench artifact or when
 # the deadline (seconds, default 8h) passes.
 set -u
-TAG="${1:-r03}"
+TAG="${1:-r04}"
 DEADLINE="${2:-28800}"
 START=$(date +%s)
 cd "$(dirname "$0")"
 bench_ok() {
-  python - <<'EOF'
-import json, sys
+  BENCH_FILE="BENCH_${TAG}.json.local" python - <<'EOF'
+import json, os, sys
 try:
-    with open("BENCH_r03.json.local") as f:
+    with open(os.environ["BENCH_FILE"]) as f:
         sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
 except Exception:
     sys.exit(1)
 EOF
+}
+suite_ok() {
+  # complete run with zero failures (a truncated run keeps no summary line)
+  tail -3 "TPU_TESTS_${TAG}.log" 2>/dev/null \
+    | grep -qE "[0-9]+ passed" \
+    && ! tail -3 "TPU_TESTS_${TAG}.log" | grep -qE "[0-9]+ (failed|error)"
 }
 
 while true; do
@@ -23,9 +29,13 @@ while true; do
   if [ $((now - START)) -ge "$DEADLINE" ]; then
     echo "[watch] deadline reached"; exit 1
   fi
-  if bench_ok; then echo "[watch] bench nonzero; done"; exit 0; fi
-  bash run_tpu_round.sh "$TAG" && {
-    echo "[watch] TPU round completed"; exit 0; }
+  if bench_ok && suite_ok; then
+    echo "[watch] bench nonzero AND suite clean; done"; exit 0
+  fi
+  bash run_tpu_round.sh "$TAG"
+  if bench_ok && suite_ok; then
+    echo "[watch] TPU round completed with both artifacts"; exit 0
+  fi
   # each attempt already spends ~15 min probing; short gap keeps the duty
   # cycle high against a tunnel that comes back on minute timescales
   sleep 240
