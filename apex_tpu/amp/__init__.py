@@ -49,7 +49,8 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
                keep_batchnorm_fp32=None, master_weights=None, loss_scale=None,
                cast_model_outputs=None, num_losses=1, verbosity=1,
                min_loss_scale=1.0, max_loss_scale=2.0 ** 24,
-               half_dtype=jnp.bfloat16, keep_fp32_predicate=None):
+               half_dtype=jnp.bfloat16, keep_fp32_predicate=None,
+               hysteresis=1):
     """Reference: apex/amp/frontend.py:initialize (same signature shape;
     torch-only knobs like patch_torch_functions are accepted and ignored).
 
@@ -94,7 +95,7 @@ def initialize(models, optimizers=None, enabled=True, opt_level="O1",
 
     _loss_scalers = [
         LossScaler(policy.loss_scale, min_loss_scale=min_loss_scale,
-                   max_loss_scale=max_loss_scale)
+                   max_loss_scale=max_loss_scale, hysteresis=hysteresis)
         for _ in range(num_losses)
     ]
     _combine_cache.clear()
@@ -206,7 +207,7 @@ def unscale_and_combine(grads_list, loss_ids=None):
     # contribute these same statics — states ride in as arguments), and the
     # cache stays bounded by distinct configurations
     statics = tuple((s._scale_factor, s._scale_window, s._min_scale,
-                     s._max_scale) for s in scalers)
+                     s._max_scale, s._hysteresis) for s in scalers)
     key = (ids, str(treedef), statics)
     if key not in _combine_cache:
         def _pure(g_list, states):

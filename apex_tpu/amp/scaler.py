@@ -23,27 +23,43 @@ class ScalerState(NamedTuple):
     scale: jax.Array            # f32 scalar
     growth_tracker: jax.Array   # i32 scalar — clean steps since last growth
     dynamic: jax.Array          # f32 0/1 flag (static per scaler, kept for pytree)
+    hysteresis_tracker: jax.Array  # i32 scalar — overflows left before a halve
 
 
 class LossScaler:
-    """API mirror of apex/amp/scaler.py:LossScaler."""
+    """API mirror of apex/amp/scaler.py:LossScaler.
+
+    ``hysteresis`` (reference: csrc/update_scale_hysteresis.cu, consumed by
+    DistributedFusedAdam): tolerate that many overflow steps before halving
+    the scale — the tracker decrements on overflow, the scale halves only
+    once it reaches zero, and the tracker refills ONLY when the scale grows
+    after ``scale_window`` clean steps (the .cu kernel resets it inside the
+    growth branch, so intermittent overflows accumulate rather than being
+    forgiven by the next clean step). The default of 1 is the classic
+    halve-on-every-overflow behavior.
+    """
 
     def __init__(self, loss_scale: Union[float, str] = 1.0,
                  init_scale: float = 2.0 ** 16,
                  scale_factor: float = 2.0,
                  scale_window: int = 2000,
                  min_loss_scale: float = 1.0,
-                 max_loss_scale: float = 2.0 ** 24):
+                 max_loss_scale: float = 2.0 ** 24,
+                 hysteresis: int = 1):
         self.dynamic = loss_scale == "dynamic"
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._min_scale = min_loss_scale
         self._max_scale = max_loss_scale  # reference default cap (frontend.py)
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+        self._hysteresis = hysteresis
         init = init_scale if self.dynamic else float(loss_scale)
         self.state = ScalerState(
             scale=jnp.asarray(init, jnp.float32),
             growth_tracker=jnp.zeros((), jnp.int32),
             dynamic=jnp.asarray(1.0 if self.dynamic else 0.0, jnp.float32),
+            hysteresis_tracker=jnp.asarray(hysteresis, jnp.int32),
         )
 
     def loss_scale(self) -> jax.Array:
@@ -53,30 +69,42 @@ class LossScaler:
         return loss * self.state.scale.astype(loss.dtype)
 
     def update(self, state: ScalerState, found_inf) -> ScalerState:
-        """Pure update (traceable): halve on overflow, double after
-        scale_window clean steps, clamped to [min, max] (reference
-        update_scale semantics incl. the 2**24 cap). Branches on the traced
-        ``state.dynamic`` flag, so a checkpoint restore that flips dynamic
-        does not require re-tracing callers."""
+        """Pure update (traceable): on overflow decrement the hysteresis
+        tracker and halve only once it reaches zero; double after
+        scale_window clean steps (which also reset the hysteresis tracker),
+        clamped to [min, max] (reference update_scale semantics incl. the
+        2**24 cap and update_scale_hysteresis.cu's tolerance counter).
+        Branches on the traced ``state.dynamic`` flag, so a checkpoint
+        restore that flips dynamic does not require re-tracing callers."""
         found = found_inf.astype(jnp.bool_)
-        new_scale = jnp.where(found, state.scale / self._scale_factor, state.scale)
+        hyst = jnp.where(found,
+                         jnp.maximum(state.hysteresis_tracker - 1, 0),
+                         state.hysteresis_tracker)
+        halve = found & (hyst <= 0)
+        new_scale = jnp.where(halve, state.scale / self._scale_factor,
+                              state.scale)
         tracker = jnp.where(found, 0, state.growth_tracker + 1)
         grow = tracker >= self._scale_window
         new_scale = jnp.where(grow, new_scale * self._scale_factor, new_scale)
         tracker = jnp.where(grow, 0, tracker)
+        # the .cu kernel refills the hysteresis budget only on growth
+        hyst = jnp.where(grow, jnp.asarray(self._hysteresis, jnp.int32), hyst)
         new_scale = jnp.clip(new_scale, self._min_scale, self._max_scale)
         is_dyn = state.dynamic > 0.0
         return ScalerState(
             scale=jnp.where(is_dyn, new_scale, state.scale),
             growth_tracker=jnp.where(is_dyn, tracker, state.growth_tracker),
             dynamic=state.dynamic,
+            hysteresis_tracker=jnp.where(is_dyn, hyst,
+                                         state.hysteresis_tracker),
         )
 
     # -- checkpointing (reference: amp.state_dict saves loss scalers) ---------
     def state_dict(self):
         return {"scale": self.state.scale,
                 "growth_tracker": self.state.growth_tracker,
-                "dynamic": self.dynamic}
+                "dynamic": self.dynamic,
+                "hysteresis_tracker": self.state.hysteresis_tracker}
 
     def load_state_dict(self, sd):
         self.dynamic = bool(sd["dynamic"])
@@ -84,4 +112,7 @@ class LossScaler:
             scale=jnp.asarray(sd["scale"], jnp.float32),
             growth_tracker=jnp.asarray(sd["growth_tracker"], jnp.int32),
             dynamic=jnp.asarray(1.0 if self.dynamic else 0.0, jnp.float32),
+            # pre-hysteresis checkpoints restore to a full tracker
+            hysteresis_tracker=jnp.asarray(
+                sd.get("hysteresis_tracker", self._hysteresis), jnp.int32),
         )

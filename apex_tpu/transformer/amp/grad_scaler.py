@@ -44,7 +44,7 @@ class GradScaler(LossScaler):
 
     def __init__(self, init_scale: float = 2.0 ** 16, growth_factor: float = 2.0,
                  backoff_factor: float = 0.5, growth_interval: int = 2000,
-                 enabled: bool = True):
+                 enabled: bool = True, hysteresis: int = 1):
         if growth_factor != 1.0 / backoff_factor:
             # the flat LossScaler uses one factor both ways; the reference's
             # defaults (2.0, 0.5) satisfy this
@@ -52,7 +52,7 @@ class GradScaler(LossScaler):
                 "GradScaler requires growth_factor == 1/backoff_factor")
         super().__init__(loss_scale="dynamic" if enabled else 1.0,
                          init_scale=init_scale, scale_factor=growth_factor,
-                         scale_window=growth_interval)
+                         scale_window=growth_interval, hysteresis=hysteresis)
 
     def update(self, state: ScalerState, found_inf) -> ScalerState:
         return super().update(state, agree_found_inf(found_inf))

@@ -1,0 +1,13 @@
+"""Exit 0 iff the given bench artifact exists and has value > 0.
+
+Shared predicate for run_tpu_round.sh / tpu_watch.sh — the single place
+that knows what a 'done' bench artifact looks like.
+"""
+import json
+import sys
+
+try:
+    with open(sys.argv[1]) as f:
+        sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
+except Exception:
+    sys.exit(1)

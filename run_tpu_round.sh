@@ -9,16 +9,7 @@ set -u
 TAG="${1:-r04}"
 cd "$(dirname "$0")"
 
-bench_done() {
-  BENCH_FILE="BENCH_${TAG}.json.local" python - <<'EOF'
-import json, os, sys
-try:
-    with open(os.environ["BENCH_FILE"]) as f:
-        sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
-except Exception:
-    sys.exit(1)
-EOF
-}
+bench_done() { python bench_ok.py "BENCH_${TAG}.json.local"; }
 
 PROBE_ERR="probe_${TAG}.stderr"
 probe() {

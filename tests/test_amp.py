@@ -144,6 +144,43 @@ def test_dynamic_scaler_growth():
     assert float(st.scale) == 4.0
 
 
+def test_scaler_hysteresis():
+    """Reference: csrc/update_scale_hysteresis.cu — tolerate hysteresis-1
+    overflows before halving; the budget refills only when the scale grows
+    (the .cu kernel resets the tracker inside the growth branch), so
+    intermittent overflows accumulate; continued overflow past zero keeps
+    halving every overflowing step."""
+    from apex_tpu.amp.scaler import LossScaler
+
+    s = LossScaler("dynamic", init_scale=16.0, hysteresis=2, scale_window=2)
+    st = s.state
+    inf, zero = jnp.ones(()), jnp.zeros(())
+
+    st = s.update(st, inf)            # 1st overflow: tolerated
+    assert float(st.scale) == 16.0 and int(st.hysteresis_tracker) == 1
+    st = s.update(st, inf)            # 2nd: budget hits 0 -> halves
+    assert float(st.scale) == 8.0
+    st = s.update(st, inf)            # still overflowing: halves again
+    assert float(st.scale) == 4.0
+
+    st = s.update(st, zero)           # clean step does NOT refill
+    assert int(st.hysteresis_tracker) == 0
+    st = s.update(st, inf)            # intermittent overflow still halves
+    assert float(st.scale) == 2.0
+
+    st = s.update(st, zero)           # two clean steps -> growth fires
+    st = s.update(st, zero)
+    assert float(st.scale) == 4.0
+    assert int(st.hysteresis_tracker) == 2   # budget refilled on growth
+    st = s.update(st, inf)            # tolerated again
+    assert float(st.scale) == 4.0 and int(st.hysteresis_tracker) == 1
+
+    # default hysteresis=1 is the classic halve-on-every-overflow
+    s1 = LossScaler("dynamic", init_scale=16.0)
+    st1 = s1.update(s1.state, inf)
+    assert float(st1.scale) == 8.0
+
+
 def test_amp_state_dict_roundtrip():
     p = {"w": jnp.ones((2, 2))}
     opt = FusedAdam(p, lr=1e-3)

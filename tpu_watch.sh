@@ -7,16 +7,7 @@ TAG="${1:-r04}"
 DEADLINE="${2:-28800}"
 START=$(date +%s)
 cd "$(dirname "$0")"
-bench_ok() {
-  BENCH_FILE="BENCH_${TAG}.json.local" python - <<'EOF'
-import json, os, sys
-try:
-    with open(os.environ["BENCH_FILE"]) as f:
-        sys.exit(0 if json.load(f).get("value", 0) > 0 else 1)
-except Exception:
-    sys.exit(1)
-EOF
-}
+bench_ok() { python bench_ok.py "BENCH_${TAG}.json.local"; }
 suite_ok() {
   # complete run with zero failures (a truncated run keeps no summary line)
   tail -3 "TPU_TESTS_${TAG}.log" 2>/dev/null \
