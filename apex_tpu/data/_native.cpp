@@ -154,11 +154,16 @@ PyObject* loader_next(PyObject*, PyObject* args) {
   {
     // release the GIL while waiting on the prefetch thread
     Py_BEGIN_ALLOW_THREADS
-    std::unique_lock<std::mutex> lk(ld->mu);
-    ld->cv.wait(lk, [&] { return ld->has_ready; });
-    out.swap(ld->ready);
-    ld->has_ready = false;
-    ld->cv.notify_all();
+    {
+      // inner scope: the loader mutex must drop BEFORE the GIL is
+      // reacquired — the capsule destructor (GIL held) joins a worker
+      // that needs this mutex, so holding both orders would deadlock
+      std::unique_lock<std::mutex> lk(ld->mu);
+      ld->cv.wait(lk, [&] { return ld->has_ready; });
+      out.swap(ld->ready);
+      ld->has_ready = false;
+      ld->cv.notify_all();
+    }
     Py_END_ALLOW_THREADS
   }
   // hand back as a bytearray: numpy's frombuffer view of it is WRITABLE

@@ -314,7 +314,10 @@ class MoEMLP(nn.Module):
             yd = lax.all_to_all(yd, self.axis_name, split_axis=1,
                                 concat_axis=0, tiled=True)
 
-        # --- combine: fp32 gates, fp32 accumulation
+        # --- combine: gates cast to the compute dtype (bf16 under bf16
+        # models — the MXU truncates f32 operands to bf16 at default matmul
+        # precision anyway, so keeping them f32 would only buy an HBM-sized
+        # upcast of yd, not precision); the ACCUMULATION is fp32
         y = jnp.einsum("tec,ecd->td", combine.astype(dt), yd,
                        preferred_element_type=jnp.float32)
         y = y.astype(x.dtype).reshape(orig_shape)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.mesh import STAGE_AXIS
+from apex_tpu.transformer.log_util import get_transformer_logger
 from apex_tpu.transformer.pipeline_parallel import p2p_communication as p2p
 from apex_tpu.transformer.tensor_parallel.mappings import (
     axis_is_bound,
@@ -169,7 +170,16 @@ def _use_explicit_schedule(stage_fn, params_for_probe, first_fn, loss_fn,
 
     try:
         jaxpr = jax.make_jaxpr(jax.grad(full_step))(params_for_probe)
-    except Exception:  # noqa: BLE001 — fail toward the deadlock-free path
+    except Exception as e:  # noqa: BLE001 — fail toward the deadlock-free path
+        # Correct failure direction (autodiff cannot deadlock), but a probe
+        # that crashes for an unrelated stage bug must not downgrade memory
+        # silently: the same error usually resurfaces when the schedule
+        # itself traces, and if it doesn't, this is the only signal.
+        get_transformer_logger(__name__).warning(
+            "1F1B dispatch probe failed (%s: %s); falling back to the "
+            "uniform autodiff schedule, which holds all M microbatch "
+            "activations live (O(M) memory) instead of O(S).",
+            type(e).__name__, e)
         return False
     return not _jaxpr_has_ppermute(jaxpr.jaxpr)
 
@@ -663,8 +673,19 @@ def forward_backward_pipelining_with_interleaving(
 
     if forward_only:
         return mean_loss_of(chunk_params), None
-    if (implementation == "1f1b"
-            and _mb_count(microbatches) % n_stages == 0 and n_stages > 1
+    m_count = _mb_count(microbatches)
+    wants_1f1b = implementation == "1f1b" and n_stages > 1
+    divisible = m_count % n_stages == 0
+    if wants_1f1b and not divisible:
+        # the reference raises on its divisibility constraint
+        # (fwd_bwd_pipelining_with_interleaving.py); we keep training but
+        # must not degrade memory/bubble silently
+        get_transformer_logger(__name__).warning(
+            "interleaved 1F1B needs num_microbatches %% pipeline_size == 0 "
+            "(got M=%d, S=%d); falling back to the autodiff schedule "
+            "(O(V*M) activation memory and a larger bubble).",
+            m_count, n_stages)
+    if (wants_1f1b and divisible
             and _use_explicit_schedule(
                 stage_fn, jax.tree.map(lambda t: t[0], chunk_params),
                 first_fn, loss_fn, loss_aux, loss_with_params,
