@@ -24,7 +24,8 @@ from jax import lax
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.normalization import FusedLayerNorm
-from apex_tpu.ops import flash_attention, ring_attention
+from apex_tpu.ops import (flash_attention, ring_attention,
+                          ring_attention_zigzag)
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -53,6 +54,10 @@ class GPTConfig:
     # sequence over it (a replicated sequence under a cp>1 mesh would get
     # wrong position offsets and double-counted ring keys)
     context_parallel: bool = False
+    # zigzag CP layout (causal load balancing) — caller feeds ids/labels
+    # zigzag-permuted along the sequence (ops/ring_attention.py to_zigzag);
+    # position embeddings follow the layout automatically
+    context_parallel_zigzag: bool = False
     # --- mixture-of-experts (beyond reference) -------------------------------
     # num_experts > 0 turns every ``moe_layer_freq``-th block's MLP into a
     # routed MoEMLP (apex_tpu.transformer.moe). ``expert_parallel`` is the
@@ -134,8 +139,13 @@ class ParallelDecoderBlock(nn.Module):
         # over ``context``, K/V ring-rotate between devices instead of any
         # device materializing the full sequence (ops/ring_attention.py)
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
-            ctx = ring_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                                 axis_name=CONTEXT_AXIS, causal=True)
+            if cfg.context_parallel_zigzag:
+                ctx = ring_attention_zigzag(
+                    to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                    axis_name=CONTEXT_AXIS)
+            else:
+                ctx = ring_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                     axis_name=CONTEXT_AXIS, causal=True)
         else:
             ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
                                   causal=True)
@@ -185,7 +195,8 @@ class GPTModel(nn.Module):
                          cfg.param_dtype)
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             # sequence sharded over ``context``: local chunk i covers global
-            # positions [i*s, (i+1)*s)
+            # positions [i*s, (i+1)*s) (or, zigzag, the two half-chunk
+            # ranges i and 2cp-1-i)
             cp = lax.axis_size(CONTEXT_AXIS)
             if cp * s > cfg.max_position_embeddings:
                 # dynamic_slice would CLAMP an out-of-range start and
@@ -193,8 +204,17 @@ class GPTModel(nn.Module):
                 raise ValueError(
                     f"global sequence cp*s = {cp}*{s} exceeds "
                     f"max_position_embeddings={cfg.max_position_embeddings}")
-            off = lax.axis_index(CONTEXT_AXIS) * s
-            pos_s = lax.dynamic_slice_in_dim(pos, off, s)
+            i = lax.axis_index(CONTEXT_AXIS)
+            if cfg.context_parallel_zigzag:
+                if s % 2:
+                    raise ValueError("zigzag CP needs an even local sequence")
+                s_h = s // 2
+                pos_s = jnp.concatenate([
+                    lax.dynamic_slice_in_dim(pos, i * s_h, s_h),
+                    lax.dynamic_slice_in_dim(
+                        pos, (2 * cp - 1 - i) * s_h, s_h)])
+            else:
+                pos_s = lax.dynamic_slice_in_dim(pos, i * s, s)
         else:
             pos_s = pos[:s]
         x = (x + pos_s[None, :, :]).astype(dt)

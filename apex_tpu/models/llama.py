@@ -28,7 +28,8 @@ from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
-from apex_tpu.ops import flash_attention, ring_attention
+from apex_tpu.ops import (flash_attention, ring_attention,
+                          ring_attention_zigzag)
 from apex_tpu.transformer.functional.fused_rope import (
     fused_apply_rotary_pos_emb_cached,
 )
@@ -58,6 +59,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     tensor_parallel_size: int = 1
     context_parallel: bool = False       # same opt-in as GPTConfig
+    # zigzag CP layout (causal load balancing): each device holds one early
+    # + one late half-chunk (ops/ring_attention.py to_zigzag); the CALLER
+    # feeds input_ids/labels already zigzag-permuted along the sequence.
+    # RoPE positions and attention follow the layout automatically.
+    context_parallel_zigzag: bool = False
     tie_word_embeddings: bool = False
     # Mistral-style sliding-window attention: band-restricted in the flash
     # kernel (O(S*window) compute+DMA); under context_parallel the ring is
@@ -157,10 +163,16 @@ class LlamaDecoderBlock(nn.Module):
         divide(h_local, kv_local)
 
         if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
-            # window-aware ring: statically shortened to the chunks the
-            # band reaches (ops/ring_attention.py)
-            ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS,
-                                 causal=True, window=cfg.sliding_window)
+            if cfg.context_parallel_zigzag:
+                # causal load-balanced layout; windows compose via the
+                # static/dynamic-offset banding (ops/ring_attention.py)
+                ctx = ring_attention_zigzag(q, k, v, axis_name=CONTEXT_AXIS,
+                                            window=cfg.sliding_window)
+            else:
+                # window-aware ring: statically shortened to the chunks the
+                # band reaches (ops/ring_attention.py)
+                ctx = ring_attention(q, k, v, axis_name=CONTEXT_AXIS,
+                                     causal=True, window=cfg.sliding_window)
         else:
             ctx = flash_attention(q, k, v, causal=True,
                                   window=cfg.sliding_window)
@@ -213,19 +225,28 @@ class LlamaModel(nn.Module):
             params_dtype=cfg.param_dtype, name="embed_tokens")
         x = emb(input_ids).astype(dt)
 
-        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
-            cp = lax.axis_size(CONTEXT_AXIS)
-            offset = lax.axis_index(CONTEXT_AXIS) * s
-        else:
-            cp = 1
-            offset = 0
+        cp = (lax.axis_size(CONTEXT_AXIS)
+              if cfg.context_parallel and _axis_bound(CONTEXT_AXIS) else 1)
         if cp * s > cfg.max_position_embeddings:
             # RoPE would silently extrapolate past the trained range;
             # enforce uniformly (CP and single-device alike)
             raise ValueError(
                 f"global sequence cp*s = {cp}*{s} exceeds "
                 f"max_position_embeddings={cfg.max_position_embeddings}")
-        cos_, sin_ = _rope_cos_sin(cfg, s, offset)
+        if cp > 1 and cfg.context_parallel_zigzag:
+            # zigzag slice = global chunks (i, 2cp-1-i): RoPE positions
+            # follow the layout, one table per half-chunk
+            if s % 2:
+                raise ValueError("zigzag CP needs an even local sequence")
+            s_h = s // 2
+            i = lax.axis_index(CONTEXT_AXIS)
+            cos_e, sin_e = _rope_cos_sin(cfg, s_h, i * s_h)
+            cos_l, sin_l = _rope_cos_sin(cfg, s_h, (2 * cp - 1 - i) * s_h)
+            cos_ = jnp.concatenate([cos_e, cos_l], axis=0)
+            sin_ = jnp.concatenate([sin_e, sin_l], axis=0)
+        else:
+            offset = lax.axis_index(CONTEXT_AXIS) * s if cp > 1 else 0
+            cos_, sin_ = _rope_cos_sin(cfg, s, offset)
 
         block_cls = nn.remat(LlamaDecoderBlock) if cfg.remat \
             else LlamaDecoderBlock

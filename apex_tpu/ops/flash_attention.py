@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -43,7 +44,36 @@ _INTERPRET = _dispatch.interpret
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_sizes(sq: int, sk: int, block_q: Optional[int], block_k: Optional[int]):
+_BLOCK_TABLE = None
+
+
+def _block_table():
+    """Autotuned (sq, sk, d, dtype) -> (block_q, block_k) winners, measured
+    on-chip by tpu_autotune.py and committed as _flash_block_table.json
+    next to this file. Missing file / missing key -> heuristic default."""
+    global _BLOCK_TABLE
+    if _BLOCK_TABLE is None:
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(__file__),
+                            "_flash_block_table.json")
+        try:
+            with open(path) as f:
+                _BLOCK_TABLE = {k: tuple(v) for k, v in json.load(f).items()}
+        except Exception:
+            _BLOCK_TABLE = {}
+    return _BLOCK_TABLE
+
+
+def _block_sizes(sq: int, sk: int, block_q: Optional[int],
+                 block_k: Optional[int], d: Optional[int] = None,
+                 dtype=None):
+    if block_q is None and block_k is None and d is not None:
+        hit = _block_table().get(f"{sq},{sk},{d},{jnp.dtype(dtype).name}")
+        if hit:
+            return (min(hit[0], _dispatch.round_up(sq, 8)),
+                    min(hit[1], _dispatch.round_up(sk, 128)))
     bq = block_q or min(128, _dispatch.round_up(sq, 8))
     bk = block_k or min(128, _dispatch.round_up(sk, 128))
     return bq, bk
@@ -200,13 +230,20 @@ def _block_live(i_g, j_g, *, bq, bk, nq, nk, causal, causal_offset, window):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
-                o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                off_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 scale, causal, causal_offset, q_len, kv_len, bq, bk, nk,
-                nq, dropout_rate, window=None):
+                nq, dropout_rate, window=None, banded=True):
     b, h, i, j = (pl.program_id(d) for d in range(4))
-    # under a window the j grid spans only the band; recover global ids
+    # a DYNAMIC offset (ring steps whose upstream distance depends on the
+    # device index — zigzag CP) arrives as an SMEM scalar; the band-grid
+    # restriction needs a static offset, so dynamic callers run unbanded
+    # (``banded=False``) and dead blocks are skipped by ``block_live``
+    off = off_ref[0, 0] if off_ref is not None else causal_offset
+    # under a (static-offset) window the j grid spans only the band;
+    # recover global ids
     i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
-                                 causal_offset=causal_offset, window=window,
+                                 causal_offset=causal_offset,
+                                 window=window if banded else None,
                                  band_over="k")
 
     @pl.when(j == 0)
@@ -216,7 +253,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
-                             causal=causal, causal_offset=causal_offset,
+                             causal=causal, causal_offset=off,
                              window=window)
 
     @pl.when(block_live)
@@ -230,7 +267,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
             s += bias_ref[0, 0].astype(jnp.float32)
         s, live = _mask_block(
             s, b_q=i_g, b_k=j_g, bq=bq, bk=bk, q_len=q_len, kv_len=kv_len,
-            causal=causal, causal_offset=causal_offset,
+            causal=causal, causal_offset=off,
             q_seg=qseg_ref[0] if qseg_ref is not None else None,
             kv_seg=kseg_ref[0] if kseg_ref is not None else None,
             window=window,
@@ -244,7 +281,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             p = p * _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
-                                  bh, i_g * bq, j_g * bk)
+                                  bh, i_g * bq + seed_ref[0, 1],
+                                  j_g * bk + seed_ref[0, 2])
         v = v_ref[0, 0]
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -270,11 +308,12 @@ def _gqa_rep(heads: int, kv_heads: int) -> int:
 
 
 def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
-            block_q, block_k, window=None, causal_offset=None):
+            block_q, block_k, window=None, causal_offset=None,
+            dyn_offset=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     rep = _gqa_rep(heads, k.shape[1])
-    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
+    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k, d, q.dtype)
     d_pad = _head_pad(d)
 
     qp = _pad_to(_pad_to(q, 2, bq), 3, d_pad)
@@ -282,15 +321,19 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
     vp = _pad_to(_pad_to(v, 2, bk), 3, d_pad)
     sq_p, sk_p = qp.shape[2], kp.shape[2]
     nq, nk = sq_p // bq, sk_p // bk
-    if causal_offset is None:
+    banded = window is not None and dyn_offset is None
+    if dyn_offset is None and causal_offset is None:
         causal_offset = kv_len - q_len   # cross-attention diagonal default
 
     # band-restricted k grid under a window: dead blocks don't exist, so
-    # windowed attention is O(S*window) in DMA as well as FLOPs
-    nk_grid = (nk if window is None
-               else _band_width_blocks(bq + window - 1, bk, nk))
+    # windowed attention is O(S*window) in DMA as well as FLOPs. A DYNAMIC
+    # offset cannot position the band statically: full grid, with dead
+    # blocks skipped (FLOPs saved, DMA not) by the kernel's block_live.
+    nk_grid = (_band_width_blocks(bq + window - 1, bk, nk) if banded
+               else nk)
     jmap = _band_index_map(bq=bq, bk=bk, n_limit=nk,
-                           causal_offset=causal_offset, window=window,
+                           causal_offset=causal_offset,
+                           window=window if banded else None,
                            band_over="k")
 
     in_specs = [
@@ -329,9 +372,13 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
             memory_space=pltpu.VMEM))
         args.extend([qsp[:, None], ksp[:, None]])
     if dropout_rate > 0.0:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+        in_specs.append(pl.BlockSpec((1, 3), lambda b, h, i, j: (0, 0),
                                      memory_space=pltpu.SMEM))
         args.append(seed)
+    if dyn_offset is not None:
+        in_specs.append(pl.BlockSpec((1, 1), lambda b, h, i, j: (0, 0),
+                                     memory_space=pltpu.SMEM))
+        args.append(dyn_offset.astype(jnp.int32).reshape(1, 1))
 
     def fn(*refs):
         it = iter(refs)
@@ -340,13 +387,14 @@ def _fa_fwd(q, k, v, bias, q_seg, kv_seg, seed, scale, causal, dropout_rate,
         qseg_ref = next(it) if q_seg is not None else None
         kseg_ref = next(it) if q_seg is not None else None
         seed_ref = next(it) if dropout_rate > 0.0 else None
+        off_ref = next(it) if dyn_offset is not None else None
         o_ref, lse_ref = next(it), next(it)
         acc_ref, m_ref, l_ref = next(it), next(it), next(it)
         _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, seed_ref,
-                    o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                    off_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                     scale=scale, causal=causal, causal_offset=causal_offset,
                     q_len=q_len, kv_len=kv_len, bq=bq, bk=bk, nk=nk, nq=nq,
-                    dropout_rate=dropout_rate, window=window)
+                    dropout_rate=dropout_rate, window=window, banded=banded)
 
     o, lse = _dispatch.pallas_call(
         fn,
@@ -400,12 +448,15 @@ def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref, *,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               bias_ref, qseg_ref, kseg_ref, seed_ref, dq_ref, dq_acc, *,
+               bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref,
+               dq_ref, dq_acc, *,
                scale, causal, causal_offset, kv_len, bq, bk, nk, nq,
-               dropout_rate, window=None):
+               dropout_rate, window=None, banded=True):
     b, h, i, j = (pl.program_id(d) for d in range(4))
+    off = off_ref[0, 0] if off_ref is not None else causal_offset
     i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
-                                 causal_offset=causal_offset, window=window,
+                                 causal_offset=causal_offset,
+                                 window=window if banded else None,
                                  band_over="k")
 
     @pl.when(j == 0)
@@ -413,14 +464,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
-                             causal=causal, causal_offset=causal_offset,
+                             causal=causal, causal_offset=off,
                              window=window)
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
-                         causal_offset=causal_offset, kv_len=kv_len,
+                         causal_offset=off, kv_len=kv_len,
                          bq=bq, bk=bk, b_q=i_g, b_k=j_g, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
@@ -429,7 +480,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             dp = dp * _dropout_keep(dp.shape, dropout_rate, seed_ref[0, 0],
-                                    bh, i_g * bq, j_g * bk)
+                                    bh, i_g * bq + seed_ref[0, 1],
+                                    j_g * bk + seed_ref[0, 2])
         ds = p * (dp - delta_ref[0, 0].reshape(-1, 1)) * scale
         k = k_ref[0, 0]
         dq_acc[...] += jax.lax.dot_general(
@@ -442,14 +494,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                 bias_ref, qseg_ref, kseg_ref, seed_ref, dk_ref, dv_ref,
-                 dk_acc, dv_acc, *,
+                 bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc, *,
                  scale, causal, causal_offset, kv_len, bq, bk, nq, nk,
-                 dropout_rate, window=None):
+                 dropout_rate, window=None, banded=True):
     # NOTE grid order: (b, h, j over k-blocks, i over q-blocks)
     b, h, j, i = (pl.program_id(d) for d in range(4))
+    off = off_ref[0, 0] if off_ref is not None else causal_offset
     i_g, j_g = _global_block_ids(i, j, bq=bq, bk=bk,
-                                 causal_offset=causal_offset, window=window,
+                                 causal_offset=causal_offset,
+                                 window=window if banded else None,
                                  band_over="q")
 
     @pl.when(i == 0)
@@ -458,21 +512,22 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     block_live = _block_live(i_g, j_g, bq=bq, bk=bk, nq=nq, nk=nk,
-                             causal=causal, causal_offset=causal_offset,
+                             causal=causal, causal_offset=off,
                              window=window)
 
     @pl.when(block_live)
     def _body():
         p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, qseg_ref, kseg_ref,
                          scale=scale, causal=causal,
-                         causal_offset=causal_offset, kv_len=kv_len,
+                         causal_offset=off, kv_len=kv_len,
                          bq=bq, bk=bk, b_q=i_g, b_k=j_g, window=window)
         do = do_ref[0, 0]
         v = v_ref[0, 0]
         if dropout_rate > 0.0:
             bh = b * pl.num_programs(1) + h
             keep = _dropout_keep(p.shape, dropout_rate, seed_ref[0, 0],
-                                 bh, i_g * bq, j_g * bk)
+                                 bh, i_g * bq + seed_ref[0, 1],
+                                 j_g * bk + seed_ref[0, 2])
             p_dropped = p * keep
         else:
             keep = None
@@ -498,12 +553,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                  dropout_rate, block_q, block_k, o, lse, do,
-                 delta_adjust=None, window=None, causal_offset=None):
+                 delta_adjust=None, window=None, causal_offset=None,
+                 dyn_offset=None):
     batch, heads, q_len, d = q.shape
     kv_len = k.shape[2]
     kv_heads = k.shape[1]
     rep = _gqa_rep(heads, kv_heads)
-    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k)
+    bq, bk = _block_sizes(q_len, kv_len, block_q, block_k, d, q.dtype)
     d_pad = _head_pad(d)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -522,19 +578,22 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
                    constant_values=jnp.inf)[..., None]
     deltap = _pad_to(delta, 2, bq)[..., None]
     nq, nk = sq_p // bq, sk_p // bk
-    if causal_offset is None:
+    banded = window is not None and dyn_offset is None
+    if dyn_offset is None and causal_offset is None:
         causal_offset = kv_len - q_len
 
-    if window is None:
-        nkg_dq, nig_dkdv = nk, nq
-    else:
+    if banded:
         nkg_dq = _band_width_blocks(bq + window - 1, bk, nk)
         nig_dkdv = _band_width_blocks(bk + window - 1, bq, nq)
+    else:
+        nkg_dq, nig_dkdv = nk, nq
     jmap_dq = _band_index_map(bq=bq, bk=bk, n_limit=nk,
-                              causal_offset=causal_offset, window=window,
+                              causal_offset=causal_offset,
+                              window=window if banded else None,
                               band_over="k")
     _imap = _band_index_map(bq=bq, bk=bk, n_limit=nq,
-                            causal_offset=causal_offset, window=window,
+                            causal_offset=causal_offset,
+                            window=window if banded else None,
                             band_over="q")
 
     def imap_dkdv(j, i):
@@ -555,6 +614,8 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         base_args.extend([qsp[:, None], ksp[:, None]])
     if dropout_rate > 0.0:
         base_args.append(seed)
+    if dyn_offset is not None:
+        base_args.append(dyn_offset.astype(jnp.int32).reshape(1, 1))
 
     def make_specs(idx_q, idx_k):
         """Index maps for one kernel given q-block/k-block extractors."""
@@ -586,6 +647,9 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
             specs.append(pl.BlockSpec((1, 1, bk), lambda *g: (g[0], 0, idx_k(g)),
                                       memory_space=pltpu.VMEM))
         if dropout_rate > 0.0:
+            specs.append(pl.BlockSpec((1, 3), lambda *g: (0, 0),
+                                      memory_space=pltpu.SMEM))
+        if dyn_offset is not None:
             specs.append(pl.BlockSpec((1, 1), lambda *g: (0, 0),
                                       memory_space=pltpu.SMEM))
         return specs
@@ -597,19 +661,21 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
         qseg_ref = next(it) if q_seg is not None else None
         kseg_ref = next(it) if q_seg is not None else None
         seed_ref = next(it) if dropout_rate > 0.0 else None
+        off_ref = next(it) if dyn_offset is not None else None
         outs = [next(it) for _ in range(n_out)]
         scratch = list(it)
-        return ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch
+        return ins, bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref, \
+            outs, scratch
 
     # ---- dq ----
     def dq_fn(*refs):
-        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch = \
-            split_refs(refs, 1)
-        _dq_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
+        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref, outs, \
+            scratch = split_refs(refs, 1)
+        _dq_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref,
                    outs[0], scratch[0],
                    scale=scale, causal=causal, causal_offset=causal_offset,
                    kv_len=kv_len, bq=bq, bk=bk, nk=nk, nq=nq,
-                   dropout_rate=dropout_rate, window=window)
+                   dropout_rate=dropout_rate, window=window, banded=banded)
 
     dq = _dispatch.pallas_call(
         dq_fn,
@@ -628,13 +694,13 @@ def _fa_bwd_impl(q, k, v, bias, q_seg, kv_seg, seed, scale, causal,
 
     # ---- dk, dv ----
     def dkdv_fn(*refs):
-        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, outs, scratch = \
-            split_refs(refs, 2)
-        _dkdv_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref,
+        ins, bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref, outs, \
+            scratch = split_refs(refs, 2)
+        _dkdv_kernel(*ins, bias_ref, qseg_ref, kseg_ref, seed_ref, off_ref,
                      outs[0], outs[1], scratch[0], scratch[1],
                      scale=scale, causal=causal, causal_offset=causal_offset,
                      kv_len=kv_len, bq=bq, bk=bk, nq=nq, nk=nk,
-                     dropout_rate=dropout_rate, window=window)
+                     dropout_rate=dropout_rate, window=window, banded=banded)
 
     dk, dv = _dispatch.pallas_call(
         dkdv_fn,
@@ -706,36 +772,44 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, block_k, window,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_with_lse(q, k, v, scale, causal, block_q, block_k, window,
-                    causal_offset):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_with_lse(q, k, v, dyn_off, drop_meta, scale, causal, block_q,
+                    block_k, window, causal_offset, dropout_rate):
     """(o, lse) variant for blockwise/ring composition: callers that merge
     partial attention results (ring attention over a context-sharded
     sequence) need the per-row logsumexp, and its cotangent folds into the
     backward's delta correction (see _fa_bwd_impl.delta_adjust).
-    ``causal_offset`` overrides the cross-attention diagonal — a ring step
-    attending an upstream chunk passes the global row offset so causal /
-    window masking applies at GLOBAL positions."""
-    return _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
-                   block_q, block_k, window, causal_offset)
+    ``causal_offset``/``dyn_off`` override the cross-attention diagonal — a
+    ring step attending an upstream chunk passes the global row offset so
+    causal / window masking applies at GLOBAL positions; ``dyn_off`` is the
+    TRACED (1, 1) i32 variant for offsets that depend on the device index
+    (zigzag CP). ``drop_meta`` is a (1, 3) i32 [seed, global_row0,
+    global_col0] so a CP-sharded sequence regenerates the exact
+    single-device keep mask."""
+    return _fa_fwd(q, k, v, None, None, None, drop_meta, scale, causal,
+                   dropout_rate, block_q, block_k, window, causal_offset,
+                   dyn_off)
 
 
-def _flash_with_lse_fwd(q, k, v, scale, causal, block_q, block_k, window,
-                        causal_offset):
-    o, lse = _fa_fwd(q, k, v, None, None, None, None, scale, causal, 0.0,
-                     block_q, block_k, window, causal_offset)
-    return (o, lse), (q, k, v, o, lse)
+def _flash_with_lse_fwd(q, k, v, dyn_off, drop_meta, scale, causal, block_q,
+                        block_k, window, causal_offset, dropout_rate):
+    o, lse = _fa_fwd(q, k, v, None, None, None, drop_meta, scale, causal,
+                     dropout_rate, block_q, block_k, window, causal_offset,
+                     dyn_off)
+    return (o, lse), (q, k, v, dyn_off, drop_meta, o, lse)
 
 
 def _flash_with_lse_bwd(scale, causal, block_q, block_k, window,
-                        causal_offset, res, cts):
-    q, k, v, o, lse = res
+                        causal_offset, dropout_rate, res, cts):
+    q, k, v, dyn_off, drop_meta, o, lse = res
     do, dlse = cts
-    dq, dk, dv = _fa_bwd_impl(q, k, v, None, None, None, None, scale,
-                              causal, 0.0, block_q, block_k, o, lse, do,
+    dq, dk, dv = _fa_bwd_impl(q, k, v, None, None, None, drop_meta, scale,
+                              causal, dropout_rate, block_q, block_k,
+                              o, lse, do,
                               delta_adjust=-dlse.astype(jnp.float32),
-                              window=window, causal_offset=causal_offset)
-    return dq, dk, dv
+                              window=window, causal_offset=causal_offset,
+                              dyn_offset=dyn_off)
+    return dq, dk, dv, None, None
 
 
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
@@ -746,20 +820,51 @@ def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
                              window: Optional[int] = None,
-                             causal_offset: Optional[int] = None):
+                             causal_offset=None,
+                             dropout_rate: float = 0.0,
+                             dropout_seed=0,
+                             dropout_row0=0,
+                             dropout_col0=0):
     """Flash attention returning ``(o, lse)`` — the building block for
     ring/blockwise attention (apex_tpu/ops/ring_attention.py). Fully
-    differentiable including through the lse. ``window``/``causal_offset``
-    let a ring step apply GLOBAL-position causal+window masking to an
-    upstream chunk (window requires causal)."""
+    differentiable including through the lse.
+
+    ``window``/``causal_offset`` let a ring step apply GLOBAL-position
+    causal+window masking to an upstream chunk (window requires causal).
+    ``causal_offset`` may be a traced value (device-index-dependent
+    offsets, zigzag CP): the kernel then masks via an SMEM scalar and the
+    static band-grid restriction is disabled (dead blocks still skip their
+    FLOPs via the liveness predicate).
+
+    ``dropout_rate``/``dropout_seed`` with ``dropout_row0``/``dropout_col0``
+    (global positions of this chunk's first q row / k col, traced OK) make
+    the counter-based keep mask a function of GLOBAL coordinates — a ring
+    of chunked calls reproduces exactly the mask one unsharded call draws,
+    so CP attention dropout matches single-device (reference:
+    multihead_attn's fused softmax-dropout under sequence sharding)."""
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
     d = q.shape[-1]
     scale = (1.0 / (d ** 0.5)) if scale is None else scale
+    if causal_offset is None or isinstance(causal_offset, (int, np.integer)):
+        dyn = None
+        static_off = None if causal_offset is None else int(causal_offset)
+    else:
+        dyn = jnp.asarray(causal_offset, jnp.int32).reshape(1, 1)
+        static_off = None
+    meta = None
+    if dropout_rate > 0.0:
+        meta = jnp.stack([
+            jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+            jnp.asarray(dropout_row0, jnp.int32).reshape(()),
+            jnp.asarray(dropout_col0, jnp.int32).reshape(()),
+        ]).reshape(1, 3)
     return _flash_with_lse(
-        q, k, v, float(scale), causal, block_q, block_k,
-        None if window is None else int(window),
-        None if causal_offset is None else int(causal_offset))
+        q, k, v, dyn, meta, float(scale), causal, block_q, block_k,
+        None if window is None else int(window), static_off,
+        float(dropout_rate))
 
 
 def flash_attention(
@@ -820,9 +925,13 @@ def flash_attention(
                              "sliding window over a causal sequence)")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-    # seed is a *traced* (1,1) SMEM scalar so jitted training steps can vary
-    # it per step without recompiling (unlike a static-arg seed)
-    seed = (jnp.asarray(dropout_seed, jnp.int32).reshape(1, 1)
+    # seed is a *traced* (1,3) SMEM scalar row [seed, row0, col0] so jitted
+    # training steps can vary it per step without recompiling (unlike a
+    # static-arg seed); row0/col0 are the global-position bases (0 here —
+    # ring callers offset them per chunk via flash_attention_with_lse)
+    seed = (jnp.stack([jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+                       jnp.zeros((), jnp.int32),
+                       jnp.zeros((), jnp.int32)]).reshape(1, 3)
             if dropout_rate > 0.0 else None)
     return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
                   float(scale), bool(causal), float(dropout_rate),

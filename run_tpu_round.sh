@@ -55,4 +55,18 @@ if tail -3 "TPU_TESTS_${TAG}.log.tmp" \
 else
   echo "[$(date +%H:%M:%S)] suite truncated; keeping previous log (tmp retained)"
 fi
+# post-suite window harvest (best-effort, each time-bounded; skipped once
+# their artifact exists so retry loops don't redo finished work)
+if [ ! -f "apex_tpu/ops/_flash_block_table.json" ]; then
+  echo "[$(date +%H:%M:%S)] flash block-size autotune..."
+  timeout 3600 python tpu_autotune.py \
+    > "AUTOTUNE_${TAG}.json.local" 2> "autotune_${TAG}.stderr.log" || true
+  tail -2 "autotune_${TAG}.stderr.log"
+fi
+if [ ! -f "PROFILE_${TAG}.json" ]; then
+  echo "[$(date +%H:%M:%S)] profiler trace + overlap check..."
+  APEX_TPU_TAG="$TAG" timeout 3600 python tpu_profile.py \
+    2> "profile_${TAG}.stderr.log" || true
+  tail -2 "profile_${TAG}.stderr.log"
+fi
 echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"

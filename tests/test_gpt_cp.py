@@ -28,8 +28,10 @@ def cp4_mesh():
         1, 1, context_parallel_size_=4)
 
 
-def test_gpt_cp_loss_and_grads_match_single_device(cp4_mesh, rng):
-    cfg = gpt_tiny_config(context_parallel=True)
+@pytest.mark.parametrize("layout", ["ring", "zigzag"])
+def test_gpt_cp_loss_and_grads_match_single_device(cp4_mesh, rng, layout):
+    cfg = gpt_tiny_config(context_parallel=True,
+                          context_parallel_zigzag=layout == "zigzag")
     model = GPTModel(cfg)
     b, s = 2, 64
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, s)), jnp.int32)
@@ -38,6 +40,16 @@ def test_gpt_cp_loss_and_grads_match_single_device(cp4_mesh, rng):
 
     def ref_loss(p):
         return gpt_loss(model, {"params": p}, ids, labels)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+
+    if layout == "zigzag":
+        # the model consumes the zigzag-permuted sequence (position
+        # embeddings follow); the mean loss is permutation-invariant
+        from apex_tpu.ops import to_zigzag
+
+        ids = to_zigzag(ids, 4, axis=1)
+        labels = to_zigzag(labels, 4, axis=1)
 
     seq_sh = P(None, CONTEXT_AXIS)
 
@@ -50,7 +62,6 @@ def test_gpt_cp_loss_and_grads_match_single_device(cp4_mesh, rng):
     def cp_loss(p):
         return cp_forward(p, ids, labels)
 
-    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
     cp_l, cp_g = jax.value_and_grad(cp_loss)(params)
 
     np.testing.assert_allclose(float(cp_l), float(ref_l), rtol=2e-6)

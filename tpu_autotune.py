@@ -1,0 +1,172 @@
+"""On-chip flash-attention block-size autotune (VERDICT r4 ask #2).
+
+Sweeps (block_q, block_k) for the flagship attention shapes (BERT-Large:
+b=8, h=16, s=512, d=64 bf16; GPT/Llama long-seq variants) timing one
+fwd+bwd step per candidate, and — when run on a real TPU — writes the
+winners to ``apex_tpu/ops/_flash_block_table.json``, which
+``flash_attention._block_sizes`` consults at trace time. Also times the
+tight-head-dim layout (``APEX_TPU_FLASH_TIGHT_HEADDIM=1``) against the
+128-padded default at the winning block config (child subprocesses, since
+the flag is read at import).
+
+Run inside a healthy tunnel window (run_tpu_round.sh invokes it after the
+kernel suite):
+    python tpu_autotune.py            # full sweep + table write
+    python tpu_autotune.py --child --shape 8,16,512,64 --tight 0 \
+        --candidates "128,128;256,128" # one timing subprocess (internal)
+
+Prints one summary JSON line to stdout at the end; diagnostics to stderr.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+TABLE_PATH = os.path.join(REPO, "apex_tpu", "ops", "_flash_block_table.json")
+
+# flagship shapes (batch, heads, seq, head_dim) — BERT-Large attention is
+# the bench gate; 1024/2048 cover GPT/Llama blocks at the same head dim
+SHAPES = [(8, 16, 512, 64), (4, 16, 1024, 64), (2, 16, 2048, 64)]
+CANDS = [(bq, bk) for bq in (128, 256, 512) for bk in (128, 256, 512)]
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _child(shape, tight, candidates):
+    """Time fwd+bwd for each (bq, bk) at one shape; print a JSON line."""
+    if tight:
+        os.environ["APEX_TPU_FLASH_TIGHT_HEADDIM"] = "1"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops import flash_attention
+
+    b, h, s, d = shape
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+
+    results = {}
+    for bq, bk in candidates:
+        if bq > s or bk > s:
+            continue
+
+        def loss(q, k, v, bq=bq, bk=bk):
+            o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            out = step(q, k, v)
+            jax.block_until_ready(out)
+        except Exception as e:  # noqa: BLE001 — illegal layout for this chip
+            log(f"  ({bq},{bk}) failed: {type(e).__name__}: {str(e)[:120]}")
+            continue
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = step(q, k, v)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / 10
+        results[f"{bq},{bk}"] = dt * 1e3
+        log(f"  ({bq},{bk}) {dt*1e3:.3f} ms")
+    dev = jax.devices()[0]
+    print(json.dumps({"shape": list(shape), "tight": tight,
+                      "platform": dev.platform,
+                      "device_kind": dev.device_kind, "ms": results}))
+
+
+def _run_child(shape, tight, candidates, timeout=1500):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--shape", ",".join(map(str, shape)), "--tight", str(int(tight)),
+           "--candidates", ";".join(f"{a},{b}" for a, b in candidates)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       cwd=REPO)
+    sys.stderr.write(r.stderr[-2000:])
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError(f"child produced no JSON (rc={r.returncode})")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--shape", type=str, default="")
+    ap.add_argument("--tight", type=int, default=0)
+    ap.add_argument("--candidates", type=str, default="")
+    args = ap.parse_args()
+
+    if args.child:
+        shape = tuple(int(x) for x in args.shape.split(","))
+        cands = [tuple(int(x) for x in c.split(","))
+                 for c in args.candidates.split(";") if c]
+        _child(shape, bool(args.tight), cands)
+        return
+
+    table = {}
+    summary = {"metric": "flash_block_autotune", "shapes": {}}
+    on_tpu = False
+    for shape in SHAPES:
+        b, h, s, d = shape
+        log(f"shape b={b} h={h} s={s} d={d}:")
+        try:
+            res = _run_child(shape, tight=False, candidates=CANDS)
+        except Exception as e:  # noqa: BLE001 — tunnel died mid-sweep:
+            # bank the shapes already measured instead of losing the window
+            log(f"  shape failed ({type(e).__name__}: {str(e)[:120]}); "
+                "keeping earlier winners")
+            summary["shapes"]["x".join(map(str, shape))] = {
+                "error": f"{type(e).__name__}"}
+            continue
+        on_tpu = on_tpu or res["platform"] not in ("cpu",)
+        if not res["ms"]:
+            log("  no candidate compiled; skipping shape")
+            continue
+        best = min(res["ms"], key=res["ms"].get)
+        default_ms = res["ms"].get("128,128")
+        best_ms = res["ms"][best]
+        bq, bk = (int(x) for x in best.split(","))
+        table[f"{s},{s},{d},bfloat16"] = [bq, bk]
+        gain = (default_ms / best_ms - 1.0) * 100 if default_ms else 0.0
+        log(f"  WINNER ({bq},{bk}) {best_ms:.3f} ms "
+            f"({gain:+.1f}% vs 128,128 default)")
+        entry = {"winner": [bq, bk], "ms": res["ms"],
+                 "gain_vs_default_pct": round(gain, 1)}
+        # tight-head-dim at the winning blocks (d=64: half the MXU padding)
+        try:
+            tight_res = _run_child(shape, tight=True, candidates=[(bq, bk)])
+        except Exception as e:  # noqa: BLE001
+            log(f"  tight-head-dim timing failed ({type(e).__name__})")
+            tight_res = {"ms": {}}
+        if tight_res["ms"]:
+            tms = tight_res["ms"][best]
+            entry["tight_headdim_ms"] = tms
+            entry["tight_speedup"] = round(best_ms / tms, 3)
+            log(f"  tight-head-dim {tms:.3f} ms "
+                f"({best_ms / tms:.2f}x vs padded)")
+        summary["shapes"]["x".join(map(str, shape))] = entry
+
+    if on_tpu and table:
+        with open(TABLE_PATH, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        log(f"wrote {TABLE_PATH}")
+        summary["table_written"] = True
+    else:
+        log("not on TPU (or nothing measured); table NOT written")
+        summary["table_written"] = False
+    summary["device"] = "tpu" if on_tpu else "cpu"
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
