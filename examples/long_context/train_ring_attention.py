@@ -63,12 +63,19 @@ def make_loss_and_grad_fn(model, mesh):
 
 
 def run_training(steps: int = 8, seq_len: int = 128, cp: int = 4,
-                 verbose=print):
+                 layout: str = "ring", verbose=print):
+    """``layout='zigzag'`` uses the causal load-balanced layout: the data
+    pipeline permutes the sequence with ``to_zigzag`` (each device gets one
+    early + one late half-chunk) and the model's position embeddings follow
+    automatically (``context_parallel_zigzag``)."""
+    if layout not in ("ring", "zigzag"):
+        raise ValueError(f"layout must be 'ring' or 'zigzag', got {layout!r}")
     mesh = parallel_state.initialize_model_parallel(
         1, 1, context_parallel_size_=cp)
     dp = int(mesh.shape[DATA_AXIS])
 
     cfg = gpt_tiny_config(context_parallel=True,
+                          context_parallel_zigzag=layout == "zigzag",
                           max_position_embeddings=seq_len)
     model = GPTModel(cfg)
     rng = np.random.default_rng(0)
@@ -76,6 +83,11 @@ def run_training(steps: int = 8, seq_len: int = 128, cp: int = 4,
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq_len)),
                       jnp.int32)
     labels = jnp.roll(ids, -1, axis=1)
+    if layout == "zigzag":
+        from apex_tpu.ops import to_zigzag
+
+        ids = to_zigzag(ids, cp, axis=1)
+        labels = to_zigzag(labels, cp, axis=1)
     params = model.init(jax.random.PRNGKey(0), ids[:, : seq_len // cp])[
         "params"]
     opt = FusedAdam(params, lr=3e-3, weight_decay=0.0)
@@ -88,7 +100,7 @@ def run_training(steps: int = 8, seq_len: int = 128, cp: int = 4,
         params = opt.step(grads)
         losses.append(float(loss))
         verbose(f"step {step}: loss {losses[-1]:.4f}  "
-                f"(seq {seq_len} over cp={cp} ring)")
+                f"(seq {seq_len} over cp={cp} {layout})")
     return losses
 
 

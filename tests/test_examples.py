@@ -70,6 +70,11 @@ def test_long_context_ring_attention_trains():
     losses = lc.run_training(steps=6, seq_len=64, cp=4, verbose=_quiet)
     assert losses[-1] < losses[0], losses
 
+    # zigzag layout variant (round-4): same pipeline, load-balanced chunks
+    z_losses = lc.run_training(steps=6, seq_len=64, cp=4, layout="zigzag",
+                               verbose=_quiet)
+    assert z_losses[-1] < z_losses[0], z_losses
+
     # the in-shard_map grads (psum over context + pmean over data) must
     # equal the plain value_and_grad of the unsharded model — review r3
     # caught the example shipping partial per-chunk grads
