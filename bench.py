@@ -107,6 +107,23 @@ def probe_backend(retries: int, wait_s: float, platform, timeout_s: int):
     raise RuntimeError(f"backend init failed after {retries} attempts: {last}")
 
 
+def _enable_compile_cache(jax):
+    """Persistent compilation cache: the BERT-Large train step takes 15+ min
+    to compile through the remote-compile tunnel — caching it means a
+    healthy window after a failed one skips straight to measurement. Silent
+    no-op when the backend can't serialize executables."""
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+        log(f"compilation cache: {cache_dir}")
+    except Exception as e:  # noqa: BLE001
+        log(f"compilation cache unavailable: {e}")
+
+
 def init_backend(retries: int, wait_s: float):
     platform = os.environ.get("APEX_TPU_BENCH_PLATFORM")
     init_timeout = int(os.environ.get("APEX_TPU_BENCH_INIT_TIMEOUT", "420"))
@@ -116,6 +133,7 @@ def init_backend(retries: int, wait_s: float):
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    _enable_compile_cache(jax)
     t0 = time.perf_counter()
     devs = jax.devices()
     log(f"backend up after {time.perf_counter()-t0:.1f}s: "

@@ -11,6 +11,10 @@ cd "$(dirname "$0")"
 
 bench_done() { python bench_ok.py "BENCH_${TAG}.json.local"; }
 
+# persistent XLA compilation cache: a window that dies after the 15-min
+# BERT-Large compile still banks the executable for the next window
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
 PROBE_ERR="probe_${TAG}.stderr"
 probe() {
   timeout 130 python -c \

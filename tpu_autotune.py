@@ -34,6 +34,14 @@ SHAPES = [(8, 16, 512, 64), (4, 16, 1024, 64), (2, 16, 2048, 64)]
 CANDS = [(bq, bk) for bq in (128, 256, 512) for bk in (128, 256, 512)]
 
 
+def _enable_compile_cache():
+    import jax
+
+    import bench
+
+    bench._enable_compile_cache(jax)
+
+
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
@@ -42,6 +50,7 @@ def _child(shape, tight, candidates):
     """Time fwd+bwd for each (bq, bk) at one shape; print a JSON line."""
     if tight:
         os.environ["APEX_TPU_FLASH_TIGHT_HEADDIM"] = "1"
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -33,6 +33,14 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 
+def _enable_compile_cache():
+    import jax
+
+    import bench
+
+    bench._enable_compile_cache(jax)
+
+
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
@@ -290,6 +298,7 @@ def _zero_overlap_hlo(mesh):
 
 
 def main():
+    _enable_compile_cache()
     tag = os.environ.get("APEX_TPU_TAG", "session")
     out = {"metric": "tpu_profile", "tag": tag}
     try:
