@@ -304,12 +304,25 @@ def run_workload(devs, batch_per_chip: int, seq_len: int, steps: int):
                 device=devs[0])
 
 
+def _tuned_batch() -> int:
+    """Per-chip batch: the measured winner from run_tpu_round.sh's batch
+    escalation (bench_batch.json, committed once a window has compared
+    8/16/32), else the conservative 8 that is known to fit."""
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_batch.json")) as f:
+            return int(json.load(f)["batch_per_chip"])
+    except Exception:
+        return 8
+
+
 def main():
     retries = int(os.environ.get("APEX_TPU_BENCH_RETRIES", "4"))
     wait_s = float(os.environ.get("APEX_TPU_BENCH_RETRY_WAIT", "30"))
     devs = init_backend(retries, wait_s)
 
-    batch_per_chip = int(os.environ.get("APEX_TPU_BENCH_BATCH", "8"))
+    batch_per_chip = int(os.environ.get("APEX_TPU_BENCH_BATCH", "0")) \
+        or _tuned_batch()
     seq_len = int(os.environ.get("APEX_TPU_BENCH_SEQ", "512"))
     steps = int(os.environ.get("APEX_TPU_BENCH_STEPS", "10"))
     compile_retries = int(os.environ.get("APEX_TPU_BENCH_COMPILE_RETRIES", "5"))

@@ -73,4 +73,43 @@ if [ ! -f "PROFILE_${TAG}.json" ]; then
     2> "profile_${TAG}.stderr.log" || true
   tail -2 "profile_${TAG}.stderr.log"
 fi
+# batch escalation (one-time, only after the gate artifacts exist): MFU at
+# batch 8/chip may leave the MXU underfed — measure 16 and 32, persist the
+# winner so the driver's own plain `python bench.py` run uses it
+if bench_done && [ -f "TPU_TESTS_${TAG}.log" ] \
+    && [ ! -f "bench_batch.json" ]; then
+  for B in 16 32; do
+    echo "[$(date +%H:%M:%S)] bench at batch ${B}/chip..."
+    APEX_TPU_BENCH_BATCH=$B timeout 5400 python bench.py \
+      2> "bench_${TAG}_b${B}.stderr.log" \
+      | tee "BENCH_${TAG}_b${B}.json.local"
+  done
+  python - "$TAG" <<'EOF'
+import json, sys
+tag = sys.argv[1]
+best_b, best_v = 8, 0.0
+try:
+    with open(f"BENCH_{tag}.json.local") as f:
+        best_v = json.load(f).get("value", 0.0)
+except Exception:
+    pass
+for b in (16, 32):
+    try:
+        with open(f"BENCH_{tag}_b{b}.json.local") as f:
+            v = json.load(f).get("value", 0.0)
+    except Exception:
+        continue
+    if v > best_v:
+        best_b, best_v = b, v
+with open("bench_batch.json", "w") as f:
+    json.dump({"batch_per_chip": best_b,
+               "tokens_per_sec_per_chip": best_v}, f)
+if best_b != 8:
+    # the committed .local artifact should carry the best measurement
+    import shutil
+    shutil.copy(f"BENCH_{tag}_b{best_b}.json.local",
+                f"BENCH_{tag}.json.local")
+print(f"[batch escalation] winner: {best_b}/chip at {best_v:.0f} tok/s")
+EOF
+fi
 echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"
