@@ -74,7 +74,7 @@ class BertSelfAttention(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
+    def __call__(self, x, segment_ids, *, deterministic: bool, dropout_seed):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)  # amp O1 seam
         e, h, d = cfg.hidden_size, cfg.num_heads, cfg.head_dim
@@ -95,7 +95,7 @@ class BertSelfAttention(nn.Module):
 
         rate = 0.0 if deterministic else cfg.attention_dropout
         ctx = flash_attention(
-            to_bhsd(q), to_bhsd(k), to_bhsd(v), bias=attention_bias,
+            to_bhsd(q), to_bhsd(k), to_bhsd(v), segment_ids=segment_ids,
             dropout_rate=rate, dropout_seed=dropout_seed,
         )
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, e)
@@ -109,11 +109,11 @@ class BertLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, x, attention_bias, *, deterministic: bool, dropout_seed):
+    def __call__(self, x, segment_ids, *, deterministic: bool, dropout_seed):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         attn_out = BertSelfAttention(cfg, name="attention")(
-            x, attention_bias, deterministic=deterministic,
+            x, segment_ids, deterministic=deterministic,
             dropout_seed=dropout_seed)
         if not deterministic and cfg.hidden_dropout > 0.0:
             attn_out = nn.Dropout(cfg.hidden_dropout)(
@@ -181,13 +181,15 @@ class BertForPreTraining(nn.Module):
         if not deterministic and cfg.hidden_dropout > 0.0:
             x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=False)
 
-        # padding mask -> additive bias [B, 1, 1, S] (generic_scaled_masked_
-        # softmax analog; flash kernel adds it pre-softmax)
-        attention_bias = None
+        # padding mask -> kernel-native segment ids (reference fmha's
+        # cu_seqlens semantics: pad keys are invisible to valid queries and
+        # pad-position outputs are excluded from every loss). Cheaper than
+        # the previous additive [B, 1, S, S]-broadcast bias: the kernel
+        # loads two int rows per tile instead of a (bq, bk) f32 block, and
+        # an all-ones mask costs only the comparisons.
+        segment_ids = None
         if attention_mask is not None:
-            attention_bias = jnp.where(
-                attention_mask[:, None, None, :].astype(bool), 0.0, -1e9
-            ).astype(jnp.float32)
+            segment_ids = attention_mask.astype(jnp.int32)
 
         for i in range(cfg.num_layers):
             # decorrelate attention-dropout streams across (step, layer):
@@ -197,7 +199,7 @@ class BertForPreTraining(nn.Module):
             layer_seed = (jnp.asarray(dropout_seed, jnp.int32)
                           * jnp.int32(1000003) + i)
             x = BertLayer(cfg, name=f"layer_{i}")(
-                x, attention_bias, deterministic=deterministic,
+                x, segment_ids, deterministic=deterministic,
                 dropout_seed=layer_seed)
 
         # MLM head: dense + gelu + LN + tied decode (BertLMPredictionHead)
