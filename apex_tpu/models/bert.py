@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.amp.policy import resolve_compute_dtype
@@ -149,13 +150,21 @@ class BertForPreTraining(nn.Module):
     ``(mlm_logits [B,S,V], nsp_logits [B,2])``. The MLM decoder is tied to the
     word-embedding table (standard BERT; standalone_bert does the same via
     Megatron's tied embeddings).
+
+    ``masked_positions`` (optional, [B, K] int32): evaluate the MLM head
+    only at those K positions (returns ``mlm_logits [B,K,V]``) — the
+    reference pretraining harness's max_predictions_per_seq gather, which
+    cuts the head's dense+decode GEMMs (the 2·e·v term that rivals a full
+    encoder layer) to K/S of their all-positions cost. Pad rows with
+    position 0 and label 0 (the loss's padding_idx drops them).
     """
 
     config: BertConfig
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 *, deterministic: bool = True, dropout_seed=0):
+                 *, deterministic: bool = True, dropout_seed=0,
+                 masked_positions=None):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
@@ -209,7 +218,12 @@ class BertForPreTraining(nn.Module):
                            (cfg.hidden_size,), cfg.param_dtype)
         mlm_out_b = self.param("mlm_output_bias", nn.initializers.zeros,
                                (cfg.vocab_size,), cfg.param_dtype)
-        hmlm = jax.nn.gelu(x @ mlm_w.astype(dt) + mlm_b.astype(dt),
+        x_head = x
+        if masked_positions is not None:
+            # [B, S, e] -> [B, K, e]: only predicted positions feed the head
+            x_head = jnp.take_along_axis(
+                x, masked_positions[..., None].astype(jnp.int32), axis=1)
+        hmlm = jax.nn.gelu(x_head @ mlm_w.astype(dt) + mlm_b.astype(dt),
                            approximate=cfg.gelu_approximate)
         hmlm = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                               name="mlm_norm")(hmlm).astype(dt)
@@ -290,16 +304,31 @@ def param_partition_specs(params) -> Any:
 def synthetic_batch(rng, cfg: BertConfig, batch_size: int, seq_len: int,
                     mlm_fraction: float = 0.15) -> Dict[str, jnp.ndarray]:
     """Random pretraining batch (the benchmark uses synthetic data, like the
-    reference's tests/L1 synthetic-data mode)."""
+    reference's tests/L1 synthetic-data mode).
+
+    Emits BOTH label views of the same prediction set: the dense
+    ``mlm_labels`` [B, S] (0 = unpredicted) for all-positions heads, and
+    the reference harness's max_predictions_per_seq form —
+    ``mlm_positions`` [B, K] + ``mlm_gathered_labels`` [B, K] — which
+    ``make_pretrain_step`` feeds to the model's gathered MLM head (K ~
+    0.15*S rounded up to a lane-friendly multiple of 8)."""
     ids = rng.integers(4, cfg.vocab_size, size=(batch_size, seq_len))
-    mlm_mask = rng.random((batch_size, seq_len)) < mlm_fraction
+    k = min(seq_len, max(8, -(-int(seq_len * mlm_fraction) // 8) * 8))
+    # k distinct positions per row, vectorized (uniform without replacement)
+    positions = np.sort(
+        np.argsort(rng.random((batch_size, seq_len)), axis=1)[:, :k], axis=1)
+    gathered = np.take_along_axis(ids, positions, axis=1)
+    dense = np.zeros_like(ids)
+    np.put_along_axis(dense, positions, gathered, axis=1)
     return {
         "input_ids": jnp.asarray(ids, jnp.int32),
         "token_type_ids": jnp.asarray(
             rng.integers(0, cfg.type_vocab_size, size=(batch_size, seq_len)),
             jnp.int32),
         "attention_mask": jnp.ones((batch_size, seq_len), jnp.int32),
-        "mlm_labels": jnp.asarray(ids * mlm_mask, jnp.int32),
+        "mlm_labels": jnp.asarray(dense, jnp.int32),
+        "mlm_positions": jnp.asarray(positions, jnp.int32),
+        "mlm_gathered_labels": jnp.asarray(gathered, jnp.int32),
         "nsp_labels": jnp.asarray(
             rng.integers(0, 2, size=(batch_size,)), jnp.int32),
     }
@@ -316,15 +345,21 @@ def make_pretrain_step(model: BertForPreTraining, mesh=None,
     """
 
     def loss_fn(params, batch, seed):
+        # the gathered head (max_predictions_per_seq) when the batch carries
+        # positions: the MLM dense+decode run at K ~ 0.15*S positions
+        positions = batch.get("mlm_positions")
         mlm_logits, nsp_logits = model.apply(
             {"params": params},
             batch["input_ids"], batch["token_type_ids"],
             batch["attention_mask"],
             deterministic=False, dropout_seed=seed,
+            masked_positions=positions,
             rngs={"dropout": jax.random.fold_in(jax.random.PRNGKey(0), seed)},
         )
+        labels = (batch["mlm_gathered_labels"] if positions is not None
+                  else batch["mlm_labels"])
         return bert_pretrain_loss(mlm_logits, nsp_logits,
-                                  batch["mlm_labels"], batch["nsp_labels"])
+                                  labels, batch["nsp_labels"])
 
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -338,6 +373,11 @@ def make_pretrain_step(model: BertForPreTraining, mesh=None,
         "token_type_ids": P(DATA_AXIS, CONTEXT_AXIS),
         "attention_mask": P(DATA_AXIS, CONTEXT_AXIS),
         "mlm_labels": P(DATA_AXIS, CONTEXT_AXIS),
+        # gathered view: positions index the FULL sequence, so they stay
+        # unsharded over context (the gather crosses context shards; the
+        # mesh path only shards them over data)
+        "mlm_positions": P(DATA_AXIS),
+        "mlm_gathered_labels": P(DATA_AXIS),
         "nsp_labels": P(DATA_AXIS),
     }
     batch_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), batch_spec,

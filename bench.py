@@ -149,12 +149,17 @@ def _is_transient(e: BaseException) -> bool:
         "connection reset", "broken pipe"))
 
 
-def model_flops_per_token(cfg, seq_len: int) -> float:
-    """Matmul FLOPs per token, fwd+bwd (bwd = 2x fwd), BERT-Large shape."""
+def model_flops_per_token(cfg, seq_len: int, mlm_k: int = None) -> float:
+    """Matmul FLOPs per token, fwd+bwd (bwd = 2x fwd), BERT-Large shape.
+
+    ``mlm_k``: with the gathered MLM head (max_predictions_per_seq), the
+    dense+decode GEMMs run at K of S positions — count only that fraction
+    so MFU stays honest about the work actually done."""
     e, i, L, v = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
                   cfg.vocab_size)
     per_layer = 8 * e * e + 4 * seq_len * e + 4 * e * i
-    head = 2 * e * e + 2 * e * v
+    head_frac = 1.0 if mlm_k is None else mlm_k / seq_len
+    head = (2 * e * e + 2 * e * v) * head_frac
     return 3.0 * (L * per_layer + head)
 
 
@@ -295,7 +300,9 @@ def run_workload(devs, batch_per_chip: int, seq_len: int, steps: int):
 
     tokens = batch_size * seq_len
     tok_per_sec_chip = tokens / dt / n_chips
-    flops = model_flops_per_token(cfg, seq_len) * tokens
+    mlm_k = (batch["mlm_positions"].shape[1]
+             if "mlm_positions" in batch else None)
+    flops = model_flops_per_token(cfg, seq_len, mlm_k) * tokens
     mfu = flops / dt / (peak_flops(devs[0]) * n_chips)
     log(f"step {dt*1e3:.1f}ms  loss={float(loss):.3f}  "
         f"tokens/s/chip={tok_per_sec_chip:.0f}  MFU={mfu*100:.1f}%")

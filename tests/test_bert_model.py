@@ -44,6 +44,37 @@ def test_forward_shapes(tiny_setup):
 
 
 @pytest.mark.slow
+def test_gathered_mlm_head_matches_dense(tiny_setup):
+    """The max_predictions_per_seq head (masked_positions) must produce
+    exactly the dense head's logits at the selected positions, and the same
+    loss on the same batch — pins the take_along_axis gather the benchmark
+    path trains through."""
+    cfg, model, params, batch = tiny_setup
+    dense_mlm, nsp = model.apply({"params": params}, batch["input_ids"],
+                                 batch["token_type_ids"],
+                                 batch["attention_mask"])
+    gathered_mlm, nsp_g = model.apply(
+        {"params": params}, batch["input_ids"], batch["token_type_ids"],
+        batch["attention_mask"], masked_positions=batch["mlm_positions"])
+    k = batch["mlm_positions"].shape[1]
+    assert gathered_mlm.shape == (4, k, cfg.vocab_size)
+    expect = jnp.take_along_axis(
+        dense_mlm, batch["mlm_positions"][..., None], axis=1)
+    np.testing.assert_allclose(np.asarray(gathered_mlm, np.float32),
+                               np.asarray(expect, np.float32),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nsp_g), np.asarray(nsp),
+                               rtol=1e-6, atol=1e-6)
+    loss_dense = bert_pretrain_loss(dense_mlm, nsp, batch["mlm_labels"],
+                                    batch["nsp_labels"])
+    loss_gathered = bert_pretrain_loss(gathered_mlm, nsp_g,
+                                       batch["mlm_gathered_labels"],
+                                       batch["nsp_labels"])
+    np.testing.assert_allclose(float(loss_gathered), float(loss_dense),
+                               rtol=1e-5)
+
+
+@pytest.mark.slow
 def test_train_loss_decreases(tiny_setup):
     cfg, model, params, batch = tiny_setup
     step = make_pretrain_step(model)
