@@ -247,6 +247,23 @@ def run_workload(devs, batch_per_chip: int, seq_len: int, steps: int):
         cfg = bert_tiny_config(max_position_embeddings=max(128, seq_len))
     else:
         cfg = bert_large_config(max_position_embeddings=max(512, seq_len))
+    # remat trades backward FLOPs for activation memory — required for the
+    # larger escalated batches. Env wins; the tuned record's choice applies
+    # ONLY when the batch also came from the record (an explicit
+    # APEX_TPU_BENCH_BATCH override must not inherit a mismatched remat).
+    remat_env = os.environ.get("APEX_TPU_BENCH_REMAT")
+    batch_overridden = bool(int(os.environ.get("APEX_TPU_BENCH_BATCH", "0")))
+    if remat_env is not None:
+        remat = remat_env == "1"
+    elif batch_overridden:
+        remat = False
+    else:
+        remat = bool(_tuned_record().get("remat", False))
+    if remat:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, remat=True)
+        log("remat enabled")
     model = BertForPreTraining(cfg)
     rng = np.random.default_rng(0)
     batch = synthetic_batch(rng, cfg, batch_size, seq_len)
@@ -311,16 +328,19 @@ def run_workload(devs, batch_per_chip: int, seq_len: int, steps: int):
                 device=devs[0])
 
 
-def _tuned_batch() -> int:
-    """Per-chip batch: the measured winner from run_tpu_round.sh's batch
-    escalation (bench_batch.json, committed once a window has compared
-    8/16/32), else the conservative 8 that is known to fit."""
+def _tuned_record() -> dict:
+    """The measured winner from run_tpu_round.sh's batch escalation
+    (bench_batch.json, committed once a window has compared 8/16/32)."""
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_batch.json")) as f:
-            return int(json.load(f)["batch_per_chip"])
+            return json.load(f)
     except Exception:
-        return 8
+        return {}
+
+
+def _tuned_batch() -> int:
+    return int(_tuned_record().get("batch_per_chip", 8))
 
 
 def main():

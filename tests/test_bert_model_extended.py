@@ -81,6 +81,41 @@ def test_bert_through_ddp_facade(rng):
     assert losses[-1] < losses[0], losses
 
 
+def test_bert_remat_trains_and_matches(rng):
+    """cfg.remat wraps each BertLayer in nn.remat (static deterministic):
+    training still converges and the forward is bit-identical to the
+    non-remat model on the same params (the bench's batch-32 escalation
+    trains with this flag)."""
+    import dataclasses
+
+    # real dropout rates: the bench trains remat + dropout +
+    # deterministic=False, so the nn.Dropout rng lifting through nn.remat
+    # must be covered, not just the dropout-free path
+    cfg = dataclasses.replace(bert_tiny_config(), remat=True,
+                              hidden_dropout=0.1, attention_dropout=0.1)
+    model = BertForPreTraining(cfg)
+    batch = synthetic_batch(rng, cfg, 2, 32)
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                        batch["token_type_ids"],
+                        batch["attention_mask"])["params"]
+    step = make_pretrain_step(model)
+    opt = FusedLAMB(params, lr=1e-3)
+    losses = []
+    for i in range(4):
+        loss, grads = step(params, batch, i)
+        params = opt.step(grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    plain = BertForPreTraining(bert_tiny_config())
+    o_r = model.apply({"params": params}, batch["input_ids"],
+                      batch["token_type_ids"], batch["attention_mask"])
+    o_p = plain.apply({"params": params}, batch["input_ids"],
+                      batch["token_type_ids"], batch["attention_mask"])
+    np.testing.assert_allclose(np.asarray(o_r[0], np.float32),
+                               np.asarray(o_p[0], np.float32), rtol=1e-6)
+
+
 def test_bert_seq512_bench_shape_forward(rng):
     """Tiny width but BENCH sequence length: validates the seq-512 mask /
     position plumbing the benchmark runs (interpret-mode on CPU)."""
