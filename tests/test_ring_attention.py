@@ -259,8 +259,11 @@ def test_zigzag_gqa_matches_single_device(rng):
 
 @pytest.mark.slow
 @pytest.mark.parametrize("cp,window", [(2, 24), (4, 48), (4, 300), (2, 1),
-                                       (4, 96)])
+                                       (4, 96), (4, 16)])
 def test_zigzag_sliding_window_matches_single_device(rng, cp, window):
+    # (4, 16): hop 2 is wholly out-of-band (d_max=1) while hop 3 is live
+    # via the LL wrap — the ONLY case exercising the composed delta=2
+    # rotation (skipped hops folding into one multi-step ppermute)
     """VERDICT r3 weak #5: the load-balanced zigzag layout composes with
     sliding windows — static-offset EE/LL bands, a dynamic-offset
     late-vs-early block, and hop skipping with composed rotations — and
