@@ -17,6 +17,11 @@ from apex_tpu.models.gpt import (  # noqa: F401
     gpt_tiny_config,
     lm_token_loss,
 )
+from apex_tpu.models import generation  # noqa: F401
+from apex_tpu.models.generation import (  # noqa: F401
+    generate,
+    init_cache,
+)
 from apex_tpu.models import hf_convert  # noqa: F401
 from apex_tpu.models import llama  # noqa: F401
 from apex_tpu.models.hf_convert import (  # noqa: F401

@@ -1,0 +1,276 @@
+"""Autoregressive decoding with a static-shape KV cache (beyond reference).
+
+The reference (apex) is a training-utilities library and ships no inference
+path; a complete framework needs one. This module is the TPU-first decode
+design:
+
+- **Static shapes everywhere**: the cache is allocated once at
+  ``(batch, kv_heads_local, max_len, head_dim)`` per layer; each step
+  writes its chunk with ``lax.dynamic_update_slice`` and attends over the
+  full buffer with an absolute-position mask. No growing arrays, no
+  recompilation per step.
+- **Prefill rides the flash kernel**: the cache length starts as a STATIC
+  Python 0 and stays static under plain-int arithmetic, so the first
+  (prompt) chunk is provably past-free at trace time and the blocks route
+  it through the same Pallas flash attention as training — O(tile) memory
+  instead of materializing ``(b, kv, rep, s, max_len)`` scores. Decode
+  steps (traced length inside ``lax.scan``) use the masked dot-product
+  over the cache, where the score tensor is a thin ``s=1`` slab.
+- **One compiled loop**: the decode loop is a ``lax.scan`` over steps, so
+  the whole ``generate`` call is a single XLA program (jittable end to
+  end); the per-step cache update aliases in place under XLA.
+- **Tensor-parallel native**: caches hold the LOCAL kv-head shard (GQA
+  divides kv heads over the ``model`` axis exactly like training), and
+  sampling all-gathers only the final-position vocab-parallel logits
+  (payload ``[batch, vocab]``) — the replicated PRNG key then makes every
+  rank sample the same token.
+- **GQA/MQA without expansion**: queries reshape to
+  ``(b, kv, rep, s, d)`` and contract against the unexpanded K/V cache —
+  the cache stays ``num_kv_heads``-sized in HBM (Llama/Mistral GQA).
+
+Prefill and decode share one model entry point: ``model.apply(variables,
+ids, cache=cache)`` returns ``(vocab-parallel logits, updated cache)`` for
+any chunk length, so chunked/speculative decoding composes for free. While
+the cache length is static (prefill + chunked continuation outside the
+scan) out-of-range chunks raise at trace time; once the length is traced
+(inside ``generate``'s scan) bounds are enforced by ``generate`` itself —
+callers driving ``apply`` directly with a traced length own that check
+(``lax.dynamic_slice`` clamps silently).
+
+Context parallelism does not compose with incremental decoding (the cache
+is position-contiguous per device); the models raise on that combination.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_is_bound as _axis_bound,
+    gather_from_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.utils import divide
+
+
+# --- cache structure ---------------------------------------------------------
+#
+# cache = {"layers": [{"k": (b, kv_local, T, d), "v": ...}] * num_layers,
+#          "len":   tokens already written — a Python int while static
+#                   (prefill, chunked continuation), an int32 scalar inside
+#                   the decode scan}
+#
+# The per-layer view handed to a decoder block adds the current length so
+# the block can place its chunk: {"k", "v", "len"}.
+
+
+def init_cache(config, batch: int, max_len: int, *, dtype=None):
+    """Allocate an all-zeros KV cache for ``batch`` sequences of up to
+    ``max_len`` total tokens (prompt + generated). Inside shard_map with
+    the ``model`` axis bound, ``config.tensor_parallel_size`` kv-head
+    shards divide exactly as in training."""
+    kv_heads = getattr(config, "num_kv_heads", config.num_heads)
+    kv_local = divide(kv_heads, config.tensor_parallel_size)
+    d = config.head_dim
+    dt = dtype if dtype is not None else resolve_compute_dtype(config.dtype)
+    shape = (batch, kv_local, max_len, d)
+    layers = [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+              for _ in range(config.num_layers)]
+    return {"layers": layers, "len": 0}
+
+
+def cache_max_len(cache) -> int:
+    return cache["layers"][0]["k"].shape[2]
+
+
+def check_chunk_bounds(cache, s: int, max_position_embeddings: int):
+    """Model-level guard for a chunk of length ``s``: while the cache
+    length is static, out-of-range chunks (past the position table or the
+    cache buffer) raise at trace time — the decode-path analog of the
+    training forward's explicit position checks. Returns the offset."""
+    t0 = cache["len"]
+    t_max = cache_max_len(cache)
+    if isinstance(t0, int):
+        if t0 + s > max_position_embeddings:
+            raise ValueError(
+                f"decode chunk [{t0}, {t0 + s}) exceeds "
+                f"max_position_embeddings={max_position_embeddings}")
+        if t0 + s > t_max:
+            raise ValueError(
+                f"decode chunk [{t0}, {t0 + s}) exceeds the cache buffer "
+                f"(max_len={t_max}); allocate a larger init_cache")
+    elif s > t_max:
+        raise ValueError(f"chunk length {s} exceeds cache max_len={t_max}")
+    return t0
+
+
+def layer_cache(cache, i: int):
+    """Per-layer view for decoder block ``i`` (adds the shared length)."""
+    lc = dict(cache["layers"][i])
+    lc["len"] = cache["len"]
+    return lc
+
+
+def is_static_prefill(lc, s: int) -> bool:
+    """True when this chunk is provably the first tokens in the cache AT
+    TRACE TIME — the blocks then attend with the training flash kernel
+    (past-free, O(tile) memory) instead of the dense cached path."""
+    return isinstance(lc["len"], int) and lc["len"] == 0 and s > 1
+
+
+def update_layer_cache(lc, k_chunk, v_chunk):
+    """Write a ``(b, kv, s, d)`` K/V chunk at offset ``len`` and return the
+    updated per-layer view. XLA aliases the update in place inside jit.
+
+    TRACED-length caveat: with a sealed (traced) ``len`` the bounds cannot
+    be checked at trace time and ``lax.dynamic_update_slice`` CLAMPS an
+    out-of-range start, silently overwriting the newest cache entries —
+    callers driving ``model.apply`` inside their own scan own the bound
+    (``generate`` enforces it up front; static lengths raise in
+    ``check_chunk_bounds``)."""
+    t0 = lc["len"]
+    start = (0, 0, t0, 0)
+    return {
+        "k": lax.dynamic_update_slice(lc["k"], k_chunk.astype(lc["k"].dtype),
+                                      start),
+        "v": lax.dynamic_update_slice(lc["v"], v_chunk.astype(lc["v"].dtype),
+                                      start),
+        "len": t0,
+    }
+
+
+def advance_cache(cache, new_layers, s: int):
+    """Model-level reassembly after all blocks ran a chunk of length s.
+    Plain-int arithmetic keeps a static length static across chunks."""
+    return {
+        "layers": [{"k": lc["k"], "v": lc["v"]} for lc in new_layers],
+        "len": cache["len"] + s,
+    }
+
+
+def seal_cache(cache):
+    """Convert a static length to a traced int32 scalar so the cache can be
+    a ``lax.scan`` carry (the decode loop's representation)."""
+    return dict(cache, len=jnp.asarray(cache["len"], jnp.int32))
+
+
+def cached_attention(q, lc, *, window: Optional[int] = None):
+    """Masked dot-product attention of a ``(b, h, s, d)`` query chunk at
+    absolute positions ``[len, len+s)`` against the full cache buffer.
+
+    The causal mask is over ABSOLUTE positions (key j visible to query at
+    global position p iff ``p - window < j <= p``), which simultaneously
+    hides the not-yet-written tail of the static buffer. GQA contracts the
+    grouped queries against the unexpanded kv-head cache. fp32 scores and
+    accumulation (same numerics contract as the flash kernel)."""
+    k, v, t0 = lc["k"], lc["v"], lc["len"]
+    b, h, s, d = q.shape
+    kv = k.shape[1]
+    rep = divide(h, kv)
+    t_max = k.shape[2]
+
+    qf = q.reshape(b, kv, rep, s, d).astype(jnp.float32)
+    scores = jnp.einsum("bkrsd,bktd->bkrst", qf, k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
+    pos_q = t0 + jnp.arange(s, dtype=jnp.int32)[:, None]      # (s, 1)
+    pos_k = jnp.arange(t_max, dtype=jnp.int32)[None, :]       # (1, T)
+    mask = pos_k <= pos_q
+    if window is not None:
+        mask = jnp.logical_and(mask, pos_k > pos_q - window)
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bkrst,bktd->bkrsd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return ctx.reshape(b, h, s, d).astype(q.dtype)
+
+
+# --- sampling + the generate loop -------------------------------------------
+
+
+def _sample_token(last_logits, step_key, *, temperature, top_k, axis_name):
+    """One token per batch row from final-position (possibly vocab-parallel)
+    logits. Greedy at temperature 0; otherwise top-k/categorical. Inside a
+    TP region the gather makes logits (and the replicated key makes the
+    draw) identical on every rank."""
+    if _axis_bound(axis_name):
+        last_logits = gather_from_tensor_model_parallel_region(
+            last_logits, axis_name)
+    logits = last_logits.astype(jnp.float32)
+    if not temperature:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(step_key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, variables, prompt_ids, max_new_tokens: int, *,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None, rng=None,
+             eos_token_id: Optional[int] = None,
+             axis_name: str = MODEL_AXIS):
+    """Prefill the prompt (flash-kernel path), then scan ``max_new_tokens``
+    single-token decode steps. Returns ``(batch, prompt_len +
+    max_new_tokens)`` token ids (prompt included). After ``eos_token_id``
+    a row keeps emitting EOS.
+
+    Jittable end to end (``max_new_tokens`` static). Works plain, under
+    ``jit`` with a dp-sharded batch, or inside ``shard_map`` with the
+    ``model`` axis bound (vocab-/head-sharded decode)."""
+    cfg = model.config
+    b, s0 = prompt_ids.shape
+    total = s0 + int(max_new_tokens)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if total > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings={cfg.max_position_embeddings}")
+    t_max = total if max_len is None else int(max_len)
+    if t_max < total:
+        raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
+    if temperature and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an explicit rng")
+    if not temperature and (top_k is not None or rng is not None):
+        # the mirror-image misuse: sampling knobs with greedy decoding
+        # would be silently ignored
+        raise ValueError("top_k/rng require temperature > 0 (greedy "
+                         "decoding at temperature=0 ignores them)")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    cache = init_cache(cfg, b, t_max)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache)
+    cache = seal_cache(cache)  # static len -> scan-carry representation
+
+    def sample(last, i):
+        return _sample_token(last, jax.random.fold_in(rng, i),
+                             temperature=temperature, top_k=top_k,
+                             axis_name=axis_name)
+
+    tok0 = sample(logits[:, -1], 0)
+    done0 = (tok0 == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((b,), bool)
+
+    def step(carry, i):
+        cache, tok, done = carry
+        step_logits, cache = model.apply(variables, tok[:, None], cache=cache)
+        nxt = sample(step_logits[:, 0], i)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = jnp.logical_or(done, nxt == eos_token_id)
+        return (cache, nxt, done), nxt
+
+    if max_new_tokens > 1:
+        _, rest = lax.scan(step, (cache, tok0, done0),
+                           jnp.arange(1, max_new_tokens))
+        gen = jnp.concatenate([tok0[:, None], rest.T], axis=1)
+    else:
+        gen = tok0[:, None]
+    return jnp.concatenate([prompt_ids.astype(jnp.int32), gen], axis=1)

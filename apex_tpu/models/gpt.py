@@ -23,6 +23,9 @@ from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.models.generation import (advance_cache, cached_attention,
+                                        check_chunk_bounds, is_static_prefill,
+                                        layer_cache, update_layer_cache)
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import (flash_attention, ring_attention,
                           ring_attention_zigzag)
@@ -115,7 +118,7 @@ class ParallelDecoderBlock(nn.Module):
         return moe_layer_selected(self.config, self.layer_idx)
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache=None):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)  # amp O1 seam
         tp = cfg.tensor_parallel_size
@@ -135,10 +138,23 @@ class ParallelDecoderBlock(nn.Module):
         def to_bhsd(t):
             return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
 
+        if cache is not None:
+            # incremental decoding: append this chunk's K/V into the static
+            # per-layer cache; a trace-time-provable prefill (static len 0)
+            # attends with the training flash kernel (O(tile) memory),
+            # decode steps with the masked dot-product over the buffer
+
+            prefill = is_static_prefill(cache, s)
+            cache = update_layer_cache(cache, to_bhsd(k), to_bhsd(v))
+            if prefill:
+                ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                      causal=True)
+            else:
+                ctx = cached_attention(to_bhsd(q), cache)
         # context parallelism (beyond reference): with the sequence sharded
         # over ``context``, K/V ring-rotate between devices instead of any
         # device materializing the full sequence (ops/ring_attention.py)
-        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+        elif cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             if cfg.context_parallel_zigzag:
                 ctx = ring_attention_zigzag(
                     to_bhsd(q), to_bhsd(k), to_bhsd(v),
@@ -170,7 +186,8 @@ class ParallelDecoderBlock(nn.Module):
             mlp_out = RowParallelLinear(
                 4 * e, e, input_is_parallel=True, world_size=tp,
                 params_dtype=cfg.param_dtype, name="mlp_out")(h)
-        return x + mlp_out.astype(x.dtype)
+        out = x + mlp_out.astype(x.dtype)
+        return out if cache is None else (out, cache)
 
 
 class GPTModel(nn.Module):
@@ -182,7 +199,7 @@ class GPTModel(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, cache=None):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
@@ -193,7 +210,18 @@ class GPTModel(nn.Module):
         pos = self.param("position_embeddings", nn.initializers.normal(0.02),
                          (cfg.max_position_embeddings, cfg.hidden_size),
                          cfg.param_dtype)
-        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+        if cache is not None:
+            # incremental decoding (models/generation.py): this chunk covers
+            # absolute positions [len, len+s); caches hold K/V per layer and
+            # the model returns (vocab-parallel logits, updated cache)
+            if cfg.context_parallel:
+                raise ValueError(
+                    "incremental decoding does not compose with context "
+                    "parallelism; decode on a dp/tp mesh instead")
+
+            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
+            pos_s = lax.dynamic_slice_in_dim(pos, t0, s)
+        elif cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             # sequence sharded over ``context``: local chunk i covers global
             # positions [i*s, (i+1)*s) (or, zigzag, the two half-chunk
             # ranges i and 2cp-1-i)
@@ -220,14 +248,25 @@ class GPTModel(nn.Module):
         x = (x + pos_s[None, :, :]).astype(dt)
         # nn.remat (lifted jax.checkpoint): same param tree, same sown
         # intermediates, recompute-in-backward per block
-        block_cls = nn.remat(ParallelDecoderBlock) if cfg.remat \
+        block_cls = nn.remat(ParallelDecoderBlock) if cfg.remat and cache is None \
             else ParallelDecoderBlock
+        new_layers = []
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, layer_idx=i, name=f"layer_{i}")(x)
+            blk = block_cls(cfg, layer_idx=i, name=f"layer_{i}")
+            if cache is None:
+                x = blk(x)
+            else:
+
+                x, lc = blk(x, cache=layer_cache(cache, i))
+                new_layers.append(lc)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_eps,
                            name="final_norm")(x)
         # tied LM head: local logits against the LOCAL vocab shard
-        return emb.attend(x.astype(dt))
+        logits = emb.attend(x.astype(dt))
+        if cache is None:
+            return logits
+
+        return logits, advance_cache(cache, new_layers, s)
 
 
 def lm_token_loss(logits, labels, axis_name: str = MODEL_AXIS,

@@ -26,6 +26,9 @@ from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
+from apex_tpu.models.generation import (advance_cache, cached_attention,
+                                        check_chunk_bounds, is_static_prefill,
+                                        layer_cache, update_layer_cache)
 from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
 from apex_tpu.ops import (flash_attention, ring_attention,
@@ -125,7 +128,7 @@ class LlamaDecoderBlock(nn.Module):
         return moe_layer_selected(self.config, self.layer_idx)
 
     @nn.compact
-    def __call__(self, x, cos_, sin_):
+    def __call__(self, x, cos_, sin_, cache=None):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         tp = cfg.tensor_parallel_size
@@ -162,7 +165,19 @@ class LlamaDecoderBlock(nn.Module):
         # non-divisible ratios at the source.
         divide(h_local, kv_local)
 
-        if cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
+        if cache is not None:
+            # incremental decoding: append K/V at the cache offset; a
+            # trace-time-provable prefill rides the training flash kernel,
+            # decode steps the absolute-position (windowed) masked product
+
+            prefill = is_static_prefill(cache, s)
+            cache = update_layer_cache(cache, k, v)
+            if prefill:
+                ctx = flash_attention(q, k, v, causal=True,
+                                      window=cfg.sliding_window)
+            else:
+                ctx = cached_attention(q, cache, window=cfg.sliding_window)
+        elif cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             if cfg.context_parallel_zigzag:
                 # causal load-balanced layout; windows compose via the
                 # static/dynamic-offset banding (ops/ring_attention.py)
@@ -204,7 +219,8 @@ class LlamaDecoderBlock(nn.Module):
                 cfg.intermediate_size, e, bias=False, input_is_parallel=True,
                 world_size=tp, params_dtype=cfg.param_dtype,
                 name="down_proj")(jax.nn.silu(gate) * up)
-        return x + mlp_out.astype(x.dtype)
+        out = x + mlp_out.astype(x.dtype)
+        return out if cache is None else (out, cache)
 
 
 class LlamaModel(nn.Module):
@@ -215,7 +231,7 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, cache=None):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
@@ -225,43 +241,64 @@ class LlamaModel(nn.Module):
             params_dtype=cfg.param_dtype, name="embed_tokens")
         x = emb(input_ids).astype(dt)
 
-        cp = (lax.axis_size(CONTEXT_AXIS)
-              if cfg.context_parallel and _axis_bound(CONTEXT_AXIS) else 1)
-        if cp * s > cfg.max_position_embeddings:
-            # RoPE would silently extrapolate past the trained range;
-            # enforce uniformly (CP and single-device alike)
-            raise ValueError(
-                f"global sequence cp*s = {cp}*{s} exceeds "
-                f"max_position_embeddings={cfg.max_position_embeddings}")
-        if cp > 1 and cfg.context_parallel_zigzag:
-            # zigzag slice = global chunks (i, 2cp-1-i): RoPE positions
-            # follow the layout, one table per half-chunk
-            if s % 2:
-                raise ValueError("zigzag CP needs an even local sequence")
-            s_h = s // 2
-            i = lax.axis_index(CONTEXT_AXIS)
-            cos_e, sin_e = _rope_cos_sin(cfg, s_h, i * s_h)
-            cos_l, sin_l = _rope_cos_sin(cfg, s_h, (2 * cp - 1 - i) * s_h)
-            cos_ = jnp.concatenate([cos_e, cos_l], axis=0)
-            sin_ = jnp.concatenate([sin_e, sin_l], axis=0)
-        else:
-            offset = lax.axis_index(CONTEXT_AXIS) * s if cp > 1 else 0
-            cos_, sin_ = _rope_cos_sin(cfg, s, offset)
+        if cache is not None:
+            # incremental decoding (models/generation.py): RoPE tables for
+            # the absolute positions [len, len+s); blocks append K/V
+            if cfg.context_parallel:
+                raise ValueError(
+                    "incremental decoding does not compose with context "
+                    "parallelism; decode on a dp/tp mesh instead")
 
-        block_cls = nn.remat(LlamaDecoderBlock) if cfg.remat \
+            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
+            cos_, sin_ = _rope_cos_sin(cfg, s, t0)
+        else:
+            cp = (lax.axis_size(CONTEXT_AXIS)
+                  if cfg.context_parallel and _axis_bound(CONTEXT_AXIS) else 1)
+            if cp * s > cfg.max_position_embeddings:
+                # RoPE would silently extrapolate past the trained range;
+                # enforce uniformly (CP and single-device alike)
+                raise ValueError(
+                    f"global sequence cp*s = {cp}*{s} exceeds "
+                    f"max_position_embeddings={cfg.max_position_embeddings}")
+            if cp > 1 and cfg.context_parallel_zigzag:
+                # zigzag slice = global chunks (i, 2cp-1-i): RoPE positions
+                # follow the layout, one table per half-chunk
+                if s % 2:
+                    raise ValueError("zigzag CP needs an even local sequence")
+                s_h = s // 2
+                i = lax.axis_index(CONTEXT_AXIS)
+                cos_e, sin_e = _rope_cos_sin(cfg, s_h, i * s_h)
+                cos_l, sin_l = _rope_cos_sin(cfg, s_h, (2 * cp - 1 - i) * s_h)
+                cos_ = jnp.concatenate([cos_e, cos_l], axis=0)
+                sin_ = jnp.concatenate([sin_e, sin_l], axis=0)
+            else:
+                offset = lax.axis_index(CONTEXT_AXIS) * s if cp > 1 else 0
+                cos_, sin_ = _rope_cos_sin(cfg, s, offset)
+
+        block_cls = nn.remat(LlamaDecoderBlock) if cfg.remat and cache is None \
             else LlamaDecoderBlock
+        new_layers = []
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, layer_idx=i,
-                          name=f"layer_{i}")(x, cos_, sin_)
+            blk = block_cls(cfg, layer_idx=i, name=f"layer_{i}")
+            if cache is None:
+                x = blk(x, cos_, sin_)
+            else:
+
+                x, lc = blk(x, cos_, sin_, cache=layer_cache(cache, i))
+                new_layers.append(lc)
         x = FusedRMSNorm(cfg.hidden_size, eps=cfg.rms_eps, name="final_norm")(x)
         x = x.astype(dt)
         if cfg.tie_word_embeddings:
-            return emb.attend(x)
-        head = ColumnParallelLinear(
-            cfg.hidden_size, cfg.vocab_size, bias=False, gather_output=False,
-            world_size=cfg.tensor_parallel_size,
-            params_dtype=cfg.param_dtype, name="lm_head")
-        return head(x)
+            logits = emb.attend(x)
+        else:
+            logits = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, bias=False,
+                gather_output=False, world_size=cfg.tensor_parallel_size,
+                params_dtype=cfg.param_dtype, name="lm_head")(x)
+        if cache is None:
+            return logits
+
+        return logits, advance_cache(cache, new_layers, s)
 
 
 def llama_loss(model: LlamaModel, variables, input_ids, labels,

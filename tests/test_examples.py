@@ -53,6 +53,18 @@ def test_dcgan_multi_loss_amp():
     assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
 
 
+def test_generation_example_decodes():
+    mod = _load("example_generation",
+                "examples/generation/generate_llama.py")
+    out = mod.run_generation(new_tokens=6, verbose=_quiet)
+    assert out.shape == (2, 12)
+    sampled = mod.run_generation(new_tokens=6, temperature=0.9, top_k=8,
+                                 verbose=_quiet)
+    assert sampled.shape == (2, 12)
+    tp = mod.run_generation(new_tokens=4, tp=2, verbose=_quiet)
+    assert tp.shape == (2, 10)
+
+
 def test_simple_ddp_loop():
     mod = _load("example_simple_ddp",
                 "examples/simple/distributed/distributed_data_parallel.py")
