@@ -193,11 +193,12 @@ def cached_attention(q, lc, *, window: Optional[int] = None):
 # --- sampling + the generate loop -------------------------------------------
 
 
-def _sample_token(last_logits, step_key, *, temperature, top_k, axis_name):
+def _sample_token(last_logits, step_key, *, temperature, top_k, top_p,
+                  axis_name):
     """One token per batch row from final-position (possibly vocab-parallel)
-    logits. Greedy at temperature 0; otherwise top-k/categorical. Inside a
-    TP region the gather makes logits (and the replicated key makes the
-    draw) identical on every rank."""
+    logits. Greedy at temperature 0; otherwise top-k/top-p/categorical.
+    Inside a TP region the gather makes logits (and the replicated key makes
+    the draw) identical on every rank."""
     if _axis_bound(axis_name):
         last_logits = gather_from_tensor_model_parallel_region(
             last_logits, axis_name)
@@ -208,13 +209,24 @@ def _sample_token(last_logits, step_key, *, temperature, top_k, axis_name):
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the sorted distribution with
+        # cumulative mass > top_p (the first token always survives: the
+        # EXCLUSIVE cumsum below is 0.0 < top_p for it)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        cutoff_idx = jnp.sum((mass_before < top_p).astype(jnp.int32),
+                             axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(step_key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(model, variables, prompt_ids, max_new_tokens: int, *,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             top_k: Optional[int] = None, rng=None,
-             eos_token_id: Optional[int] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             rng=None, eos_token_id: Optional[int] = None,
              axis_name: str = MODEL_AXIS):
     """Prefill the prompt (flash-kernel path), then scan ``max_new_tokens``
     single-token decode steps. Returns ``(batch, prompt_len +
@@ -238,11 +250,19 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
         raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
     if temperature and rng is None:
         raise ValueError("sampling (temperature > 0) needs an explicit rng")
-    if not temperature and (top_k is not None or rng is not None):
+    if not temperature and (top_k is not None or top_p is not None
+                            or rng is not None):
         # the mirror-image misuse: sampling knobs with greedy decoding
         # would be silently ignored
-        raise ValueError("top_k/rng require temperature > 0 (greedy "
+        raise ValueError("top_k/top_p/rng require temperature > 0 (greedy "
                          "decoding at temperature=0 ignores them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p <= 0 would otherwise hit the exclusive-cumsum edge (no row
+        # below the threshold -> index -1 -> smallest logit as cutoff) and
+        # silently sample the FULL distribution
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     cache = init_cache(cfg, b, t_max)
@@ -252,7 +272,7 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
     def sample(last, i):
         return _sample_token(last, jax.random.fold_in(rng, i),
                              temperature=temperature, top_k=top_k,
-                             axis_name=axis_name)
+                             top_p=top_p, axis_name=axis_name)
 
     tok0 = sample(logits[:, -1], 0)
     done0 = (tok0 == eos_token_id) if eos_token_id is not None \

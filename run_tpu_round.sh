@@ -128,4 +128,13 @@ if best_b != 8:
 print(f"[batch escalation] winner: {best_b}/chip at {best_v:.0f} tok/s")
 EOF
 fi
+# decode-throughput harvest (beyond reference — no gate dependency beyond
+# the suite's flash/xentropy compiles; cheap: one small-model compile)
+if bench_done && [ ! -f "DECODE_${TAG}.json" ]; then
+  echo "[$(date +%H:%M:%S)] decode-throughput bench (GPT-2 small KV cache)..."
+  timeout 3600 python tpu_decode_bench.py \
+    > "DECODE_${TAG}.json.tmp" 2> "decode_${TAG}.stderr.log" \
+    && mv "DECODE_${TAG}.json.tmp" "DECODE_${TAG}.json" || true
+  tail -2 "decode_${TAG}.stderr.log"
+fi
 echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"

@@ -45,3 +45,19 @@ def test_bench_sharded_over_8_cpu_devices():
         "batch not sharded over the device mesh — per-chip throughput would "
         f"be fictional: {rec}")
     assert rec["value"] > 0
+
+
+def test_decode_bench_smoke_emits_json():
+    """tpu_decode_bench.py in smoke mode prints one parseable JSON record
+    with a nonzero steady-state decode throughput."""
+    env = dict(os.environ)
+    env["APEX_TPU_DECODE_SMOKE"] = "1"
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tpu_decode_bench.py")],
+                       capture_output=True, text=True, timeout=600, env=env,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "gpt2_decode_tokens_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["unit"] == "tokens/s/chip"

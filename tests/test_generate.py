@@ -153,6 +153,38 @@ def test_generate_sampling_topk_support_and_reproducibility(rng):
         generate(model, v, prompt, max_new_tokens=2, top_k=4)
 
 
+def test_generate_top_p_nucleus(rng):
+    """top_p -> 0 degenerates to greedy (only the modal token survives);
+    moderate top_p draws stay inside the teacher-forced nucleus set."""
+    cfg = llama_tiny_config()
+    model = LlamaModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+
+    greedy = np.asarray(generate(model, v, prompt, max_new_tokens=5))
+    tiny_p = np.asarray(generate(model, v, prompt, max_new_tokens=5,
+                                 temperature=1.0, top_p=1e-9,
+                                 rng=jax.random.PRNGKey(1)))
+    np.testing.assert_array_equal(tiny_p, greedy)
+
+    with pytest.raises(ValueError):  # top_p=0 would sample the full dist
+        generate(model, v, prompt, max_new_tokens=2, temperature=1.0,
+                 top_p=0.0, rng=jax.random.PRNGKey(1))
+
+    out = np.asarray(generate(model, v, prompt, max_new_tokens=5,
+                              temperature=1.0, top_p=0.7,
+                              rng=jax.random.PRNGKey(2)))
+    for p in range(5):
+        logits = _full_logits(model, v, jnp.asarray(out[:, :4 + p]))[:, -1]
+        for row in range(2):
+            probs = np.exp(logits[row] - logits[row].max())
+            probs /= probs.sum()
+            order = np.argsort(-probs)
+            mass_before = np.cumsum(probs[order]) - probs[order]
+            nucleus = set(order[mass_before < 0.7].tolist())
+            assert int(out[row, 4 + p]) in nucleus
+
+
 def test_chunked_continuation_matches_full_forward(rng):
     """Static-offset multi-token chunks (speculative-decoding shape):
     prefill 4, then a 4-token chunk through the dense cached path."""

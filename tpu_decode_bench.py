@@ -1,0 +1,86 @@
+"""On-chip decode-throughput harvest: GPT-2 small autoregressive generation.
+
+Run inside a healthy tunnel window (run_tpu_round.sh calls it after the
+gate artifacts exist). Measures steady-state single-token decode steps/s
+of `apex_tpu.models.generation.generate` on BASELINE config #4's GPT-2
+small (beyond-reference: apex has no inference path, so this metric has
+no reference analog — it documents the KV-cache design's throughput).
+
+Method: jit two generate programs at the same prompt — one with
+`max_new_tokens=1` (prefill + 1 step) and one with `N` steps — and take
+``(N-1) * batch / (t_N - t_1)``: pure decode-step throughput with the
+prefill and sampling epilogue differenced out. Greedy decode (argmax),
+bf16 model, batch 8, prompt 128, N=128.
+
+Emits one JSON line: {"metric": "gpt2_decode_tokens_per_sec_per_chip", ...}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_best(fn, repeats=3):
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import functools
+    import os
+
+    from apex_tpu.models.generation import generate
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config, gpt_tiny_config
+
+    if os.environ.get("APEX_TPU_DECODE_SMOKE") == "1":
+        # CPU smoke: interpret-mode flash prefill at GPT-2 shapes is far
+        # too slow; prove the harness mechanics on the tiny model instead
+        # (jax.config, not env — sitecustomize imports jax before us)
+        jax.config.update("jax_platforms", "cpu")
+        batch, prompt_len, n_new = 2, 8, 4
+        cfg = gpt_tiny_config()
+    else:
+        batch, prompt_len, n_new = 8, 128, 128
+        cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt_len)),
+                         jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt[:, :8])
+
+    gen_1 = jax.jit(functools.partial(generate, model, max_new_tokens=1,
+                                      max_len=prompt_len + n_new,
+                                      axis_name="unbound"))
+    gen_n = jax.jit(functools.partial(generate, model, max_new_tokens=n_new,
+                                      max_len=prompt_len + n_new,
+                                      axis_name="unbound"))
+    jax.block_until_ready(gen_1(v, prompt))   # compile
+    jax.block_until_ready(gen_n(v, prompt))
+    t1 = time_best(lambda: gen_1(v, prompt))
+    tn = time_best(lambda: gen_n(v, prompt))
+
+    steps = n_new - 1
+    toks_per_s = steps * batch / max(tn - t1, 1e-9)
+    dev = jax.devices()[0]
+    rec = {
+        "metric": "gpt2_decode_tokens_per_sec_per_chip",
+        "value": round(toks_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,  # no reference analog (apex ships no inference)
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": n_new,
+        "step_ms": round(1e3 * (tn - t1) / steps, 3),
+        "prefill_plus_one_s": round(t1, 3),
+        "device": dev.device_kind, "platform": dev.platform,
+    }
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
