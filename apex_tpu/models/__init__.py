@@ -21,6 +21,7 @@ from apex_tpu.models import generation  # noqa: F401
 from apex_tpu.models.generation import (  # noqa: F401
     generate,
     init_cache,
+    speculative_generate,
 )
 from apex_tpu.models import hf_convert  # noqa: F401
 from apex_tpu.models import llama  # noqa: F401

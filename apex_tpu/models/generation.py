@@ -43,6 +43,7 @@ is position-contiguous per device); the models raise on that combination.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -193,19 +194,26 @@ def cached_attention(q, lc, *, window: Optional[int] = None):
 # --- sampling + the generate loop -------------------------------------------
 
 
+def _greedy_token(logits, axis_name):
+    """fp32 argmax over (possibly vocab-parallel) logits' last axis —
+    the shared greedy primitive for sampling and speculative verify."""
+    if _axis_bound(axis_name):
+        logits = gather_from_tensor_model_parallel_region(logits, axis_name)
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
 def _sample_token(last_logits, step_key, *, temperature, top_k, top_p,
                   axis_name):
     """One token per batch row from final-position (possibly vocab-parallel)
     logits. Greedy at temperature 0; otherwise top-k/top-p/categorical.
     Inside a TP region the gather makes logits (and the replicated key makes
     the draw) identical on every rank."""
+    if not temperature:
+        return _greedy_token(last_logits, axis_name)
     if _axis_bound(axis_name):
         last_logits = gather_from_tensor_model_parallel_region(
             last_logits, axis_name)
-    logits = last_logits.astype(jnp.float32)
-    if not temperature:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+    logits = last_logits.astype(jnp.float32) / temperature
     if top_k is not None:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -293,4 +301,113 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
         gen = jnp.concatenate([tok0[:, None], rest.T], axis=1)
     else:
         gen = tok0[:, None]
+    return jnp.concatenate([prompt_ids.astype(jnp.int32), gen], axis=1)
+
+
+# --- speculative decoding ----------------------------------------------------
+
+
+def rollback_cache(cache, new_len):
+    """Rewind a cache to ``new_len`` tokens. O(1): entries past the length
+    are already invisible to ``cached_attention``'s absolute-position mask
+    and will be overwritten by the next chunk write — rejection rollback is
+    just the scalar assignment. (The static-buffer design's payoff.)"""
+    return dict(cache, len=new_len)
+
+
+# module-level jits so the compiled draft/verify programs are shared across
+# speculative_generate calls (a per-call closure would re-trace every
+# request and bake the weights in as constants)
+@functools.partial(jax.jit, static_argnames=("model", "k", "axis_name"))
+def _spec_draft_propose(model, variables, dc, first_tok, *, k, axis_name):
+    """k draft steps from first_tok: returns (cache at +k tokens, proposals
+    d_1..d_{k-1}); the k-th step only advances the draft cache so a
+    fully-accepted round leaves it consistent."""
+    def one(carry, _):
+        dc, tok = carry
+        lg, dc = model.apply(variables, tok[:, None], cache=dc)
+        return (dc, _greedy_token(lg[:, 0], axis_name)), tok
+    (dc, _), toks = lax.scan(one, (dc, first_tok), None, length=k)
+    return dc, toks[1:].T                          # (b, k-1) proposals
+
+
+@functools.partial(jax.jit, static_argnames=("model", "axis_name"))
+def _spec_verify(model, variables, tc, chunk, *, axis_name):
+    """Target forward on the (b, k) chunk [x_t, d_1..d_{k-1}]: argmax
+    predictions for positions t+1..t+k."""
+    lg, tc = model.apply(variables, chunk, cache=tc)
+    return tc, _greedy_token(lg, axis_name)        # (b, k) argmax tokens
+
+
+def speculative_generate(model, variables, draft_model, draft_variables,
+                         prompt_ids, max_new_tokens: int, *, k: int = 4,
+                         axis_name: str = MODEL_AXIS):
+    """Greedy speculative decoding: a cheap DRAFT model proposes ``k - 1``
+    tokens per round; the target verifies them in ONE ``k``-token chunk
+    (an MXU-friendly matmul instead of ``k`` sequential s=1 steps) and
+    accepts the longest prefix matching its own argmax. Rejected positions
+    roll both caches back (``rollback_cache``) — output is EXACTLY the
+    target's greedy decode, for any draft model; the draft only changes
+    how many target steps are saved. (Exactness assumes the s=k verify
+    forward and the s=1 decode forward agree numerically — guaranteed in
+    fp32; under bf16 XLA may tile the two shapes differently, so a
+    near-tied argmax can flip and the output is then "target greedy under
+    chunked evaluation" rather than bitwise-equal to ``generate``.)
+
+    Batched rows accept the minimum match count across the batch (the
+    per-round bonus token — the target's own argmax after the accepted
+    prefix — keeps every round's progress >= 1 token/row). Host loop over
+    rounds (the accept count is data-dependent); the per-round programs
+    are shape-stable, so each jits once. Greedy only; EOS rows are not
+    early-stopped (slice the output yourself)."""
+    cfg = model.config
+    b, s0 = prompt_ids.shape
+    total = s0 + int(max_new_tokens)
+    if k < 2:
+        raise ValueError("k must be >= 2 (k-1 draft proposals per round)")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    for c in (cfg, draft_model.config):
+        # + k: the last round's verification chunk may SPAN positions past
+        # the final token before rollback discards them — a chunk crossing
+        # the position table's end would make dynamic_slice clamp the
+        # whole chunk's positions (corrupting kept tokens too)
+        if total + k > c.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) + "
+                f"k ({k}) speculative slack exceeds "
+                f"max_position_embeddings={c.max_position_embeddings}")
+
+    # + k slack: a round's verification chunk may write up to k tokens past
+    # the final accepted position before the rollback discards them
+    t_cache = init_cache(cfg, b, total + k)
+    d_cache = init_cache(draft_model.config, b, total + k)
+    logits, t_cache = model.apply(variables, prompt_ids, cache=t_cache)
+    _, d_cache = draft_model.apply(draft_variables, prompt_ids, cache=d_cache)
+    t_cache, d_cache = seal_cache(t_cache), seal_cache(d_cache)
+
+    produced = []
+    n_out = 0
+    next_tok = _greedy_token(logits[:, -1], axis_name)  # guaranteed correct
+    while n_out < max_new_tokens:
+        x_t = next_tok
+        d_cache, props = _spec_draft_propose(
+            draft_model, draft_variables, d_cache, x_t, k=k,
+            axis_name=axis_name)
+        chunk = jnp.concatenate([x_t[:, None], props], axis=1)
+        t_cache, preds = _spec_verify(model, variables, t_cache, chunk,
+                                      axis_name=axis_name)
+        # leading matches of proposals vs target argmax, min over rows
+        # (host sync: the accept count steers the Python loop)
+        match = (props == preds[:, :-1]).astype(jnp.int32)   # (b, k-1)
+        m = int(jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1)))
+        produced.append(jnp.concatenate([x_t[:, None], props[:, :m]], axis=1))
+        n_out += m + 1
+        new_len = t_cache["len"] - (k - (m + 1))   # back to t + 1 + m tokens
+        t_cache = rollback_cache(t_cache, new_len)
+        d_cache = rollback_cache(d_cache, new_len)
+        # the target's own argmax after the accepted prefix is both the
+        # round's bonus guarantee and the next round's first token
+        next_tok = preds[:, m]
+    gen = jnp.concatenate(produced, axis=1)[:, :max_new_tokens]
     return jnp.concatenate([prompt_ids.astype(jnp.int32), gen], axis=1)

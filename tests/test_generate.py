@@ -19,7 +19,8 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.mesh import MODEL_AXIS
-from apex_tpu.models.generation import generate, init_cache
+from apex_tpu.models.generation import (generate, init_cache,
+                                        speculative_generate)
 from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
 from apex_tpu.models.llama import LlamaModel, llama_tiny_config
 
@@ -289,3 +290,63 @@ def test_generate_tp2_matches_tp1(rng):
     with mesh:
         outt = np.asarray(jax.jit(run)(stacked, prompt))
     np.testing.assert_array_equal(outt, out1)
+
+
+def test_speculative_equals_greedy_self_draft(rng):
+    """Draft == target: every proposal accepted, output == plain greedy."""
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+
+    ref = np.asarray(generate(model, v, prompt, max_new_tokens=10))
+    out = np.asarray(speculative_generate(model, v, model, v, prompt,
+                                          max_new_tokens=10, k=4))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_equals_greedy_random_draft(rng):
+    """An unrelated random draft (low acceptance): rejections roll the
+    caches back and the output is STILL exactly the target's greedy
+    decode — the correctness contract of speculative decoding."""
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 5)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    draft = GPTModel(cfg)
+    dv = draft.init(jax.random.PRNGKey(99), prompt)   # different weights
+
+    ref = np.asarray(generate(model, v, prompt, max_new_tokens=9))
+    out = np.asarray(speculative_generate(model, v, draft, dv, prompt,
+                                          max_new_tokens=9, k=3))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_llama_gqa_window_draft(rng):
+    """Target Llama (GQA + sliding window) with a differently-seeded
+    draft; exactness must hold through the windowed decode path."""
+    cfg = llama_tiny_config(sliding_window=6)
+    model = LlamaModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    draft = LlamaModel(cfg)
+    dv = draft.init(jax.random.PRNGKey(7), prompt)
+
+    ref = np.asarray(generate(model, v, prompt, max_new_tokens=8))
+    out = np.asarray(speculative_generate(model, v, draft, dv, prompt,
+                                          max_new_tokens=8, k=4))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_speculative_validates_position_slack(rng):
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    with pytest.raises(ValueError):  # total + k must fit the position table
+        speculative_generate(model, v, model, v, prompt,
+                             max_new_tokens=cfg.max_position_embeddings - 4,
+                             k=4)
+    with pytest.raises(ValueError):
+        speculative_generate(model, v, model, v, prompt, max_new_tokens=4,
+                             k=1)
