@@ -39,6 +39,14 @@ from apex_tpu.models.llama import (  # noqa: F401
     llama_loss,
     llama_tiny_config,
 )
+from apex_tpu.models import t5  # noqa: F401
+from apex_tpu.models.t5 import (  # noqa: F401
+    T5Config,
+    T5Model,
+    t5_generate,
+    t5_loss,
+    t5_tiny_config,
+)
 from apex_tpu.models.bert import (  # noqa: F401
     BertConfig,
     BertForPreTraining,

@@ -160,7 +160,8 @@ def seal_cache(cache):
     return dict(cache, len=jnp.asarray(cache["len"], jnp.int32))
 
 
-def cached_attention(q, lc, *, window: Optional[int] = None):
+def cached_attention(q, lc, *, window: Optional[int] = None, bias=None,
+                     scale: Optional[float] = None):
     """Masked dot-product attention of a ``(b, h, s, d)`` query chunk at
     absolute positions ``[len, len+s)`` against the full cache buffer.
 
@@ -168,7 +169,10 @@ def cached_attention(q, lc, *, window: Optional[int] = None):
     global position p iff ``p - window < j <= p``), which simultaneously
     hides the not-yet-written tail of the static buffer. GQA contracts the
     grouped queries against the unexpanded kv-head cache. fp32 scores and
-    accumulation (same numerics contract as the flash kernel)."""
+    accumulation (same numerics contract as the flash kernel). ``bias``
+    (broadcastable to ``(b, h, s, t_max)``, e.g. T5 relative-position
+    bias) adds to the scaled scores before masking — the cached analog of
+    the flash kernel's additive slot."""
     k, v, t0 = lc["k"], lc["v"], lc["len"]
     b, h, s, d = q.shape
     kv = k.shape[1]
@@ -178,7 +182,12 @@ def cached_attention(q, lc, *, window: Optional[int] = None):
     qf = q.reshape(b, kv, rep, s, d).astype(jnp.float32)
     scores = jnp.einsum("bkrsd,bktd->bkrst", qf, k.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
-    scores = scores * (1.0 / jnp.sqrt(jnp.float32(d)))
+    scores = scores * (jnp.float32(scale) if scale is not None
+                       else 1.0 / jnp.sqrt(jnp.float32(d)))
+    if bias is not None:
+        bb = jnp.broadcast_to(bias.astype(jnp.float32),
+                              (b, h, s, t_max))
+        scores = scores + bb.reshape(b, kv, rep, s, t_max)
     pos_q = t0 + jnp.arange(s, dtype=jnp.int32)[:, None]      # (s, 1)
     pos_k = jnp.arange(t_max, dtype=jnp.int32)[None, :]       # (1, T)
     mask = pos_k <= pos_q
@@ -231,6 +240,61 @@ def _sample_token(last_logits, step_key, *, temperature, top_k, top_p,
     return jax.random.categorical(step_key, logits, axis=-1).astype(jnp.int32)
 
 
+def validate_sampling(temperature, top_k, top_p, rng):
+    """Shared sampling-knob validation for the decode loops; returns the
+    effective rng."""
+    if temperature and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an explicit rng")
+    if not temperature and (top_k is not None or top_p is not None
+                            or rng is not None):
+        # the mirror-image misuse: sampling knobs with greedy decoding
+        # would be silently ignored
+        raise ValueError("top_k/top_p/rng require temperature > 0 (greedy "
+                         "decoding at temperature=0 ignores them)")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p <= 0 would otherwise hit the exclusive-cumsum edge (no row
+        # below the threshold -> index -1 -> smallest logit as cutoff) and
+        # silently sample the FULL distribution
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    return rng if rng is not None else jax.random.PRNGKey(0)
+
+
+def decode_loop(step_apply, prefill_logits, cache, max_new_tokens: int, *,
+                temperature, top_k, top_p, rng, eos_token_id, axis_name):
+    """The shared sampled-decode scan (decoder-only AND encoder-decoder
+    models): ``step_apply(tok_(b,), cache) -> (logits_(b,1,V), cache)``.
+    Samples the first token from ``prefill_logits[:, -1]``, then scans
+    single-token steps; EOS rows keep emitting EOS. Returns the
+    ``(b, max_new_tokens)`` generated tokens."""
+    b = prefill_logits.shape[0]
+
+    def sample(last, i):
+        return _sample_token(last, jax.random.fold_in(rng, i),
+                             temperature=temperature, top_k=top_k,
+                             top_p=top_p, axis_name=axis_name)
+
+    tok0 = sample(prefill_logits[:, -1], 0)
+    done0 = (tok0 == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((b,), bool)
+
+    def step(carry, i):
+        cache, tok, done = carry
+        step_logits, cache = step_apply(tok, cache)
+        nxt = sample(step_logits[:, 0], i)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = jnp.logical_or(done, nxt == eos_token_id)
+        return (cache, nxt, done), nxt
+
+    if max_new_tokens > 1:
+        _, rest = lax.scan(step, (cache, tok0, done0),
+                           jnp.arange(1, max_new_tokens))
+        return jnp.concatenate([tok0[:, None], rest.T], axis=1)
+    return tok0[:, None]
+
+
 def generate(model, variables, prompt_ids, max_new_tokens: int, *,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
@@ -256,51 +320,16 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
     t_max = total if max_len is None else int(max_len)
     if t_max < total:
         raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
-    if temperature and rng is None:
-        raise ValueError("sampling (temperature > 0) needs an explicit rng")
-    if not temperature and (top_k is not None or top_p is not None
-                            or rng is not None):
-        # the mirror-image misuse: sampling knobs with greedy decoding
-        # would be silently ignored
-        raise ValueError("top_k/top_p/rng require temperature > 0 (greedy "
-                         "decoding at temperature=0 ignores them)")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        # top_p <= 0 would otherwise hit the exclusive-cumsum edge (no row
-        # below the threshold -> index -1 -> smallest logit as cutoff) and
-        # silently sample the FULL distribution
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = validate_sampling(temperature, top_k, top_p, rng)
 
     cache = init_cache(cfg, b, t_max)
     logits, cache = model.apply(variables, prompt_ids, cache=cache)
     cache = seal_cache(cache)  # static len -> scan-carry representation
 
-    def sample(last, i):
-        return _sample_token(last, jax.random.fold_in(rng, i),
-                             temperature=temperature, top_k=top_k,
-                             top_p=top_p, axis_name=axis_name)
-
-    tok0 = sample(logits[:, -1], 0)
-    done0 = (tok0 == eos_token_id) if eos_token_id is not None \
-        else jnp.zeros((b,), bool)
-
-    def step(carry, i):
-        cache, tok, done = carry
-        step_logits, cache = model.apply(variables, tok[:, None], cache=cache)
-        nxt = sample(step_logits[:, 0], i)
-        if eos_token_id is not None:
-            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
-            done = jnp.logical_or(done, nxt == eos_token_id)
-        return (cache, nxt, done), nxt
-
-    if max_new_tokens > 1:
-        _, rest = lax.scan(step, (cache, tok0, done0),
-                           jnp.arange(1, max_new_tokens))
-        gen = jnp.concatenate([tok0[:, None], rest.T], axis=1)
-    else:
-        gen = tok0[:, None]
+    gen = decode_loop(
+        lambda tok, c: model.apply(variables, tok[:, None], cache=c),
+        logits, cache, max_new_tokens, temperature=temperature, top_k=top_k,
+        top_p=top_p, rng=rng, eos_token_id=eos_token_id, axis_name=axis_name)
     return jnp.concatenate([prompt_ids.astype(jnp.int32), gen], axis=1)
 
 

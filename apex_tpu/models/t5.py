@@ -1,0 +1,428 @@
+"""T5-family encoder-decoder (RMSNorm, relative-position bias, bias-free
+linears, unscaled attention), tensor-parallel.
+
+No reference analog (apex ships no models) — the third first-class family,
+and the first ENCODER-DECODER: it exercises the components the decoder-only
+families don't: non-causal flash attention, cross-attention through the
+flash kernel's separate kv operands (the `contrib.multihead_attn` Encdec
+role in a full model), the kernel's ADDITIVE BIAS slot carrying T5's
+bucketed relative-position bias (reference analog of that slot:
+fmha/fast_multihead_attn additive masks), and encoder-KV caching at decode
+time.
+
+T5 specifics implemented: pre-RMSNorm everywhere, NO attention scaling
+(T5 folds 1/sqrt(d) into init; ``scale=1.0`` on every kernel call),
+bias-free linears, a SHARED relative-position bias table (one embedding,
+computed once per forward, added in every self-attention layer; none in
+cross-attention), and a relu or gated-gelu (v1.1) FFN.
+
+Parallel contract matches GPT/Llama: Column/RowParallel linears inside
+shard_map with ``model`` bound divide heads and FFN; the vocab-parallel
+LM head feeds ``lm_token_loss``. The relative-bias table is replicated
+and sliced to the local head shard by rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.models.generation import (cached_attention, is_static_prefill,
+                                        update_layer_cache)
+from apex_tpu.models.gpt import lm_token_loss
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.ops import flash_attention
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_is_bound as _axis_bound,
+)
+from apex_tpu.transformer.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_ff: int = 2048
+    num_layers: int = 6                  # encoder AND decoder depth
+    num_heads: int = 8
+    head_dim: int = 64                   # T5 decouples d_kv from d_model
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    rms_eps: float = 1e-6
+    ff_act: str = "relu"                 # "relu" (v1.0) | "gated-gelu" (v1.1)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    tensor_parallel_size: int = 1
+    decoder_start_token_id: int = 0      # T5 convention: pad id starts decode
+    # v1.0 ties the LM head to the shared embedding with the d_model^-0.5
+    # rescale; v1.1 (gated-gelu) unties it and drops the rescale
+    tie_word_embeddings: bool = True
+    # practical cap for the decode cache/bias tables (T5's rel-bias has no
+    # hard limit; this bounds the static decode buffers)
+    max_position_embeddings: int = 512
+
+
+def t5_tiny_config(**overrides) -> T5Config:
+    base = T5Config(vocab_size=128, d_model=64, d_ff=128, num_layers=2,
+                    num_heads=4, head_dim=16, max_position_embeddings=128,
+                    dtype=jnp.float32)
+    return dataclasses.replace(base, **overrides)
+
+
+def relative_position_bucket(rel, *, bidirectional: bool, num_buckets: int,
+                             max_distance: int):
+    """T5's log-binned bucket of ``rel = k_pos - q_pos`` (the HF/mesh-tf
+    formula): half the buckets exact, half log-spaced up to max_distance."""
+    ret = jnp.zeros_like(rel)
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (rel > 0).astype(rel.dtype) * num_buckets
+        n = jnp.abs(rel)
+    else:
+        n = jnp.maximum(-rel, 0)         # causal: only the past is bucketed
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    # guard log(0); masked to the exact branch anyway
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)).astype(rel.dtype)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class T5RelativeBias(nn.Module):
+    """The shared bias table: (num_buckets, num_heads) -> additive bias
+    ``(1, h_local, s_q, s_k)`` for self-attention. Replicated table,
+    sliced to this rank's head shard inside a TP region."""
+
+    config: T5Config
+    bidirectional: bool = True
+
+    @nn.compact
+    def __call__(self, q_pos, k_pos):
+        cfg = self.config
+        table = self.param(
+            "rel_attn_bias", nn.initializers.normal(0.02),
+            (cfg.relative_attention_num_buckets, cfg.num_heads),
+            cfg.param_dtype)
+        rel = k_pos[None, :] - q_pos[:, None]              # (s_q, s_k)
+        bucket = relative_position_bucket(
+            rel.astype(jnp.int32), bidirectional=self.bidirectional,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance)
+        bias = table[bucket]                               # (s_q, s_k, H)
+        bias = bias.transpose(2, 0, 1)[None]               # (1, H, s_q, s_k)
+        tp = cfg.tensor_parallel_size
+        if tp > 1 and _axis_bound(MODEL_AXIS):
+            h_local = divide(cfg.num_heads, tp)
+            r = lax.axis_index(MODEL_AXIS)
+            bias = lax.dynamic_slice_in_dim(bias, r * h_local, h_local,
+                                            axis=1)
+        return bias
+
+
+class _T5SelfAttention(nn.Module):
+    """Bias-free QKV + out projections, unscaled flash attention with the
+    shared relative bias; cache-aware for incremental decoding."""
+
+    config: T5Config
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, h, bias, cache=None):
+        cfg = self.config
+        tp = cfg.tensor_parallel_size
+        h_local = divide(cfg.num_heads, tp)
+        d = cfg.head_dim
+        inner = cfg.num_heads * d
+        b, s, _ = h.shape
+
+        qkv = ColumnParallelLinear(
+            cfg.d_model, 3 * inner, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_bhsd(t):
+            return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
+
+        if cache is not None:
+            prefill = is_static_prefill(cache, s)
+            cache = update_layer_cache(cache, to_bhsd(k), to_bhsd(v))
+            if prefill:
+                ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                      bias=bias, causal=self.causal,
+                                      scale=1.0)
+            else:
+                ctx = cached_attention(to_bhsd(q), cache, bias=bias,
+                                       scale=1.0)
+        else:
+            ctx = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                                  bias=bias, causal=self.causal, scale=1.0)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
+        out = RowParallelLinear(
+            inner, cfg.d_model, bias=False, input_is_parallel=True,
+            world_size=tp, params_dtype=cfg.param_dtype, name="out")(ctx)
+        return (out, cache) if cache is not None else out
+
+
+class _T5CrossAttention(nn.Module):
+    """Decoder-to-encoder attention. At decode time the encoder K/V are
+    projected ONCE (on the first call, when the cache view lacks them) and
+    reused every step — the cross-attention analog of the KV cache."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, h, enc, cache=None):
+        cfg = self.config
+        tp = cfg.tensor_parallel_size
+        h_local = divide(cfg.num_heads, tp)
+        d = cfg.head_dim
+        inner = cfg.num_heads * d
+        b, s, _ = h.shape
+        s_enc = enc.shape[1]
+
+        q = ColumnParallelLinear(
+            cfg.d_model, inner, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="q")(h)
+        kv_proj = ColumnParallelLinear(
+            cfg.d_model, 2 * inner, bias=False, gather_output=False,
+            world_size=tp, params_dtype=cfg.param_dtype, name="kv")
+
+        def to_bhsd(t, length):
+            return t.reshape(b, length, h_local, d).transpose(0, 2, 1, 3)
+
+        if cache is not None and "ck" in cache:
+            ck, cv = cache["ck"], cache["cv"]
+        else:
+            kv = kv_proj(enc)
+            k, v = jnp.split(kv, 2, axis=-1)
+            ck, cv = to_bhsd(k, s_enc), to_bhsd(v, s_enc)
+            if cache is not None:
+                cache = dict(cache, ck=ck, cv=cv)
+        ctx = flash_attention(to_bhsd(q, s), ck, cv, scale=1.0)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
+        out = RowParallelLinear(
+            inner, cfg.d_model, bias=False, input_is_parallel=True,
+            world_size=tp, params_dtype=cfg.param_dtype, name="out")(ctx)
+        return (out, cache) if cache is not None else out
+
+
+class _T5FFN(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        tp = cfg.tensor_parallel_size
+        if cfg.ff_act == "gated-gelu":
+            # v1.1: gate+up in one column-parallel GEMM (the Llama pattern)
+            wi = ColumnParallelLinear(
+                cfg.d_model, 2 * cfg.d_ff, bias=False, gather_output=False,
+                world_size=tp, params_dtype=cfg.param_dtype, name="wi")(h)
+            gate, up = jnp.split(wi, 2, axis=-1)
+            act = jax.nn.gelu(gate, approximate=True) * up
+        elif cfg.ff_act == "relu":
+            act = jax.nn.relu(ColumnParallelLinear(
+                cfg.d_model, cfg.d_ff, bias=False, gather_output=False,
+                world_size=tp, params_dtype=cfg.param_dtype, name="wi")(h))
+        else:
+            raise ValueError(f"unknown ff_act {cfg.ff_act!r}")
+        return RowParallelLinear(
+            cfg.d_ff, cfg.d_model, bias=False, input_is_parallel=True,
+            world_size=tp, params_dtype=cfg.param_dtype, name="wo")(act)
+
+
+class T5EncoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        h = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps, name="attn_norm")(x)
+        x = x + _T5SelfAttention(cfg, causal=False, name="self_attn")(
+            h.astype(dt), bias).astype(x.dtype)
+        h = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps, name="ffn_norm")(x)
+        return x + _T5FFN(cfg, name="ffn")(h.astype(dt)).astype(x.dtype)
+
+
+class T5DecoderBlock(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc, bias, cache=None):
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        h = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps, name="attn_norm")(x)
+        sa = _T5SelfAttention(cfg, causal=True, name="self_attn")
+        if cache is None:
+            attn = sa(h.astype(dt), bias)
+        else:
+            attn, cache = sa(h.astype(dt), bias, cache=cache)
+        x = x + attn.astype(x.dtype)
+        h = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps, name="cross_norm")(x)
+        ca = _T5CrossAttention(cfg, name="cross_attn")
+        if cache is None:
+            cross = ca(h.astype(dt), enc)
+        else:
+            cross, cache = ca(h.astype(dt), enc, cache=cache)
+        x = x + cross.astype(x.dtype)
+        h = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps, name="ffn_norm")(x)
+        out = x + _T5FFN(cfg, name="ffn")(h.astype(dt)).astype(x.dtype)
+        return out if cache is None else (out, cache)
+
+
+class T5Model(nn.Module):
+    """Encoder-decoder LM. ``__call__(encoder_ids, decoder_ids)`` returns
+    vocab-PARALLEL logits over the decoder positions (teacher forcing);
+    ``encode``/``decode`` split the two halves for generation
+    (models/t5.py:t5_generate). The LM head is the tied embedding scaled
+    by d_model^-0.5 (the T5 tying convention)."""
+
+    config: T5Config
+
+    def setup(self):
+        cfg = self.config
+        self.shared = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.d_model, world_size=cfg.tensor_parallel_size,
+            params_dtype=cfg.param_dtype, name="shared")
+        self.enc_bias = T5RelativeBias(cfg, bidirectional=True,
+                                       name="enc_rel_bias")
+        self.dec_bias = T5RelativeBias(cfg, bidirectional=False,
+                                       name="dec_rel_bias")
+        self.enc_blocks = [T5EncoderBlock(cfg, name=f"enc_{i}")
+                           for i in range(cfg.num_layers)]
+        self.dec_blocks = [T5DecoderBlock(cfg, name=f"dec_{i}")
+                           for i in range(cfg.num_layers)]
+        self.enc_norm = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps,
+                                     name="enc_final_norm")
+        self.dec_norm = FusedRMSNorm(cfg.d_model, eps=cfg.rms_eps,
+                                     name="dec_final_norm")
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                cfg.d_model, cfg.vocab_size, bias=False, gather_output=False,
+                world_size=cfg.tensor_parallel_size,
+                params_dtype=cfg.param_dtype, name="lm_head")
+
+    def _lm_logits(self, x):
+        """T5 head convention: tied embedding scaled by d_model^-0.5
+        (v1.0) or an independent unscaled lm_head (v1.1)."""
+        cfg = self.config
+        if cfg.tie_word_embeddings:
+            return self.shared.attend(x * (cfg.d_model ** -0.5))
+        return self.lm_head(x)
+
+    def encode(self, encoder_ids):
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        s = encoder_ids.shape[1]
+        pos = jnp.arange(s, dtype=jnp.int32)
+        bias = self.enc_bias(pos, pos).astype(dt)
+        x = self.shared(encoder_ids).astype(dt)
+        for blk in self.enc_blocks:
+            x = blk(x, bias)
+        return self.enc_norm(x).astype(dt)
+
+    def decode(self, decoder_ids, enc, cache=None):
+        """Teacher-forced (cache=None) or incremental decode against a
+        computed encoder representation. Cache layout matches
+        models/generation.py, with per-layer ``ck``/``cv`` encoder K/V
+        added by the first call."""
+        cfg = self.config
+        dt = resolve_compute_dtype(cfg.dtype)
+        s = decoder_ids.shape[1]
+        x = self.shared(decoder_ids).astype(dt)
+        if cache is None:
+            pos = jnp.arange(s, dtype=jnp.int32)
+            bias = self.dec_bias(pos, pos).astype(dt)
+            for blk in self.dec_blocks:
+                x = blk(x, enc, bias)
+        else:
+            from apex_tpu.models.generation import advance_cache, layer_cache
+
+            t0 = cache["len"]
+            t_max = cache["layers"][0]["k"].shape[2]
+            q_pos = t0 + jnp.arange(s, dtype=jnp.int32)
+            k_pos = jnp.arange(t_max, dtype=jnp.int32)
+            bias = self.dec_bias(q_pos, k_pos).astype(dt)
+            if is_static_prefill(layer_cache(cache, 0), s):
+                # the flash prefill sees only the chunk's keys, not the
+                # whole buffer: slice the bias to the chunk square
+                bias_prefill = bias[:, :, :, :s]
+            new_layers = []
+            for i, blk in enumerate(self.dec_blocks):
+                lc = layer_cache(cache, i)
+                blk_bias = bias_prefill if is_static_prefill(lc, s) else bias
+                x, lc = blk(x, enc, blk_bias, cache=lc)
+                new_layers.append(lc)
+            x = self.dec_norm(x).astype(dt)
+            logits = self._lm_logits(x)
+            new_cache = advance_cache(cache, new_layers, s)
+            # ck/cv ride each layer dict (advance_cache keeps only k/v)
+            new_cache["layers"] = [
+                {"k": lc["k"], "v": lc["v"], "ck": lc["ck"], "cv": lc["cv"]}
+                for lc in new_layers]
+            return logits, new_cache
+        x = self.dec_norm(x).astype(dt)
+        return self._lm_logits(x)
+
+    def __call__(self, encoder_ids, decoder_ids):
+        return self.decode(decoder_ids, self.encode(encoder_ids))
+
+
+def t5_loss(model: T5Model, variables, encoder_ids, decoder_ids, labels,
+            axis_name: str = MODEL_AXIS):
+    """Mean token loss over decoder positions (teacher forcing)."""
+    logits = model.apply(variables, encoder_ids, decoder_ids)
+    return lm_token_loss(logits, labels, axis_name=axis_name)
+
+
+def t5_generate(model: T5Model, variables, encoder_ids,
+                max_new_tokens: int, *, temperature: float = 0.0,
+                top_k=None, top_p=None, rng=None, eos_token_id=None,
+                axis_name: str = MODEL_AXIS):
+    """Encode once, then autoregressively decode from
+    ``decoder_start_token_id``: the encoder-decoder analog of
+    ``generation.generate`` (same static cache, flash/dense split, and
+    sampling). Returns ``(batch, max_new_tokens)`` decoder tokens (the
+    start token is not included)."""
+    from apex_tpu.models.generation import (decode_loop, init_cache,
+                                            seal_cache, validate_sampling)
+
+    cfg = model.config
+    b = encoder_ids.shape[0]
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if max_new_tokens + 1 > cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} exceeds the decode cap "
+            f"max_position_embeddings={cfg.max_position_embeddings}")
+    rng = validate_sampling(temperature, top_k, top_p, rng)
+
+    enc = model.apply(variables, encoder_ids, method=T5Model.encode)
+    cache = init_cache(cfg, b, max_new_tokens + 1)
+    start = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
+    logits, cache = model.apply(variables, start, enc, cache,
+                                method=T5Model.decode)
+    cache = seal_cache(cache)
+
+    return decode_loop(
+        lambda tok, c: model.apply(variables, tok[:, None], enc, c,
+                                   method=T5Model.decode),
+        logits, cache, max_new_tokens, temperature=temperature, top_k=top_k,
+        top_p=top_p, rng=rng, eos_token_id=eos_token_id, axis_name=axis_name)
