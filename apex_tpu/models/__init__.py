@@ -32,6 +32,8 @@ from apex_tpu.models.hf_convert import (  # noqa: F401
     gpt2_params_from_hf,
     llama_config_from_hf,
     llama_params_from_hf,
+    t5_config_from_hf,
+    t5_params_from_hf,
 )
 from apex_tpu.models.llama import (  # noqa: F401
     LlamaConfig,

@@ -136,20 +136,22 @@ def update_layer_cache(lc, k_chunk, v_chunk):
     ``check_chunk_bounds``)."""
     t0 = lc["len"]
     start = (0, 0, t0, 0)
-    return {
-        "k": lax.dynamic_update_slice(lc["k"], k_chunk.astype(lc["k"].dtype),
-                                      start),
-        "v": lax.dynamic_update_slice(lc["v"], v_chunk.astype(lc["v"].dtype),
-                                      start),
-        "len": t0,
-    }
+    out = dict(lc)  # preserve extra entries (e.g. T5's cross ck/cv)
+    out["k"] = lax.dynamic_update_slice(lc["k"],
+                                        k_chunk.astype(lc["k"].dtype), start)
+    out["v"] = lax.dynamic_update_slice(lc["v"],
+                                        v_chunk.astype(lc["v"].dtype), start)
+    return out
 
 
 def advance_cache(cache, new_layers, s: int):
     """Model-level reassembly after all blocks ran a chunk of length s.
-    Plain-int arithmetic keeps a static length static across chunks."""
+    Plain-int arithmetic keeps a static length static across chunks; the
+    per-layer entries keep everything but the (shared) length — including
+    model-specific extras like T5's cross ``ck``/``cv``."""
     return {
-        "layers": [{"k": lc["k"], "v": lc["v"]} for lc in new_layers],
+        "layers": [{k: v for k, v in lc.items() if k != "len"}
+                   for lc in new_layers],
         "len": cache["len"] + s,
     }
 

@@ -310,3 +310,125 @@ def bert_params_from_hf(state_dict, cfg) -> dict:
         raise ValueError(
             f"unconsumed checkpoint tensors: {sorted(leftover)[:8]}")
     return params
+
+
+def t5_config_from_hf(hf_config):
+    """Map a ``transformers.T5Config`` to :class:`T5Config` (fp32). Fails
+    loud on variants T5Model does not express."""
+    from apex_tpu.models.t5 import T5Config
+
+    ff = getattr(hf_config, "feed_forward_proj", "relu")
+    if ff not in ("relu", "gated-gelu"):
+        raise NotImplementedError(
+            f"feed_forward_proj={ff!r}: T5Model implements relu (v1.0) and "
+            "gated-gelu (v1.1) only")
+    dec_layers = getattr(hf_config, "num_decoder_layers",
+                         hf_config.num_layers)
+    if dec_layers != hf_config.num_layers:
+        raise NotImplementedError(
+            f"num_decoder_layers={dec_layers} != num_layers="
+            f"{hf_config.num_layers}: T5Model shares one depth")
+    return T5Config(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.d_model,
+        d_ff=hf_config.d_ff,
+        num_layers=hf_config.num_layers,
+        num_heads=hf_config.num_heads,
+        head_dim=hf_config.d_kv,
+        relative_attention_num_buckets=
+            hf_config.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(
+            hf_config, "relative_attention_max_distance", 128),
+        rms_eps=hf_config.layer_norm_epsilon,
+        ff_act=ff,
+        dtype=jnp.float32,
+        decoder_start_token_id=getattr(
+            hf_config, "decoder_start_token_id", 0) or 0,
+        tie_word_embeddings=bool(
+            getattr(hf_config, "tie_word_embeddings", True)),
+    )
+
+
+def t5_params_from_hf(state_dict, cfg) -> dict:
+    """Convert a ``T5ForConditionalGeneration.state_dict()`` into the
+    ``T5Model`` param tree (tp=1 layout). Fused layouts: self-attn
+    ``qkv`` = [Q | K | V] rows, cross-attn ``kv`` = [K | V] rows,
+    gated-gelu ``wi`` = [wi_0 | wi_1] rows."""
+    if cfg.tensor_parallel_size != 1:
+        raise NotImplementedError(
+            "t5_params_from_hf emits the tp=1 layout; convert at tp=1 and "
+            "slice per rank (fused projections need per-shard interleaving)")
+    consumed = set()
+
+    def t(name):
+        return _fetch(state_dict, consumed, name)
+
+    def ffn(p):
+        if cfg.ff_act == "gated-gelu":
+            wi = jnp.concatenate([t(p + "DenseReluDense.wi_0.weight"),
+                                  t(p + "DenseReluDense.wi_1.weight")],
+                                 axis=0)
+        else:
+            wi = t(p + "DenseReluDense.wi.weight")
+        return {"wi": {"weight": wi},
+                "wo": {"weight": t(p + "DenseReluDense.wo.weight")}}
+
+    params = {
+        "shared": {"weight": t("shared.weight")},
+        "enc_rel_bias": {"rel_attn_bias": t(
+            "encoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight")},
+        "dec_rel_bias": {"rel_attn_bias": t(
+            "decoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight")},
+        "enc_final_norm": {"weight": t("encoder.final_layer_norm.weight")},
+        "dec_final_norm": {"weight": t("decoder.final_layer_norm.weight")},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"weight": t("lm_head.weight")}
+    for i in range(cfg.num_layers):
+        e = f"encoder.block.{i}.layer."
+        params[f"enc_{i}"] = {
+            "attn_norm": {"weight": t(e + "0.layer_norm.weight")},
+            "self_attn": {
+                "qkv": {"weight": jnp.concatenate(
+                    [t(e + "0.SelfAttention.q.weight"),
+                     t(e + "0.SelfAttention.k.weight"),
+                     t(e + "0.SelfAttention.v.weight")], axis=0)},
+                "out": {"weight": t(e + "0.SelfAttention.o.weight")},
+            },
+            "ffn_norm": {"weight": t(e + "1.layer_norm.weight")},
+            "ffn": ffn(e + "1."),
+        }
+        d = f"decoder.block.{i}.layer."
+        params[f"dec_{i}"] = {
+            "attn_norm": {"weight": t(d + "0.layer_norm.weight")},
+            "self_attn": {
+                "qkv": {"weight": jnp.concatenate(
+                    [t(d + "0.SelfAttention.q.weight"),
+                     t(d + "0.SelfAttention.k.weight"),
+                     t(d + "0.SelfAttention.v.weight")], axis=0)},
+                "out": {"weight": t(d + "0.SelfAttention.o.weight")},
+            },
+            "cross_norm": {"weight": t(d + "1.layer_norm.weight")},
+            "cross_attn": {
+                "q": {"weight": t(d + "1.EncDecAttention.q.weight")},
+                "kv": {"weight": jnp.concatenate(
+                    [t(d + "1.EncDecAttention.k.weight"),
+                     t(d + "1.EncDecAttention.v.weight")], axis=0)},
+                "out": {"weight": t(d + "1.EncDecAttention.o.weight")},
+            },
+            "ffn_norm": {"weight": t(d + "2.layer_norm.weight")},
+            "ffn": ffn(d + "2."),
+        }
+    # shared-embedding aliases and tied heads are the only legal leftovers
+    ignorable = {k for k in state_dict
+                 if k in ("encoder.embed_tokens.weight",
+                          "decoder.embed_tokens.weight")
+                 or (cfg.tie_word_embeddings and k == "lm_head.weight")}
+    leftover = set(state_dict) - consumed - ignorable
+    if leftover:
+        raise ValueError(
+            f"unconsumed checkpoint tensors (conversion would silently "
+            f"drop them): {sorted(leftover)[:8]}")
+    return params

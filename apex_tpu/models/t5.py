@@ -353,9 +353,11 @@ class T5Model(nn.Module):
             for blk in self.dec_blocks:
                 x = blk(x, enc, bias)
         else:
-            from apex_tpu.models.generation import advance_cache, layer_cache
+            from apex_tpu.models.generation import (advance_cache,
+                                                    check_chunk_bounds,
+                                                    layer_cache)
 
-            t0 = cache["len"]
+            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
             t_max = cache["layers"][0]["k"].shape[2]
             q_pos = t0 + jnp.arange(s, dtype=jnp.int32)
             k_pos = jnp.arange(t_max, dtype=jnp.int32)
@@ -372,12 +374,8 @@ class T5Model(nn.Module):
                 new_layers.append(lc)
             x = self.dec_norm(x).astype(dt)
             logits = self._lm_logits(x)
-            new_cache = advance_cache(cache, new_layers, s)
-            # ck/cv ride each layer dict (advance_cache keeps only k/v)
-            new_cache["layers"] = [
-                {"k": lc["k"], "v": lc["v"], "ck": lc["ck"], "cv": lc["cv"]}
-                for lc in new_layers]
-            return logits, new_cache
+            # ck/cv ride each layer dict (advance_cache keeps extras)
+            return logits, advance_cache(cache, new_layers, s)
         x = self.dec_norm(x).astype(dt)
         return self._lm_logits(x)
 

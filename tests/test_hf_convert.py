@@ -179,3 +179,45 @@ def test_bert_logits_match_transformers(rng):
     np.testing.assert_allclose(np.asarray(nsp),
                                out.seq_relationship_logits.numpy(),
                                rtol=3e-4, atol=3e-4)
+
+
+def _t5_hf_pair(ff="relu", tie=True):
+    from transformers import T5Config as HFT5Config
+    from transformers import T5ForConditionalGeneration
+
+    hf_cfg = HFT5Config(vocab_size=128, d_model=64, d_kv=16, d_ff=128,
+                        num_layers=2, num_heads=4,
+                        relative_attention_num_buckets=32,
+                        relative_attention_max_distance=128,
+                        feed_forward_proj=ff, tie_word_embeddings=tie,
+                        dropout_rate=0.0, decoder_start_token_id=0)
+    torch.manual_seed(0)
+    hf = T5ForConditionalGeneration(hf_cfg).eval()
+    return hf_cfg, hf
+
+
+@pytest.mark.parametrize("ff,tie", [("relu", True), ("gated-gelu", False)])
+def test_t5_logits_match_transformers(rng, ff, tie):
+    """v1.0 (relu, tied+rescaled head) and v1.1 (gated-gelu, untied):
+    teacher-forced logits must match torch's independent implementation —
+    relative-bias bucketing, unscaled attention, cross-attention, fused
+    qkv/kv/wi layouts and the head convention in one assertion."""
+    from apex_tpu.models.hf_convert import (t5_config_from_hf,
+                                            t5_params_from_hf)
+    from apex_tpu.models.t5 import T5Model
+
+    hf_cfg, hf = _t5_hf_pair(ff=ff, tie=tie)
+    cfg = t5_config_from_hf(hf_cfg)
+    assert cfg.ff_act == ff and cfg.tie_word_embeddings == tie
+    params = t5_params_from_hf(hf.state_dict(), cfg)
+    model = T5Model(cfg)
+
+    enc_ids = rng.integers(0, hf_cfg.vocab_size, (2, 12))
+    dec_ids = rng.integers(0, hf_cfg.vocab_size, (2, 7))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(enc_ids),
+                 decoder_input_ids=torch.from_numpy(dec_ids)).logits.numpy()
+    ours = np.asarray(model.apply({"params": params},
+                                  jnp.asarray(enc_ids, jnp.int32),
+                                  jnp.asarray(dec_ids, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)

@@ -132,6 +132,48 @@ def test_t5_generate_greedy_matches_teacher_forced(rng):
     np.testing.assert_array_equal(out, dec[:, 1:])
 
 
+def test_t5_cross_kv_projected_once(rng):
+    """After the first decode step the encoder K/V live in the cache:
+    zeroing ``enc`` must not change later step logits (the projected-once
+    contract — a recompute-from-enc bug would alter them)."""
+    cfg = t5_tiny_config()
+    model = T5Model(cfg)
+    enc_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 6)), jnp.int32)
+    dec_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 3)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), enc_ids, dec_ids)
+
+    from apex_tpu.models.generation import init_cache
+
+    enc = model.apply(v, enc_ids, method=T5Model.encode)
+    cache = init_cache(cfg, 2, 4)
+    _, cache = model.apply(v, dec_ids[:, :1], enc, cache,
+                           method=T5Model.decode)
+    assert "ck" in cache["layers"][0]
+    step_real, _ = model.apply(v, dec_ids[:, 1:2], enc, cache,
+                               method=T5Model.decode)
+    step_zero, _ = model.apply(v, dec_ids[:, 1:2], jnp.zeros_like(enc),
+                               cache, method=T5Model.decode)
+    np.testing.assert_array_equal(np.asarray(step_real),
+                                  np.asarray(step_zero))
+
+
+def test_t5_decode_bounds_raise_at_trace_time(rng):
+    """A statically out-of-range decoder chunk raises instead of letting
+    dynamic_update_slice clamp and corrupt the cache tail."""
+    cfg = t5_tiny_config()
+    model = T5Model(cfg)
+    enc_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 5)), jnp.int32)
+    dec_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 6)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), enc_ids, dec_ids)
+
+    from apex_tpu.models.generation import init_cache
+
+    enc = model.apply(v, enc_ids, method=T5Model.encode)
+    cache = init_cache(cfg, 1, 4)  # buffer smaller than the chunk
+    with pytest.raises(ValueError):
+        model.apply(v, dec_ids, enc, cache, method=T5Model.decode)
+
+
 def test_t5_v11_untied_head_cached_decode(rng):
     """v1.1 shape: gated-gelu FFN + untied lm_head, no d_model^-0.5
     rescale; cached decode must still match teacher forcing."""
