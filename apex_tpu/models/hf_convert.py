@@ -1,4 +1,5 @@
-"""HuggingFace → apex_tpu checkpoint conversion (Llama/Mistral + GPT-2).
+"""HuggingFace → apex_tpu checkpoint conversion (Llama/Mistral, GPT-2,
+BERT, T5).
 
 Beyond-reference interop: load a ``transformers`` Llama/Mistral checkpoint
 into :class:`apex_tpu.models.llama.LlamaModel`. Pure tensor relayout — the
