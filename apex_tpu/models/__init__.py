@@ -20,6 +20,7 @@ from apex_tpu.models.gpt import (  # noqa: F401
 from apex_tpu.models import generation  # noqa: F401
 from apex_tpu.models.generation import (  # noqa: F401
     generate,
+    generate_beam,
     init_cache,
     speculative_generate,
 )
@@ -45,6 +46,7 @@ from apex_tpu.models import t5  # noqa: F401
 from apex_tpu.models.t5 import (  # noqa: F401
     T5Config,
     T5Model,
+    t5_beam_search,
     t5_generate,
     t5_loss,
     t5_tiny_config,

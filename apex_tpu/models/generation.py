@@ -312,16 +312,8 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
     ``model`` axis bound (vocab-/head-sharded decode)."""
     cfg = model.config
     b, s0 = prompt_ids.shape
-    total = s0 + int(max_new_tokens)
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    if total > cfg.max_position_embeddings:
-        raise ValueError(
-            f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) exceeds "
-            f"max_position_embeddings={cfg.max_position_embeddings}")
-    t_max = total if max_len is None else int(max_len)
-    if t_max < total:
-        raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
+    t_max = validate_decode_bounds(s0, max_new_tokens,
+                                   cfg.max_position_embeddings, max_len)
     rng = validate_sampling(temperature, top_k, top_p, rng)
 
     cache = init_cache(cfg, b, t_max)
@@ -333,6 +325,177 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
         logits, cache, max_new_tokens, temperature=temperature, top_k=top_k,
         top_p=top_p, rng=rng, eos_token_id=eos_token_id, axis_name=axis_name)
     return jnp.concatenate([prompt_ids.astype(jnp.int32), gen], axis=1)
+
+
+# --- beam search -------------------------------------------------------------
+
+
+def validate_decode_bounds(s0: int, max_new_tokens: int,
+                           max_position_embeddings: int,
+                           max_len=None) -> int:
+    """Shared prompt/cap/buffer validation for the decode entry points;
+    returns the effective cache length."""
+    total = s0 + int(max_new_tokens)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if total > max_position_embeddings:
+        raise ValueError(
+            f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_position_embeddings={max_position_embeddings}")
+    t_max = total if max_len is None else int(max_len)
+    if t_max < total:
+        raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
+    return t_max
+
+
+def repeat_cache(cache, times: int):
+    """Replicate every per-sequence cache row ``times`` along the leading
+    dim (row layout ``[b0 x times, b1 x times, ...]``) — beam search
+    prefills ONCE at batch b and fans the cache out to b*W afterwards
+    instead of running W identical prompt forwards."""
+    def rep(t):
+        return jnp.repeat(t, times, axis=0) if hasattr(t, "ndim") \
+            and t.ndim >= 1 else t
+
+    return {"layers": [jax.tree.map(rep, lc) for lc in cache["layers"]],
+            "len": cache["len"]}
+
+
+def _gather_beam_cache(cache, parent, batch: int, num_beams: int):
+    """Reorder every (batch*num_beams)-leading-dim cache buffer by the
+    chosen parents — the beam-search analog of rollback: surviving beams
+    inherit their parent's K/V (and any extras like T5's cross ck/cv)."""
+    flat = (jnp.arange(batch)[:, None] * num_beams + parent).reshape(-1)
+    bw = batch * num_beams
+
+    def reorder(t):
+        return t[flat] if (hasattr(t, "ndim") and t.ndim >= 1
+                           and t.shape[0] == bw) else t
+
+    return {"layers": [jax.tree.map(reorder, lc) for lc in cache["layers"]],
+            "len": cache["len"]}
+
+
+def _gathered_log_softmax(logits, axis_name):
+    if _axis_bound(axis_name):
+        logits = gather_from_tensor_model_parallel_region(logits, axis_name)
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
+                     *, batch: int, num_beams: int, eos_token_id=None,
+                     length_penalty: float = 1.0,
+                     axis_name: str = MODEL_AXIS):
+    """Static-shape beam search over a ``(batch*num_beams)``-row cache.
+
+    The beams FOLD INTO THE BATCH dimension, so every step is one batched
+    forward (MXU-friendly) and beam reordering is a gather over the cache's
+    leading dim (``_gather_beam_cache``). Scan-collected (token, parent)
+    backpointers are unwound after the loop — no growing arrays anywhere.
+    Finished beams extend only with EOS at zero added score. Final ranking
+    divides by ``length^length_penalty`` (the HF convention; penalty 0 =
+    pure sum-logprob). Returns ``(sequences (batch, num_beams,
+    max_new_tokens), scores (batch, num_beams))``, best beam first.
+
+    ``step_apply(tokens_(batch*num_beams,), cache) -> (logits_(bw,1,V),
+    cache)`` — the same contract as ``decode_loop``; ``prefill_logits``
+    are the prompt logits with the prompt REPLICATED per beam (row layout
+    ``[b0 x W, b1 x W, ...]``)."""
+    b, w = batch, num_beams
+    neg = jnp.float32(-1e30)   # -inf breaks top_k ties; large-negative safe
+
+    logp0 = _gathered_log_softmax(prefill_logits[:, -1], axis_name)
+    vocab = logp0.shape[-1]
+    logp0 = logp0.reshape(b, w, vocab)
+    # all beams start identical: only beam 0 may seed, else W duplicates
+    seed_mask = jnp.where(jnp.arange(w)[None, :, None] == 0, 0.0, neg)
+    scores, idx = lax.top_k((logp0 + seed_mask).reshape(b, w * vocab), w)
+    tok = (idx % vocab).astype(jnp.int32)                    # (b, w)
+    parent = idx // vocab
+    cache = _gather_beam_cache(cache, parent, b, w)
+    done = (tok == eos_token_id) if eos_token_id is not None \
+        else jnp.zeros((b, w), bool)
+
+    def step(carry, _):
+        cache, scores, tok, done = carry
+        logits, cache = step_apply(tok.reshape(b * w), cache)
+        logp = _gathered_log_softmax(logits[:, 0], axis_name)
+        logp = logp.reshape(b, w, vocab)
+        if eos_token_id is not None:
+            # finished beams: EOS-extension only, at no cost — the beam
+            # persists in the pool with a frozen score
+            eos_only = jnp.full((vocab,), neg).at[eos_token_id].set(0.0)
+            logp = jnp.where(done[..., None], eos_only[None, None], logp)
+        cand = (scores[..., None] + logp).reshape(b, w * vocab)
+        scores, idx = lax.top_k(cand, w)
+        tok = (idx % vocab).astype(jnp.int32)
+        parent = idx // vocab
+        done = jnp.take_along_axis(done, parent, axis=1)
+        if eos_token_id is not None:
+            done = jnp.logical_or(done, tok == eos_token_id)
+        cache = _gather_beam_cache(cache, parent, b, w)
+        return (cache, scores, tok, done), (tok, parent)
+
+    if max_new_tokens > 1:
+        (_, scores, _, _), (toks, parents) = lax.scan(
+            step, (cache, scores, tok, done), None,
+            length=max_new_tokens - 1)
+    else:
+        toks = jnp.zeros((0, b, w), jnp.int32)
+        parents = jnp.zeros((0, b, w), jnp.int32)
+
+    # unwind backpointers (python loop over the STATIC step count)
+    seq = [None] * max_new_tokens
+    beam_idx = jnp.broadcast_to(jnp.arange(w)[None], (b, w))
+    for t in range(max_new_tokens - 1, 0, -1):
+        seq[t] = jnp.take_along_axis(toks[t - 1], beam_idx, axis=1)
+        beam_idx = jnp.take_along_axis(parents[t - 1], beam_idx, axis=1)
+    seq[0] = jnp.take_along_axis(tok, beam_idx, axis=1)
+    seqs = jnp.stack(seq, axis=-1)                           # (b, w, T)
+
+    if eos_token_id is not None and length_penalty:
+        is_eos = seqs == eos_token_id
+        # length incl. the first EOS; max_new_tokens when none
+        first_eos = jnp.argmax(is_eos, axis=-1) + 1
+        lengths = jnp.where(is_eos.any(axis=-1), first_eos, max_new_tokens)
+    else:
+        lengths = jnp.full((b, w), max_new_tokens)
+    final = scores / (lengths.astype(jnp.float32) ** jnp.float32(
+        length_penalty))
+    order = jnp.argsort(-final, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    return seqs, jnp.take_along_axis(final, order, axis=1)
+
+
+def generate_beam(model, variables, prompt_ids, max_new_tokens: int, *,
+                  num_beams: int, eos_token_id=None,
+                  length_penalty: float = 1.0, max_len=None,
+                  axis_name: str = MODEL_AXIS):
+    """Beam-search decoding for the decoder-only families: replicate the
+    prompt per beam, prefill once, run ``beam_search_loop``. Returns
+    ``(sequences (b, num_beams, prompt+max_new), scores (b, num_beams))``,
+    best beam first (prompt included in the sequences)."""
+    cfg = model.config
+    b, s0 = prompt_ids.shape
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    t_max = validate_decode_bounds(s0, max_new_tokens,
+                                   cfg.max_position_embeddings, max_len)
+
+    # prefill ONCE at batch b; the beams only diverge after the first
+    # expansion, so the cache/logits fan out by replication
+    cache = init_cache(cfg, b, t_max)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache)
+    cache = seal_cache(repeat_cache(cache, num_beams))
+    logits = jnp.repeat(logits[:, -1:], num_beams, axis=0)   # (b*w, 1, V)
+    seqs, scores = beam_search_loop(
+        lambda tok, c: model.apply(variables, tok[:, None], cache=c),
+        logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
+        eos_token_id=eos_token_id, length_penalty=length_penalty,
+        axis_name=axis_name)
+    prompt_rep = jnp.broadcast_to(prompt_ids[:, None].astype(jnp.int32),
+                                  (b, num_beams, s0))
+    return jnp.concatenate([prompt_rep, seqs], axis=-1), scores
 
 
 # --- speculative decoding ----------------------------------------------------

@@ -390,6 +390,17 @@ def t5_loss(model: T5Model, variables, encoder_ids, decoder_ids, labels,
     return lm_token_loss(logits, labels, axis_name=axis_name)
 
 
+def _validate_t5_decode(cfg: T5Config, max_new_tokens: int) -> None:
+    """Shared decode-cap validation (start token + generated tokens must
+    fit the static cache/bias tables)."""
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if max_new_tokens + 1 > cfg.max_position_embeddings:
+        raise ValueError(
+            f"max_new_tokens={max_new_tokens} exceeds the decode cap "
+            f"max_position_embeddings={cfg.max_position_embeddings}")
+
+
 def t5_generate(model: T5Model, variables, encoder_ids,
                 max_new_tokens: int, *, temperature: float = 0.0,
                 top_k=None, top_p=None, rng=None, eos_token_id=None,
@@ -404,12 +415,7 @@ def t5_generate(model: T5Model, variables, encoder_ids,
 
     cfg = model.config
     b = encoder_ids.shape[0]
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    if max_new_tokens + 1 > cfg.max_position_embeddings:
-        raise ValueError(
-            f"max_new_tokens={max_new_tokens} exceeds the decode cap "
-            f"max_position_embeddings={cfg.max_position_embeddings}")
+    _validate_t5_decode(cfg, max_new_tokens)
     rng = validate_sampling(temperature, top_k, top_p, rng)
 
     enc = model.apply(variables, encoder_ids, method=T5Model.encode)
@@ -424,3 +430,41 @@ def t5_generate(model: T5Model, variables, encoder_ids,
                                    method=T5Model.decode),
         logits, cache, max_new_tokens, temperature=temperature, top_k=top_k,
         top_p=top_p, rng=rng, eos_token_id=eos_token_id, axis_name=axis_name)
+
+
+def t5_beam_search(model: T5Model, variables, encoder_ids,
+                   max_new_tokens: int, *, num_beams: int,
+                   eos_token_id=None, length_penalty: float = 1.0,
+                   axis_name: str = MODEL_AXIS):
+    """Beam-search decode for the encoder-decoder family: encode once,
+    replicate the encoder output per beam, run the shared
+    ``beam_search_loop`` (generation.py — beams fold into the batch, cache
+    reorder is a leading-dim gather incl. the cross ck/cv). Returns
+    ``(sequences (b, num_beams, max_new_tokens), scores)``, best first."""
+    from apex_tpu.models.generation import (beam_search_loop, init_cache,
+                                            repeat_cache, seal_cache)
+
+    cfg = model.config
+    b = encoder_ids.shape[0]
+    if num_beams < 1:
+        raise ValueError("num_beams must be >= 1")
+    _validate_t5_decode(cfg, max_new_tokens)
+
+    # encode + start-token prefill ONCE at batch b (incl. the cross-KV
+    # projection); fan the cache out to the beam-folded batch afterwards
+    enc = model.apply(variables, encoder_ids, method=T5Model.encode)
+    cache = init_cache(cfg, b, max_new_tokens + 1)
+    start = jnp.full((b, 1), cfg.decoder_start_token_id, jnp.int32)
+    logits, cache = model.apply(variables, start, enc, cache,
+                                method=T5Model.decode)
+    cache = seal_cache(repeat_cache(cache, num_beams))
+    logits = jnp.repeat(logits, num_beams, axis=0)
+    # steps read cross K/V from the cache; enc_rep only rides the call
+    # signature (dead operand under "ck" in cache)
+    enc_rep = jnp.repeat(enc, num_beams, axis=0)
+    return beam_search_loop(
+        lambda tok, c: model.apply(variables, tok[:, None], enc_rep, c,
+                                   method=T5Model.decode),
+        logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
+        eos_token_id=eos_token_id, length_penalty=length_penalty,
+        axis_name=axis_name)
