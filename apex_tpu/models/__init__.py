@@ -25,6 +25,8 @@ from apex_tpu.models.generation import (  # noqa: F401
     speculative_generate,
 )
 from apex_tpu.models import hf_convert  # noqa: F401
+from apex_tpu.models import quantize  # noqa: F401
+from apex_tpu.models.quantize import quantize_model_params  # noqa: F401
 from apex_tpu.models import llama  # noqa: F401
 from apex_tpu.models.hf_convert import (  # noqa: F401
     bert_config_from_hf,

@@ -411,8 +411,8 @@ def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
     seed_mask = jnp.where(jnp.arange(w)[None, :, None] == 0, 0.0, neg)
     scores, idx = lax.top_k((logp0 + seed_mask).reshape(b, w * vocab), w)
     tok = (idx % vocab).astype(jnp.int32)                    # (b, w)
-    parent = idx // vocab
-    cache = _gather_beam_cache(cache, parent, b, w)
+    # no cache gather here: at the first expansion every beam's rows are
+    # identical prefill replicas, so any reorder is a value-level no-op
     done = (tok == eos_token_id) if eos_token_id is not None \
         else jnp.zeros((b, w), bool)
 

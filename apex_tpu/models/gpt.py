@@ -77,6 +77,12 @@ class GPTConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0    # ST-MoE router z-loss
     expert_parallel: bool = False
+    # int8 W8A8 serving (ops/quant.py): block linears store int8 weights
+    # + per-channel scales and run on the int8 MXU dot. Inference-only —
+    # embeddings/tied head stay fp; convert a trained checkpoint with
+    # models/quantize.quantize_model_params. Does not compose with MoE
+    # (expert weights would silently stay fp — the model raises)
+    quantize_int8: bool = False
     # activation rematerialization: recompute each decoder block in
     # backward instead of saving its activations (flax nn.remat, the
     # lifted jax.checkpoint; in pipeline stages: jax.checkpoint around the
@@ -132,7 +138,8 @@ class ParallelDecoderBlock(nn.Module):
         # QKV column-parallel: local output is the local heads' q,k,v
         qkv = ColumnParallelLinear(
             e, 3 * e, gather_output=False, world_size=tp,
-            params_dtype=cfg.param_dtype, name="qkv")(h)
+            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+            name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def to_bhsd(t):
@@ -168,7 +175,8 @@ class ParallelDecoderBlock(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, input_is_parallel=True, world_size=tp,
-            params_dtype=cfg.param_dtype, name="out_proj")(ctx)
+            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+            name="out_proj")(ctx)
         x = x + attn_out.astype(x.dtype)
 
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
@@ -181,11 +189,13 @@ class ParallelDecoderBlock(nn.Module):
         else:
             h = ColumnParallelLinear(
                 e, 4 * e, gather_output=False, world_size=tp,
-                params_dtype=cfg.param_dtype, name="mlp_in")(h)
+                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+                name="mlp_in")(h)
             h = jax.nn.gelu(h, approximate=True)
             mlp_out = RowParallelLinear(
                 4 * e, e, input_is_parallel=True, world_size=tp,
-                params_dtype=cfg.param_dtype, name="mlp_out")(h)
+                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+                name="mlp_out")(h)
         out = x + mlp_out.astype(x.dtype)
         return out if cache is None else (out, cache)
 
@@ -203,6 +213,10 @@ class GPTModel(nn.Module):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
+        if cfg.quantize_int8 and cfg.num_experts > 0:
+            raise NotImplementedError(
+                "quantize_int8 does not cover MoE expert weights; the "
+                "combination would silently serve fp experts")
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, world_size=cfg.tensor_parallel_size,
             params_dtype=cfg.param_dtype, name="word_embeddings")

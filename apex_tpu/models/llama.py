@@ -83,6 +83,9 @@ class LlamaConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
     expert_parallel: bool = False
+    # int8 W8A8 serving for the block linears (same as GPTConfig;
+    # lm_head/embedding stay fp)
+    quantize_int8: bool = False
     # activation rematerialization per decoder block (same as GPTConfig)
     remat: bool = False
 
@@ -142,10 +145,12 @@ class LlamaDecoderBlock(nn.Module):
         h = h.astype(dt)
         q = ColumnParallelLinear(
             e, cfg.num_heads * d, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="q_proj")(h)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="q_proj")(h)
         kv = ColumnParallelLinear(
             e, 2 * cfg.num_kv_heads * d, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="kv_proj")(h)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="kv_proj")(h)
         k, v = jnp.split(kv, 2, axis=-1)
 
         def to_shd(t, nh):  # (b, s, nh*d) -> (s, b, nh, d): rope layout
@@ -194,7 +199,8 @@ class LlamaDecoderBlock(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, bias=False, input_is_parallel=True, world_size=tp,
-            params_dtype=cfg.param_dtype, name="o_proj")(ctx)
+            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+            name="o_proj")(ctx)
         x = x + attn_out.astype(x.dtype)
 
         h = FusedRMSNorm(e, eps=cfg.rms_eps, name="post_norm")(x)
@@ -213,11 +219,13 @@ class LlamaDecoderBlock(nn.Module):
             gate_up = ColumnParallelLinear(
                 e, 2 * cfg.intermediate_size, bias=False,
                 gather_output=False, world_size=tp,
-                params_dtype=cfg.param_dtype, name="gate_up_proj")(h)
+                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
+                name="gate_up_proj")(h)
             gate, up = jnp.split(gate_up, 2, axis=-1)
             mlp_out = RowParallelLinear(
                 cfg.intermediate_size, e, bias=False, input_is_parallel=True,
                 world_size=tp, params_dtype=cfg.param_dtype,
+                quantize=cfg.quantize_int8,
                 name="down_proj")(jax.nn.silu(gate) * up)
         out = x + mlp_out.astype(x.dtype)
         return out if cache is None else (out, cache)
@@ -235,6 +243,10 @@ class LlamaModel(nn.Module):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
+        if cfg.quantize_int8 and cfg.num_experts > 0:
+            raise NotImplementedError(
+                "quantize_int8 does not cover MoE expert weights; the "
+                "combination would silently serve fp experts")
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size,
             world_size=cfg.tensor_parallel_size,

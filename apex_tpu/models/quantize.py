@@ -1,0 +1,55 @@
+"""fp checkpoint -> int8-serving param tree (models with quantize_int8).
+
+Beyond reference (apex has no quantization story). The quantized models
+(``GPTConfig(quantize_int8=True)``, ``LlamaConfig(quantize_int8=True)``)
+expect each block linear's ``weight`` as int8 plus a per-output-channel
+``scale`` (transformer/tensor_parallel/layers.py); this module produces
+that tree from a TRAINED fp tree — post-training quantization, the
+ordinary serving flow:
+
+    fp_vars = model_fp.init(...)          # or an HF-converted checkpoint
+    qmodel = GPTModel(dataclasses.replace(cfg, quantize_int8=True))
+    qparams = quantize_model_params(qmodel, fp_vars, example_ids)
+    generate(qmodel, {"params": qparams}, prompt, ...)
+
+Leaves the target expects in fp (embeddings, norms, biases, heads) pass
+through unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.quant import quantize_weight
+
+
+def quantize_params_like(target_shapes, params_fp):
+    """Build the quantized tree: wherever ``target_shapes`` holds an int8
+    ``weight`` with a sibling ``scale``, quantize the fp source weight
+    per-output-channel; everything else passes through."""
+    def walk(tgt, src):
+        if isinstance(tgt, dict):
+            out = {}
+            wants_q = ("weight" in tgt and "scale" in tgt
+                       and tgt["weight"].dtype == jnp.int8)
+            for k in tgt:
+                if wants_q and k == "weight":
+                    out["weight"], out["scale"] = quantize_weight(
+                        src["weight"])
+                elif wants_q and k == "scale":
+                    continue  # produced with the weight
+                else:
+                    out[k] = walk(tgt[k], src[k])
+            return out
+        return src
+
+    return walk(target_shapes, params_fp)
+
+
+def quantize_model_params(qmodel, fp_variables, *example_args):
+    """fp ``{"params": ...}`` (trained or HF-converted) -> the param tree
+    of ``qmodel`` (a model constructed with ``quantize_int8=True``)."""
+    target = jax.eval_shape(
+        lambda: qmodel.init(jax.random.PRNGKey(0), *example_args))["params"]
+    return quantize_params_like(target, fp_variables["params"])

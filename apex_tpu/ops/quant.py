@@ -1,0 +1,48 @@
+"""int8 quantized matmul (W8A8): true int8 MXU dots for serving.
+
+Beyond reference (apex has no quantization/inference story) — this is the
+TPU-native int8 recipe (the AQT pattern): per-output-channel symmetric
+weight scales computed offline, DYNAMIC per-token activation scales
+computed on the fly, ``int8 x int8 -> int32`` accumulation on the MXU,
+then one fused dequant multiply. Weights stream from HBM at 1 byte/elem —
+a 4x (vs fp32) / 2x (vs bf16) cut in the weight-fetch bandwidth that
+bounds single-token decode.
+
+Inference-only: ``round`` has zero gradient, so a quantized layer cannot
+train (the tensor-parallel layers raise if asked to).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_weight(w, *, axis: int = 1):
+    """Symmetric per-output-channel int8: ``w (out, in) -> (q int8 (out,
+    in), scale f32 (out,))`` with ``w ≈ q * scale[:, None]``."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale.squeeze(axis).astype(jnp.float32)
+
+
+def int8_matmul(x, qw, scale):
+    """``y = x @ dequant(qw).T`` via an int8 MXU dot.
+
+    x: (..., in) float; qw: (out, in) int8; scale: (out,) f32 per-channel.
+    Per-token activation scales (amax/127) quantize x on the fly; the
+    contraction accumulates in int32; the result dequantizes by
+    ``sx * scale`` and casts back to x.dtype.
+    """
+    xf = x.astype(jnp.float32)
+    sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                     1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / sx), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, qw,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * scale.astype(jnp.float32)) \
+        .astype(x.dtype)

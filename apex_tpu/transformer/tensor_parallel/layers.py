@@ -115,6 +115,10 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     world_size: Optional[int] = None      # default: tp size of the global mesh
     axis_name: str = MODEL_AXIS
+    # int8 W8A8 serving path (ops/quant.py): weight stored int8 with a
+    # per-output-channel "scale" param; matmul runs on the int8 MXU dot.
+    # Inference-only (round has zero gradient)
+    quantize: bool = False
 
     def _world(self) -> int:
         if self.world_size is not None:
@@ -128,9 +132,22 @@ class ColumnParallelLinear(nn.Module):
         world = self._world()
         out_local = divide(self.output_size, world)
         init = self.init_method or nn.initializers.lecun_normal()
-        # weight layout matches the reference: (out_local, in)
-        w = self.param("weight", _shard_init(init, self.axis_name),
-                       (out_local, self.input_size), self.params_dtype)
+        if self.quantize:
+            if self.gradient_accumulation_fusion:
+                raise ValueError(
+                    "quantize is an inference path; it cannot combine with "
+                    "gradient_accumulation_fusion")
+            # init is a placeholder: real values come from
+            # models/quantize.quantize_params_like on a trained checkpoint
+            w = self.param("weight", nn.initializers.zeros,
+                           (out_local, self.input_size), jnp.int8)
+            w_scale = self.param("scale", _shard_init(nn.initializers.ones,
+                                                      self.axis_name),
+                                 (out_local,), jnp.float32)
+        else:
+            # weight layout matches the reference: (out_local, in)
+            w = self.param("weight", _shard_init(init, self.axis_name),
+                           (out_local, self.input_size), self.params_dtype)
         b = (self.param("bias", _shard_init(nn.initializers.zeros,
                                             self.axis_name),
                         (out_local,), self.params_dtype)
@@ -144,7 +161,11 @@ class ColumnParallelLinear(nn.Module):
             else:
                 x = mappings.copy_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        if self.gradient_accumulation_fusion:
+        if self.quantize:
+            from apex_tpu.ops.quant import int8_matmul
+
+            y = int8_matmul(x, w, w_scale)
+        elif self.gradient_accumulation_fusion:
             y = fp32_wgrad_matmul(x, w)
         else:
             y = x @ w.astype(x.dtype).T
@@ -190,6 +211,10 @@ class RowParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     world_size: Optional[int] = None
     axis_name: str = MODEL_AXIS
+    # int8 W8A8 serving path — see ColumnParallelLinear.quantize. Each
+    # rank quantizes its OWN (out, in_local) shard, so dequant happens
+    # before the partial-sum reduction (per-rank scales are exact)
+    quantize: bool = False
 
     def _world(self) -> int:
         if self.world_size is not None:
@@ -203,8 +228,18 @@ class RowParallelLinear(nn.Module):
         world = self._world()
         in_local = divide(self.input_size, world)
         init = self.init_method or nn.initializers.lecun_normal()
-        w = self.param("weight", _shard_init(init, self.axis_name),
-                       (self.output_size, in_local), self.params_dtype)
+        if self.quantize:
+            if self.gradient_accumulation_fusion:
+                raise ValueError(
+                    "quantize is an inference path; it cannot combine with "
+                    "gradient_accumulation_fusion")
+            w = self.param("weight", nn.initializers.zeros,
+                           (self.output_size, in_local), jnp.int8)
+            w_scale = self.param("scale", nn.initializers.ones,
+                                 (self.output_size,), jnp.float32)
+        else:
+            w = self.param("weight", _shard_init(init, self.axis_name),
+                           (self.output_size, in_local), self.params_dtype)
         # bias is replicated (applied post-reduce), not sharded
         b = (self.param("bias", nn.initializers.zeros, (self.output_size,),
                         self.params_dtype)
@@ -219,7 +254,11 @@ class RowParallelLinear(nn.Module):
             if bound:
                 x = mappings.scatter_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        if self.gradient_accumulation_fusion:
+        if self.quantize:
+            from apex_tpu.ops.quant import int8_matmul
+
+            y = int8_matmul(x, w, w_scale)
+        elif self.gradient_accumulation_fusion:
             y = fp32_wgrad_matmul(x, w)
         else:
             y = x @ w.astype(x.dtype).T
