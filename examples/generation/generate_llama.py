@@ -77,6 +77,50 @@ def run_generation(*, prompt_len=6, new_tokens=12, tp=1, temperature=0.0,
     return out
 
 
+def run_speculative(*, prompt_len=6, new_tokens=10, k=4, seed=0,
+                    verbose=print):
+    """Greedy speculative decoding: a differently-seeded tiny draft
+    proposes k-1 tokens/round; output must equal plain greedy."""
+    from apex_tpu.models.generation import speculative_generate
+
+    rng = np.random.default_rng(seed)
+    cfg = llama_tiny_config()
+    model = LlamaModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, prompt_len)),
+                         jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    draft = LlamaModel(cfg)
+    dv = draft.init(jax.random.PRNGKey(99), prompt)
+
+    ref = np.asarray(generate(model, v, prompt, new_tokens,
+                              axis_name="unbound"))
+    out = np.asarray(speculative_generate(model, v, draft, dv, prompt,
+                                          new_tokens, k=k,
+                                          axis_name="unbound"))
+    assert (out == ref).all(), "speculative must equal greedy"
+    verbose(f"[speculative] k={k}: exact greedy parity over "
+            f"{new_tokens} tokens")
+    return out
+
+
+def run_beam(*, prompt_len=6, new_tokens=8, beams=4, seed=0, verbose=print):
+    from apex_tpu.models.generation import generate_beam
+
+    rng = np.random.default_rng(seed)
+    cfg = llama_tiny_config()
+    model = LlamaModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, prompt_len)),
+                         jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    seqs, scores = generate_beam(model, v, prompt, new_tokens,
+                                 num_beams=beams, length_penalty=0.0,
+                                 axis_name="unbound")
+    seqs, scores = np.asarray(seqs), np.asarray(scores)
+    verbose(f"[beam] {beams} beams, best scores: "
+            f"{np.round(scores[:, 0], 2).tolist()}")
+    return seqs, scores
+
+
 if __name__ == "__main__":
     import os
 
@@ -88,3 +132,5 @@ if __name__ == "__main__":
     run_generation()                                   # greedy single-device
     run_generation(temperature=0.9, top_k=8, seed=3)   # sampled
     run_generation(tp=2)                               # tensor-parallel decode
+    run_speculative()                                  # draft-accelerated
+    run_beam()                                         # beam search

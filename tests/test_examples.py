@@ -63,6 +63,9 @@ def test_generation_example_decodes():
     assert sampled.shape == (2, 12)
     tp = mod.run_generation(new_tokens=4, tp=2, verbose=_quiet)
     assert tp.shape == (2, 10)
+    mod.run_speculative(new_tokens=6, k=3, verbose=_quiet)  # asserts parity
+    seqs, scores = mod.run_beam(new_tokens=5, beams=3, verbose=_quiet)
+    assert seqs.shape == (2, 3, 11)
 
 
 def test_simple_ddp_loop():

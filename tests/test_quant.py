@@ -4,8 +4,9 @@ Beyond reference (apex has no quantization story). Contract: the int8 MXU
 dot with per-channel weight scales + dynamic per-token activation scales
 (ops/quant.py) approximates the fp matmul to quantization error; a
 converted model's logits stay faithful (cosine) and the decode paths run
-unchanged on the quantized tree; TP=2 quantized equals TP=1 quantized
-exactly (per-shard scales are deterministic).
+unchanged on the quantized tree; TP=2 quantized tracks TP=1 quantized to
+cosine > 0.999 (row-parallel shards requantize per rank, so their scales
+differ from the whole-row ones by design — see docs/quantization.md).
 """
 
 import dataclasses
