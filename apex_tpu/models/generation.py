@@ -74,12 +74,25 @@ def init_cache(config, batch: int, max_len: int, *, dtype=None):
     """Allocate an all-zeros KV cache for ``batch`` sequences of up to
     ``max_len`` total tokens (prompt + generated). Inside shard_map with
     the ``model`` axis bound, ``config.tensor_parallel_size`` kv-head
-    shards divide exactly as in training."""
+    shards divide exactly as in training.
+
+    With ``config.rolling_cache`` (sliding-window models), the buffer is a
+    ROLLING ring of ``sliding_window`` slots instead of ``max_len`` —
+    O(window) HBM for arbitrarily long decodes (the Mistral serving
+    pattern); writes wrap modulo the window and the mask reconstructs each
+    slot's absolute position."""
     kv_heads = getattr(config, "num_kv_heads", config.num_heads)
     kv_local = divide(kv_heads, config.tensor_parallel_size)
     d = config.head_dim
     dt = dtype if dtype is not None else resolve_compute_dtype(config.dtype)
-    shape = (batch, kv_local, max_len, d)
+    t_buf = max_len
+    if getattr(config, "rolling_cache", False):
+        if not getattr(config, "sliding_window", None):
+            raise ValueError("rolling_cache requires sliding_window")
+        # ALWAYS window-sized: a ring shorter than the window would
+        # silently drop reachable positions once decoding passes its size
+        t_buf = config.sliding_window
+    shape = (batch, kv_local, t_buf, d)
     layers = [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
               for _ in range(config.num_layers)]
     return {"layers": layers, "len": 0}
@@ -89,11 +102,13 @@ def cache_max_len(cache) -> int:
     return cache["layers"][0]["k"].shape[2]
 
 
-def check_chunk_bounds(cache, s: int, max_position_embeddings: int):
+def check_chunk_bounds(cache, s: int, max_position_embeddings: int, *,
+                       rolling: bool = False):
     """Model-level guard for a chunk of length ``s``: while the cache
     length is static, out-of-range chunks (past the position table or the
     cache buffer) raise at trace time — the decode-path analog of the
-    training forward's explicit position checks. Returns the offset."""
+    training forward's explicit position checks. Returns the offset.
+    A ``rolling`` buffer wraps, so only the position cap applies."""
     t0 = cache["len"]
     t_max = cache_max_len(cache)
     if isinstance(t0, int):
@@ -101,11 +116,11 @@ def check_chunk_bounds(cache, s: int, max_position_embeddings: int):
             raise ValueError(
                 f"decode chunk [{t0}, {t0 + s}) exceeds "
                 f"max_position_embeddings={max_position_embeddings}")
-        if t0 + s > t_max:
+        if not rolling and t0 + s > t_max:
             raise ValueError(
                 f"decode chunk [{t0}, {t0 + s}) exceeds the cache buffer "
                 f"(max_len={t_max}); allocate a larger init_cache")
-    elif s > t_max:
+    elif not rolling and s > t_max:
         raise ValueError(f"chunk length {s} exceeds cache max_len={t_max}")
     return t0
 
@@ -144,6 +159,70 @@ def update_layer_cache(lc, k_chunk, v_chunk):
     return out
 
 
+def update_layer_cache_rolling(lc, k_chunk, v_chunk):
+    """Ring-buffer write: the chunk's positions land at ``pos % R``. Only
+    the LAST ``min(s, R)`` chunk positions are kept (earlier ones would
+    collide with slots later writes need, and a window model never reads
+    past its band anyway). Duplicate-free scatter indices by construction."""
+    t0 = lc["len"]
+    r = lc["k"].shape[2]
+    s = k_chunk.shape[2]
+    keep = min(s, r)
+    k_tail = k_chunk[:, :, s - keep:, :]
+    v_tail = v_chunk[:, :, s - keep:, :]
+    idx = (t0 + (s - keep) + jnp.arange(keep, dtype=jnp.int32)) % r
+    out = dict(lc)
+    out["k"] = lc["k"].at[:, :, idx, :].set(k_tail.astype(lc["k"].dtype))
+    out["v"] = lc["v"].at[:, :, idx, :].set(v_tail.astype(lc["v"].dtype))
+    return out
+
+
+def _masked_attention_core(q, k, v, mask, *, scale, bias=None):
+    """Shared GQA dot-product core for the cached paths: fp32 scores +
+    accumulation, queries grouped against the unexpanded kv-head buffer,
+    ``mask`` broadcastable to ``(b, kv, rep, s, T)``."""
+    b, h, s, d = q.shape
+    kv = k.shape[1]
+    rep = divide(h, kv)
+    t_max = k.shape[2]
+
+    qf = q.reshape(b, kv, rep, s, d).astype(jnp.float32)
+    scores = jnp.einsum("bkrsd,bktd->bkrst", qf, k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+    scores = scores * (jnp.float32(scale) if scale is not None
+                       else 1.0 / jnp.sqrt(jnp.float32(d)))
+    if bias is not None:
+        bb = jnp.broadcast_to(bias.astype(jnp.float32), (b, h, s, t_max))
+        scores = scores + bb.reshape(b, kv, rep, s, t_max)
+    scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bkrst,bktd->bkrsd", p, v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return ctx.reshape(b, h, s, d).astype(q.dtype)
+
+
+def cached_attention_rolling(q, lc, *, window: int,
+                             scale: Optional[float] = None):
+    """Single-step (``s=1``) attention over the rolling ring: slot ``j``'s
+    absolute position is reconstructed from the write pointer
+    (``last - ((last - j) mod R)``), masked to the causal window band and
+    to written slots. Multi-token chunks are unsupported on the ring (a
+    later in-chunk write would overwrite a slot an earlier query needs)."""
+    k, v, t0 = lc["k"], lc["v"], lc["len"]
+    if q.shape[2] != 1:
+        raise NotImplementedError(
+            "rolling cache supports single-token decode steps only "
+            "(prefill rides the flash kernel; chunked continuation / "
+            "speculative verification need the full buffer)")
+    r = k.shape[2]
+    last = t0                                  # this step's absolute position
+    slots = jnp.arange(r, dtype=jnp.int32)
+    p_j = last - ((last - slots) % r)          # slot -> absolute position
+    mask = jnp.logical_and(p_j >= 0, p_j > last - window)
+    return _masked_attention_core(q, k, v, mask[None, None, None, None],
+                                  scale=scale)
+
+
 def advance_cache(cache, new_layers, s: int):
     """Model-level reassembly after all blocks ran a chunk of length s.
     Plain-int arithmetic keeps a static length static across chunks; the
@@ -176,30 +255,15 @@ def cached_attention(q, lc, *, window: Optional[int] = None, bias=None,
     bias) adds to the scaled scores before masking — the cached analog of
     the flash kernel's additive slot."""
     k, v, t0 = lc["k"], lc["v"], lc["len"]
-    b, h, s, d = q.shape
-    kv = k.shape[1]
-    rep = divide(h, kv)
+    s = q.shape[2]
     t_max = k.shape[2]
-
-    qf = q.reshape(b, kv, rep, s, d).astype(jnp.float32)
-    scores = jnp.einsum("bkrsd,bktd->bkrst", qf, k.astype(jnp.float32),
-                        preferred_element_type=jnp.float32)
-    scores = scores * (jnp.float32(scale) if scale is not None
-                       else 1.0 / jnp.sqrt(jnp.float32(d)))
-    if bias is not None:
-        bb = jnp.broadcast_to(bias.astype(jnp.float32),
-                              (b, h, s, t_max))
-        scores = scores + bb.reshape(b, kv, rep, s, t_max)
     pos_q = t0 + jnp.arange(s, dtype=jnp.int32)[:, None]      # (s, 1)
     pos_k = jnp.arange(t_max, dtype=jnp.int32)[None, :]       # (1, T)
     mask = pos_k <= pos_q
     if window is not None:
         mask = jnp.logical_and(mask, pos_k > pos_q - window)
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1)
-    ctx = jnp.einsum("bkrst,bktd->bkrsd", p, v.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-    return ctx.reshape(b, h, s, d).astype(q.dtype)
+    return _masked_attention_core(q, k, v, mask[None, None, None],
+                                  scale=scale, bias=bias)
 
 
 # --- sampling + the generate loop -------------------------------------------

@@ -27,8 +27,10 @@ from jax import lax
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.models.generation import (advance_cache, cached_attention,
+                                        cached_attention_rolling,
                                         check_chunk_bounds, is_static_prefill,
-                                        layer_cache, update_layer_cache)
+                                        layer_cache, update_layer_cache,
+                                        update_layer_cache_rolling)
 from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
 from apex_tpu.ops import (flash_attention, ring_attention,
@@ -72,6 +74,11 @@ class LlamaConfig:
     # kernel (O(S*window) compute+DMA); under context_parallel the ring is
     # statically shortened to the chunks the band reaches (fewer ppermutes).
     sliding_window: Optional[int] = None
+    # rolling KV cache for decode (requires sliding_window): a ring of
+    # ``window`` slots instead of a full-length buffer — O(window) HBM for
+    # arbitrarily long generation (models/generation.py). Single-token
+    # steps only after prefill (speculative/chunked continuation raise).
+    rolling_cache: bool = False
     # --- mixture-of-experts (Mixtral family = GQA + window + MoE) ---------
     # Same contract as GPTConfig: num_experts > 0 routes every
     # moe_layer_freq-th block's MLP through MoEMLP — with SWIGLU experts
@@ -173,13 +180,18 @@ class LlamaDecoderBlock(nn.Module):
         if cache is not None:
             # incremental decoding: append K/V at the cache offset; a
             # trace-time-provable prefill rides the training flash kernel,
-            # decode steps the absolute-position (windowed) masked product
-
+            # decode steps the absolute-position (windowed) masked product.
+            # rolling_cache swaps in the O(window) ring-buffer variants
             prefill = is_static_prefill(cache, s)
-            cache = update_layer_cache(cache, k, v)
+            update_fn = update_layer_cache_rolling if cfg.rolling_cache \
+                else update_layer_cache
+            cache = update_fn(cache, k, v)
             if prefill:
                 ctx = flash_attention(q, k, v, causal=True,
                                       window=cfg.sliding_window)
+            elif cfg.rolling_cache:
+                ctx = cached_attention_rolling(q, cache,
+                                               window=cfg.sliding_window)
             else:
                 ctx = cached_attention(q, cache, window=cfg.sliding_window)
         elif cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
@@ -261,7 +273,10 @@ class LlamaModel(nn.Module):
                     "incremental decoding does not compose with context "
                     "parallelism; decode on a dp/tp mesh instead")
 
-            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
+            if cfg.rolling_cache and not cfg.sliding_window:
+                raise ValueError("rolling_cache requires sliding_window")
+            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings,
+                                    rolling=cfg.rolling_cache)
             cos_, sin_ = _rope_cos_sin(cfg, s, t0)
         else:
             cp = (lax.axis_size(CONTEXT_AXIS)

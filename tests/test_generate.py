@@ -461,3 +461,66 @@ def test_t5_beam1_equals_greedy(rng):
     seqs, _ = t5_beam_search(model, v, enc_ids, max_new_tokens=5,
                              num_beams=1)
     np.testing.assert_array_equal(np.asarray(seqs)[:, 0], ref)
+
+
+def test_rolling_cache_matches_full_cache(rng):
+    """O(window) ring buffer: stepwise decode logits equal BOTH the
+    full-length-cache decode and the training forward, past the point
+    where the ring has wrapped several times."""
+    import dataclasses
+
+    cfg = llama_tiny_config(sliding_window=5)
+    rcfg = dataclasses.replace(cfg, rolling_cache=True)
+    model, rmodel = LlamaModel(cfg), LlamaModel(rcfg)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    full = _full_logits(model, v, ids)
+
+    cache = init_cache(rcfg, 2, 16)
+    assert cache["layers"][0]["k"].shape[2] == 5  # ring = window slots
+    logits, cache = rmodel.apply(v, ids[:, :8], cache=cache)  # prefill > R
+    np.testing.assert_allclose(np.asarray(logits, np.float32),
+                               full[:, :8], **TOL)
+    for p in range(8, 16):
+        step, cache = rmodel.apply(v, ids[:, p:p + 1], cache=cache)
+        np.testing.assert_allclose(np.asarray(step[:, 0], np.float32),
+                                   full[:, p], **TOL)
+
+
+def test_rolling_cache_generate_and_beam_parity(rng):
+    import dataclasses
+
+    from apex_tpu.models.generation import generate_beam
+
+    cfg = llama_tiny_config(sliding_window=4)
+    rcfg = dataclasses.replace(cfg, rolling_cache=True)
+    model, rmodel = LlamaModel(cfg), LlamaModel(rcfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 6)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+
+    ref = np.asarray(generate(model, v, prompt, max_new_tokens=9))
+    out = np.asarray(generate(rmodel, v, prompt, max_new_tokens=9))
+    np.testing.assert_array_equal(out, ref)
+
+    bref, _ = generate_beam(model, v, prompt, max_new_tokens=6, num_beams=3,
+                            length_penalty=0.0)
+    brol, _ = generate_beam(rmodel, v, prompt, max_new_tokens=6, num_beams=3,
+                            length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(brol), np.asarray(bref))
+
+
+def test_rolling_cache_rejects_chunked_continuation(rng):
+    """Multi-token chunks past prefill would overwrite slots earlier
+    in-chunk queries need — the ring path raises instead."""
+    import dataclasses
+
+    rcfg = llama_tiny_config(sliding_window=4, rolling_cache=True)
+    model = LlamaModel(rcfg)
+    ids = jnp.asarray(rng.integers(0, rcfg.vocab_size, (1, 8)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    cache = init_cache(rcfg, 1, 12)
+    _, cache = model.apply(v, ids[:, :4], cache=cache)
+    with pytest.raises(NotImplementedError):
+        model.apply(v, ids[:, 4:8], cache=cache)  # s=4 continuation
+    with pytest.raises(ValueError):  # rolling without a window
+        init_cache(dataclasses.replace(rcfg, sliding_window=None), 1, 8)
