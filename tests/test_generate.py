@@ -31,6 +31,7 @@ def _full_logits(model, v, ids):
     return np.asarray(model.apply(v, ids), np.float32)
 
 
+@pytest.mark.slow
 def test_gpt_prefill_matches_full_forward(rng):
     cfg = gpt_tiny_config()
     model = GPTModel(cfg)
@@ -44,6 +45,7 @@ def test_gpt_prefill_matches_full_forward(rng):
     assert int(cache["len"]) == 12
 
 
+@pytest.mark.slow
 def test_gpt_incremental_steps_match_full_forward(rng):
     """Prefill 6 tokens then 6 single-token steps: step logits equal the
     full forward's logits at the same absolute position."""
@@ -64,6 +66,7 @@ def test_gpt_incremental_steps_match_full_forward(rng):
     assert int(cache["len"]) == 12
 
 
+@pytest.mark.slow
 def test_llama_gqa_window_incremental_matches_full_forward(rng):
     """GQA (kv=2 < h=4) + sliding window: the cache holds UNEXPANDED kv
     heads and the absolute-position band mask reproduces the banded flash
@@ -84,6 +87,7 @@ def test_llama_gqa_window_incremental_matches_full_forward(rng):
                                    full[:, p], **TOL)
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_teacher_forced(rng):
     cfg = gpt_tiny_config()
     model = GPTModel(cfg)
@@ -102,6 +106,7 @@ def test_generate_greedy_matches_teacher_forced(rng):
     np.testing.assert_array_equal(out, seq)
 
 
+@pytest.mark.slow
 def test_generate_is_jittable_end_to_end(rng):
     cfg = llama_tiny_config()
     model = LlamaModel(cfg)
@@ -114,6 +119,7 @@ def test_generate_is_jittable_end_to_end(rng):
     np.testing.assert_array_equal(out_jit, out)
 
 
+@pytest.mark.slow
 def test_generate_eos_padding(rng):
     """Once a row emits EOS every later position is EOS."""
     cfg = gpt_tiny_config()
@@ -128,6 +134,7 @@ def test_generate_eos_padding(rng):
     assert (out[0, 4:] == eos).all()
 
 
+@pytest.mark.slow
 def test_generate_sampling_topk_support_and_reproducibility(rng):
     cfg = llama_tiny_config()
     model = LlamaModel(cfg)
@@ -154,6 +161,7 @@ def test_generate_sampling_topk_support_and_reproducibility(rng):
         generate(model, v, prompt, max_new_tokens=2, top_k=4)
 
 
+@pytest.mark.slow
 def test_generate_top_p_nucleus(rng):
     """top_p -> 0 degenerates to greedy (only the modal token survives);
     moderate top_p draws stay inside the teacher-forced nucleus set."""
@@ -186,6 +194,7 @@ def test_generate_top_p_nucleus(rng):
             assert int(out[row, 4 + p]) in nucleus
 
 
+@pytest.mark.slow
 def test_chunked_continuation_matches_full_forward(rng):
     """Static-offset multi-token chunks (speculative-decoding shape):
     prefill 4, then a 4-token chunk through the dense cached path."""
@@ -234,6 +243,7 @@ def test_generate_validates_lengths(rng):
         generate(model, v, prompt, max_new_tokens=4, max_len=6)
 
 
+@pytest.mark.slow
 def test_moe_decode_matches_full_forward(rng):
     """MoE routing is per-token, so with undropped capacity the cached path
     reproduces the full forward."""
@@ -292,6 +302,7 @@ def test_generate_tp2_matches_tp1(rng):
     np.testing.assert_array_equal(outt, out1)
 
 
+@pytest.mark.slow
 def test_speculative_equals_greedy_self_draft(rng):
     """Draft == target: every proposal accepted, output == plain greedy."""
     cfg = gpt_tiny_config()
@@ -305,6 +316,7 @@ def test_speculative_equals_greedy_self_draft(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_speculative_equals_greedy_random_draft(rng):
     """An unrelated random draft (low acceptance): rejections roll the
     caches back and the output is STILL exactly the target's greedy
@@ -322,6 +334,7 @@ def test_speculative_equals_greedy_random_draft(rng):
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_speculative_llama_gqa_window_draft(rng):
     """Target Llama (GQA + sliding window) with a differently-seeded
     draft; exactness must hold through the windowed decode path."""
@@ -352,6 +365,7 @@ def test_speculative_validates_position_slack(rng):
                              k=1)
 
 
+@pytest.mark.slow
 def test_beam1_equals_greedy(rng):
     from apex_tpu.models.generation import generate_beam
 
@@ -368,6 +382,7 @@ def test_beam1_equals_greedy(rng):
     assert np.isfinite(np.asarray(scores)).all()
 
 
+@pytest.mark.slow
 def test_beam_exhaustive_width_finds_global_optimum(rng):
     """vocab=4, T=3, num_beams=16 = V^(T-1): the beam pool provably holds
     every live prefix at every depth, so the returned best must equal the
@@ -403,6 +418,7 @@ def test_beam_exhaustive_width_finds_global_optimum(rng):
                                    atol=2e-4)
 
 
+@pytest.mark.slow
 def test_beam_scores_match_teacher_forced(rng):
     """Every returned beam's score equals its sequence's recomputed
     teacher-forced log-prob (penalty 0)."""
@@ -426,6 +442,7 @@ def test_beam_scores_match_teacher_forced(rng):
         np.testing.assert_allclose(scores[0, j], want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_beam_eos_freezes_and_ranks(rng):
     """A beam that emits EOS keeps emitting it at zero added cost, and the
     returned sequences pad with EOS after the first one."""
@@ -448,6 +465,7 @@ def test_beam_eos_freezes_and_ranks(rng):
             assert (row[hits[0]:] == eos).all()
 
 
+@pytest.mark.slow
 def test_t5_beam1_equals_greedy(rng):
     from apex_tpu.models.t5 import (T5Model, t5_beam_search, t5_generate,
                                     t5_tiny_config)
@@ -463,6 +481,7 @@ def test_t5_beam1_equals_greedy(rng):
     np.testing.assert_array_equal(np.asarray(seqs)[:, 0], ref)
 
 
+@pytest.mark.slow
 def test_rolling_cache_matches_full_cache(rng):
     """O(window) ring buffer: stepwise decode logits equal BOTH the
     full-length-cache decode and the training forward, past the point
@@ -487,6 +506,7 @@ def test_rolling_cache_matches_full_cache(rng):
                                    full[:, p], **TOL)
 
 
+@pytest.mark.slow
 def test_rolling_cache_generate_and_beam_parity(rng):
     import dataclasses
 
@@ -509,6 +529,7 @@ def test_rolling_cache_generate_and_beam_parity(rng):
     np.testing.assert_array_equal(np.asarray(brol), np.asarray(bref))
 
 
+@pytest.mark.slow
 def test_rolling_cache_rejects_chunked_continuation(rng):
     """Multi-token chunks past prefill would overwrite slots earlier
     in-chunk queries need — the ring path raises instead."""

@@ -60,6 +60,7 @@ def _cosine(a, b, axis=-1):
 
 
 @pytest.mark.parametrize("family", ["gpt", "llama"])
+@pytest.mark.slow
 def test_quantized_model_logits_faithful(rng, family):
     """Post-training int8 conversion: per-position logits cosine > 0.99
     vs the fp model, and generate() runs on the quantized tree."""

@@ -54,6 +54,7 @@ def test_relative_position_bucket_matches_reference(bidir):
     assert got.min() >= 0 and got.max() < 32
 
 
+@pytest.mark.slow
 def test_t5_trains(rng):
     """Teacher-forced loss decreases over a few adam steps (both FFN
     variants' params exist and get gradients)."""
@@ -86,6 +87,7 @@ def test_t5_trains(rng):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_t5_cached_decode_matches_teacher_forced(rng):
     """Incremental decode (self-attn KV cache + cross-KV computed once)
     reproduces the teacher-forced decoder logits position by position."""
@@ -113,6 +115,7 @@ def test_t5_cached_decode_matches_teacher_forced(rng):
                                    full[:, p], **TOL)
 
 
+@pytest.mark.slow
 def test_t5_generate_greedy_matches_teacher_forced(rng):
     cfg = t5_tiny_config()
     model = T5Model(cfg)
@@ -132,6 +135,7 @@ def test_t5_generate_greedy_matches_teacher_forced(rng):
     np.testing.assert_array_equal(out, dec[:, 1:])
 
 
+@pytest.mark.slow
 def test_t5_cross_kv_projected_once(rng):
     """After the first decode step the encoder K/V live in the cache:
     zeroing ``enc`` must not change later step logits (the projected-once
@@ -157,6 +161,7 @@ def test_t5_cross_kv_projected_once(rng):
                                   np.asarray(step_zero))
 
 
+@pytest.mark.slow
 def test_t5_decode_bounds_raise_at_trace_time(rng):
     """A statically out-of-range decoder chunk raises instead of letting
     dynamic_update_slice clamp and corrupt the cache tail."""
@@ -174,6 +179,7 @@ def test_t5_decode_bounds_raise_at_trace_time(rng):
         model.apply(v, dec_ids, enc, cache, method=T5Model.decode)
 
 
+@pytest.mark.slow
 def test_t5_v11_untied_head_cached_decode(rng):
     """v1.1 shape: gated-gelu FFN + untied lm_head, no d_model^-0.5
     rescale; cached decode must still match teacher forcing."""
@@ -202,6 +208,7 @@ def test_t5_v11_untied_head_cached_decode(rng):
                                    full[:, p], **TOL)
 
 
+@pytest.mark.slow
 def test_t5_generate_sampling_and_eos(rng):
     cfg = t5_tiny_config()
     model = T5Model(cfg)
