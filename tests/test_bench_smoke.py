@@ -61,3 +61,6 @@ def test_decode_bench_smoke_emits_json():
     assert rec["metric"] == "gpt2_decode_tokens_per_sec_per_chip"
     assert rec["value"] > 0
     assert rec["unit"] == "tokens/s/chip"
+    # speedup may round toward 0 under extreme CPU scheduler noise —
+    # assert presence/sanity, not a ratio
+    assert rec["int8_tokens_per_sec"] > 0 and rec["int8_speedup"] >= 0

@@ -31,8 +31,9 @@ def _full_logits(model, v, ids):
     return np.asarray(model.apply(v, ids), np.float32)
 
 
-@pytest.mark.slow
 def test_gpt_prefill_matches_full_forward(rng):
+    # deliberately NOT slow: the smoke tier keeps one real decode-parity
+    # check (this is the cheapest — one forward + one cached prefill)
     cfg = gpt_tiny_config()
     model = GPTModel(cfg)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)

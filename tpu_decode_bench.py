@@ -42,9 +42,11 @@ def main():
     if os.environ.get("APEX_TPU_DECODE_SMOKE") == "1":
         # CPU smoke: interpret-mode flash prefill at GPT-2 shapes is far
         # too slow; prove the harness mechanics on the tiny model instead
-        # (jax.config, not env — sitecustomize imports jax before us)
+        # (jax.config, not env — sitecustomize imports jax before us).
+        # n_new=16 keeps the differenced step window wide enough that
+        # scheduler noise can't zero the speedup ratio
         jax.config.update("jax_platforms", "cpu")
-        batch, prompt_len, n_new = 2, 8, 4
+        batch, prompt_len, n_new = 2, 8, 16
         cfg = gpt_tiny_config()
     else:
         batch, prompt_len, n_new = 8, 128, 128
@@ -55,19 +57,34 @@ def main():
                          jnp.int32)
     v = model.init(jax.random.PRNGKey(0), prompt[:, :8])
 
-    gen_1 = jax.jit(functools.partial(generate, model, max_new_tokens=1,
-                                      max_len=prompt_len + n_new,
-                                      axis_name="unbound"))
-    gen_n = jax.jit(functools.partial(generate, model, max_new_tokens=n_new,
-                                      max_len=prompt_len + n_new,
-                                      axis_name="unbound"))
-    jax.block_until_ready(gen_1(v, prompt))   # compile
-    jax.block_until_ready(gen_n(v, prompt))
-    t1 = time_best(lambda: gen_1(v, prompt))
-    tn = time_best(lambda: gen_n(v, prompt))
+    def measure(m, variables):
+        gen_1 = jax.jit(functools.partial(generate, m, max_new_tokens=1,
+                                          max_len=prompt_len + n_new,
+                                          axis_name="unbound"))
+        gen_n = jax.jit(functools.partial(generate, m,
+                                          max_new_tokens=n_new,
+                                          max_len=prompt_len + n_new,
+                                          axis_name="unbound"))
+        jax.block_until_ready(gen_1(variables, prompt))   # compile
+        jax.block_until_ready(gen_n(variables, prompt))
+        t1 = time_best(lambda: gen_1(variables, prompt))
+        tn = time_best(lambda: gen_n(variables, prompt))
+        steps = n_new - 1
+        return steps * batch / max(tn - t1, 1e-9), t1, tn, steps
 
-    steps = n_new - 1
-    toks_per_s = steps * batch / max(tn - t1, 1e-9)
+    toks_per_s, t1, tn, steps = measure(model, v)
+
+    # int8 W8A8 serving pass (docs/quantization.md): same weights,
+    # post-training-quantized — decode is weight-fetch bound, so this
+    # measures the HBM-bandwidth story directly
+    import dataclasses
+
+    from apex_tpu.models.quantize import quantize_model_params
+
+    qmodel = GPTModel(dataclasses.replace(cfg, quantize_int8=True))
+    qparams = quantize_model_params(qmodel, v, prompt[:, :8])
+    q_toks_per_s, _, _, _ = measure(qmodel, {"params": qparams})
+
     dev = jax.devices()[0]
     rec = {
         "metric": "gpt2_decode_tokens_per_sec_per_chip",
@@ -77,6 +94,8 @@ def main():
         "batch": batch, "prompt_len": prompt_len, "new_tokens": n_new,
         "step_ms": round(1e3 * (tn - t1) / steps, 3),
         "prefill_plus_one_s": round(t1, 3),
+        "int8_tokens_per_sec": round(q_toks_per_s, 1),
+        "int8_speedup": round(q_toks_per_s / max(toks_per_s, 1e-9), 3),
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(rec), flush=True)
