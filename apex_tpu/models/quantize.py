@@ -1,7 +1,7 @@
 """fp checkpoint -> int8-serving param tree (models with quantize_int8).
 
 Beyond reference (apex has no quantization story). The quantized models
-(``GPTConfig(quantize_int8=True)``, ``LlamaConfig(quantize_int8=True)``)
+(``quantize_int8=True`` on ``GPTConfig``/``LlamaConfig``/``T5Config``)
 expect each block linear's ``weight`` as int8 plus a per-output-channel
 ``scale`` (transformer/tensor_parallel/layers.py); this module produces
 that tree from a TRAINED fp tree — post-training quantization, the

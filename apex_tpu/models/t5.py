@@ -70,6 +70,9 @@ class T5Config:
     # v1.0 ties the LM head to the shared embedding with the d_model^-0.5
     # rescale; v1.1 (gated-gelu) unties it and drops the rescale
     tie_word_embeddings: bool = True
+    # int8 W8A8 serving for the block linears (same contract as
+    # GPTConfig.quantize_int8; embeddings/rel-bias/head stay fp)
+    quantize_int8: bool = False
     # practical cap for the decode cache/bias tables (T5's rel-bias has no
     # hard limit; this bounds the static decode buffers)
     max_position_embeddings: int = 512
@@ -153,7 +156,8 @@ class _T5SelfAttention(nn.Module):
 
         qkv = ColumnParallelLinear(
             cfg.d_model, 3 * inner, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="qkv")(h)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def to_bhsd(t):
@@ -175,7 +179,8 @@ class _T5SelfAttention(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         out = RowParallelLinear(
             inner, cfg.d_model, bias=False, input_is_parallel=True,
-            world_size=tp, params_dtype=cfg.param_dtype, name="out")(ctx)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="out")(ctx)
         return (out, cache) if cache is not None else out
 
 
@@ -198,10 +203,12 @@ class _T5CrossAttention(nn.Module):
 
         q = ColumnParallelLinear(
             cfg.d_model, inner, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="q")(h)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="q")(h)
         kv_proj = ColumnParallelLinear(
             cfg.d_model, 2 * inner, bias=False, gather_output=False,
-            world_size=tp, params_dtype=cfg.param_dtype, name="kv")
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="kv")
 
         def to_bhsd(t, length):
             return t.reshape(b, length, h_local, d).transpose(0, 2, 1, 3)
@@ -218,7 +225,8 @@ class _T5CrossAttention(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         out = RowParallelLinear(
             inner, cfg.d_model, bias=False, input_is_parallel=True,
-            world_size=tp, params_dtype=cfg.param_dtype, name="out")(ctx)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="out")(ctx)
         return (out, cache) if cache is not None else out
 
 
@@ -233,18 +241,21 @@ class _T5FFN(nn.Module):
             # v1.1: gate+up in one column-parallel GEMM (the Llama pattern)
             wi = ColumnParallelLinear(
                 cfg.d_model, 2 * cfg.d_ff, bias=False, gather_output=False,
-                world_size=tp, params_dtype=cfg.param_dtype, name="wi")(h)
+                world_size=tp, params_dtype=cfg.param_dtype,
+                quantize=cfg.quantize_int8, name="wi")(h)
             gate, up = jnp.split(wi, 2, axis=-1)
             act = jax.nn.gelu(gate, approximate=True) * up
         elif cfg.ff_act == "relu":
             act = jax.nn.relu(ColumnParallelLinear(
                 cfg.d_model, cfg.d_ff, bias=False, gather_output=False,
-                world_size=tp, params_dtype=cfg.param_dtype, name="wi")(h))
+                world_size=tp, params_dtype=cfg.param_dtype,
+                quantize=cfg.quantize_int8, name="wi")(h))
         else:
             raise ValueError(f"unknown ff_act {cfg.ff_act!r}")
         return RowParallelLinear(
             cfg.d_ff, cfg.d_model, bias=False, input_is_parallel=True,
-            world_size=tp, params_dtype=cfg.param_dtype, name="wo")(act)
+            world_size=tp, params_dtype=cfg.param_dtype,
+            quantize=cfg.quantize_int8, name="wo")(act)
 
 
 class T5EncoderBlock(nn.Module):
