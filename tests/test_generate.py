@@ -546,3 +546,28 @@ def test_rolling_cache_rejects_chunked_continuation(rng):
         model.apply(v, ids[:, 4:8], cache=cache)  # s=4 continuation
     with pytest.raises(ValueError):  # rolling without a window
         init_cache(dataclasses.replace(rcfg, sliding_window=None), 1, 8)
+
+
+@pytest.mark.slow
+def test_generate_gspmd_dp_sharded_batch(rng):
+    """The OTHER distribution path (no shard_map): jit + NamedSharding
+    params/batch — generate partitions under GSPMD and matches the
+    unsharded output."""
+    from jax.sharding import NamedSharding
+
+    from apex_tpu.mesh import build_mesh
+
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 5)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+    ref = np.asarray(generate(model, v, prompt, max_new_tokens=6))
+
+    mesh = build_mesh()  # all 8 virtual devices on the data axis
+    with mesh:
+        vs = jax.device_put(v, NamedSharding(mesh, P()))
+        ps = jax.device_put(prompt, NamedSharding(mesh, P("data")))
+        fn = jax.jit(functools.partial(generate, model, max_new_tokens=6,
+                                       axis_name="unbound"))
+        out = np.asarray(fn(vs, ps))
+    np.testing.assert_array_equal(out, ref)
