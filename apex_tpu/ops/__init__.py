@@ -22,4 +22,5 @@ from apex_tpu.ops.scaled_softmax import (  # noqa: F401
     scaled_softmax,
     scaled_upper_triang_masked_softmax,
 )
+from apex_tpu.ops.quant import int8_matmul, quantize_weight  # noqa: F401
 from apex_tpu.ops.xentropy import softmax_cross_entropy  # noqa: F401
