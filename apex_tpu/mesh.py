@@ -50,17 +50,41 @@ class MeshConfig:
     context: int = 1
 
 
+def _slice_key(d) -> int:
+    """Connectivity-domain id of a device: its TPU slice when exposed
+    (multi-slice pods — ICI only *within* a slice), else the host process
+    (multi-host CPU/DCN simulation). ``slice_index`` is only trusted on
+    TPU devices — distributed CPU backends expose it as 0 on every device,
+    which would collapse all processes into one 'slice'."""
+    if getattr(d, "platform", "") == "tpu":
+        s = getattr(d, "slice_index", None)
+        if s is not None:
+            return int(s)
+    return int(getattr(d, "process_index", 0))
+
+
 def build_mesh(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     context_parallel_size: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    dcn_data_parallel_size: int = 1,
 ) -> Mesh:
     """Build the global 4-axis mesh (data, stage, context, model).
 
     Mirrors ``initialize_model_parallel(tp, pp)`` from the reference
     (apex/transformer/parallel_state.py) but returns a Mesh instead of
     mutating process-group globals.
+
+    ``dcn_data_parallel_size`` > 1 requests topology-aware multi-slice
+    placement (the ``mesh_utils.create_hybrid_device_mesh`` analog, SURVEY
+    §2.4 closing: "ICI for intra-slice and DCN for multi-slice axes"):
+    devices are grouped by slice (``Device.slice_index``, falling back to
+    ``process_index`` off-TPU), each slice must hold a full tp*pp*cp block,
+    and the ``data`` axis is ordered slice-OUTER — consecutive data ranks
+    stay inside one slice (gradient reduce-scatter phases ride ICI) and only
+    the outermost data strides cross the DCN. ``model``/``stage``/
+    ``context`` never cross a slice boundary.
     """
     devices = list(devices) if devices is not None else jax.devices()
     n = len(devices)
@@ -73,7 +97,31 @@ def build_mesh(
             f"device count {n} is not divisible by tp({tp}) * pp({pp}) * cp({cp})"
         )
     dp = n // denom
-    dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
+    dcn = dcn_data_parallel_size
+    if dcn > 1:
+        groups: dict = {}
+        for d in devices:
+            groups.setdefault(_slice_key(d), []).append(d)
+        if len(groups) != dcn:
+            raise RuntimeError(
+                f"dcn_data_parallel_size={dcn} but the device list spans "
+                f"{len(groups)} slices/processes ({sorted(groups)})")
+        sizes = {k: len(v) for k, v in groups.items()}
+        if len(set(sizes.values())) != 1:
+            raise RuntimeError(f"uneven devices per slice: {sizes}")
+        per_slice = n // dcn
+        if per_slice % denom != 0:
+            raise RuntimeError(
+                f"per-slice device count {per_slice} is not divisible by "
+                f"tp({tp}) * pp({pp}) * cp({cp}) — model/stage/context axes "
+                "must not cross a slice (ICI) boundary")
+        # slice-major order: reshaping to (dcn, ici_dp, pp, cp, tp) keeps
+        # every non-data axis (and the inner data blocks) within one slice
+        ordered = [d for k in sorted(groups) for d in groups[k]]
+        dev_array = np.asarray(ordered).reshape(
+            dcn, per_slice // denom, pp, cp, tp).reshape(dp, pp, cp, tp)
+    else:
+        dev_array = np.asarray(devices).reshape(dp, pp, cp, tp)
     return Mesh(dev_array, axis_names=(DATA_AXIS, STAGE_AXIS, CONTEXT_AXIS, MODEL_AXIS))
 
 

@@ -26,7 +26,10 @@ from apex_tpu.models.generation import (  # noqa: F401
 )
 from apex_tpu.models import hf_convert  # noqa: F401
 from apex_tpu.models import quantize  # noqa: F401
-from apex_tpu.models.quantize import quantize_model_params  # noqa: F401
+from apex_tpu.models.quantize import (  # noqa: F401
+    assert_quantized_loaded,
+    quantize_model_params,
+)
 from apex_tpu.models import llama  # noqa: F401
 from apex_tpu.models.hf_convert import (  # noqa: F401
     bert_config_from_hf,

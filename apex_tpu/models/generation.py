@@ -448,7 +448,7 @@ def _gathered_log_softmax(logits, axis_name):
 
 def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
                      *, batch: int, num_beams: int, eos_token_id=None,
-                     length_penalty: float = 1.0,
+                     length_penalty: float = 1.0, length_offset: int = 0,
                      axis_name: str = MODEL_AXIS):
     """Static-shape beam search over a ``(batch*num_beams)``-row cache.
 
@@ -457,9 +457,13 @@ def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
     leading dim (``_gather_beam_cache``). Scan-collected (token, parent)
     backpointers are unwound after the loop — no growing arrays anywhere.
     Finished beams extend only with EOS at zero added score. Final ranking
-    divides by ``length^length_penalty`` (the HF convention; penalty 0 =
-    pure sum-logprob). Returns ``(sequences (batch, num_beams,
-    max_new_tokens), scores (batch, num_beams))``, best beam first.
+    divides by ``(length_offset + gen_length)^length_penalty`` where
+    ``gen_length`` counts generated tokens up to and including the first
+    EOS; callers pass ``length_offset`` = prompt (or decoder-start) token
+    count so the normalizer is the FULL hypothesis length, matching HF's
+    ``BeamSearchScorer`` (ADVICE r4; penalty 0 = pure sum-logprob).
+    Returns ``(sequences (batch, num_beams, max_new_tokens),
+    scores (batch, num_beams))``, best beam first.
 
     ``step_apply(tokens_(batch*num_beams,), cache) -> (logits_(bw,1,V),
     cache)`` — the same contract as ``decode_loop``; ``prefill_logits``
@@ -524,6 +528,7 @@ def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
         lengths = jnp.where(is_eos.any(axis=-1), first_eos, max_new_tokens)
     else:
         lengths = jnp.full((b, w), max_new_tokens)
+    lengths = lengths + length_offset  # full-hypothesis length (HF)
     final = scores / (lengths.astype(jnp.float32) ** jnp.float32(
         length_penalty))
     order = jnp.argsort(-final, axis=1)
@@ -556,7 +561,7 @@ def generate_beam(model, variables, prompt_ids, max_new_tokens: int, *,
         lambda tok, c: model.apply(variables, tok[:, None], cache=c),
         logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
         eos_token_id=eos_token_id, length_penalty=length_penalty,
-        axis_name=axis_name)
+        length_offset=s0, axis_name=axis_name)
     prompt_rep = jnp.broadcast_to(prompt_ids[:, None].astype(jnp.int32),
                                   (b, num_beams, s0))
     return jnp.concatenate([prompt_rep, seqs], axis=-1), scores

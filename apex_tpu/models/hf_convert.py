@@ -313,11 +313,19 @@ def bert_params_from_hf(state_dict, cfg) -> dict:
     return params
 
 
-def t5_config_from_hf(hf_config):
+def t5_config_from_hf(hf_config, max_position_embeddings=None):
     """Map a ``transformers.T5Config`` to :class:`T5Config` (fp32). Fails
-    loud on variants T5Model does not express."""
+    loud on variants T5Model does not express.
+
+    ``max_position_embeddings`` caps decoder positions (KV-cache length in
+    generation). T5's relative bias has no architectural limit, so the cap
+    is ours: default ``hf_config.n_positions`` when present, else 512. Pass
+    a larger value for long-output variants (ADVICE r4)."""
     from apex_tpu.models.t5 import T5Config
 
+    if max_position_embeddings is None:
+        max_position_embeddings = int(
+            getattr(hf_config, "n_positions", None) or 512)
     ff = getattr(hf_config, "feed_forward_proj", "relu")
     if ff not in ("relu", "gated-gelu"):
         raise NotImplementedError(
@@ -347,6 +355,7 @@ def t5_config_from_hf(hf_config):
             hf_config, "decoder_start_token_id", 0) or 0,
         tie_word_embeddings=bool(
             getattr(hf_config, "tie_word_embeddings", True)),
+        max_position_embeddings=max_position_embeddings,
     )
 
 

@@ -53,3 +53,29 @@ def quantize_model_params(qmodel, fp_variables, *example_args):
     target = jax.eval_shape(
         lambda: qmodel.init(jax.random.PRNGKey(0), *example_args))["params"]
     return quantize_params_like(target, fp_variables["params"])
+
+
+def assert_quantized_loaded(params) -> None:
+    """Fail loud if a quantized tree still holds its ``init()`` placeholders.
+
+    A model built with ``quantize_int8=True`` init()s every block linear to
+    all-zero int8 weights (real values come from ``quantize_model_params``
+    on a trained checkpoint) — serving such a tree silently produces zero
+    logits from every block linear (ADVICE r4). Call this before serving;
+    it raises ``ValueError`` naming the first all-zero int8 weight."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    from apex_tpu.optimizers.common import path_name
+
+    checked = 0
+    for path, leaf in leaves:
+        if getattr(leaf, "dtype", None) == jnp.int8:
+            checked += 1
+            if not bool(jnp.any(leaf != 0)):
+                raise ValueError(
+                    f"int8 weight {path_name(path)!r} is all zeros — this "
+                    "tree looks like init() placeholders; load real values "
+                    "with quantize_model_params() before serving")
+    if checked == 0:
+        raise ValueError(
+            "no int8 leaves found — was this model built with "
+            "quantize_int8=True?")

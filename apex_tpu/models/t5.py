@@ -478,4 +478,5 @@ def t5_beam_search(model: T5Model, variables, encoder_ids,
                                    method=T5Model.decode),
         logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
         eos_token_id=eos_token_id, length_penalty=length_penalty,
+        length_offset=1,  # the decoder_start token counts in HF's normalizer
         axis_name=axis_name)

@@ -21,9 +21,18 @@ def _backend() -> str:
 
 
 def interpret() -> bool:
-    """True when pallas_call must run in interpreter mode (non-TPU backend)."""
+    """True when pallas_call must run in interpreter mode (non-TPU backend).
+
+    ``APEX_TPU_FORCE_MOSAIC=1`` forces the Mosaic path even when the default
+    backend is CPU — used by the offline AOT evidence tier (``tpu_aot.py``),
+    which lowers kernels against a device-less TPU *topology*
+    (``jax.experimental.topologies``) where ``jax.default_backend()`` still
+    reports the host platform.
+    """
     if os.environ.get("APEX_TPU_FORCE_INTERPRET") == "1":
         return True
+    if os.environ.get("APEX_TPU_FORCE_MOSAIC") == "1":
+        return False
     return _backend() != "tpu"
 
 

@@ -83,8 +83,30 @@ def _block_sizes(sq: int, sk: int, block_q: Optional[int],
 # caches are not keyed on env vars — a mid-process flip would silently keep
 # serving the previously-compiled layout. Set the env before importing
 # apex_tpu (tests monkeypatch this constant + jax.clear_caches()).
-_TIGHT_HEADDIM = __import__("os").environ.get(
-    "APEX_TPU_FLASH_TIGHT_HEADDIM") == "1"
+#
+# Default resolution (r5 pre-staged flip): env var wins when set; otherwise
+# the layout turns ON once on-chip proof exists — ``_flash_tight_ok.json``,
+# written by run_tpu_round.sh only after the on-chip parity test
+# (test_flash_attention_tight_head_dim) passed AND the autotuner timed the
+# tight layout faster than the 128-padded default on the real chip. The
+# compile half of the gate is already discharged offline (AOT_r05.json:
+# flash_tight_headdim_* compile to tpu_custom_call on the v5e topology).
+def _tight_default() -> bool:
+    import json
+    import os
+
+    env = os.environ.get("APEX_TPU_FLASH_TIGHT_HEADDIM")
+    if env is not None:
+        return env == "1"
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "_flash_tight_ok.json")) as f:
+            return bool(json.load(f).get("ok"))
+    except Exception:
+        return False
+
+
+_TIGHT_HEADDIM = _tight_default()
 
 
 def _head_pad(d: int) -> int:

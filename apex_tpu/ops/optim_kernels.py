@@ -42,8 +42,17 @@ def _seg_pad(num_segments: int) -> int:
     return max(128, _dispatch.round_up(num_segments, 128))
 
 
-def _row_block(total_rows: int) -> int:
-    return min(256, _dispatch.round_up(total_rows, 8))
+def _row_block(total_rows: int, n_bufs: int = 5) -> int:
+    """Rows per grid step, sized to Mosaic's 16 MB scoped-VMEM stack.
+
+    ``n_bufs`` counts the big (blk, LANE) fp32 blocks live per step (inputs
+    + outputs). The Adam kernel (7 buffers + ~10 body temporaries) measured
+    17.91 MB of scoped stack at blk=256 — over the limit (caught offline by
+    tpu_aot.py at the BERT-Large buffer shape); halving the block halves the
+    stack. Kernels with <=6 buffers fit at 256.
+    """
+    cap = 256 if n_bufs <= 6 else 128
+    return min(cap, _dispatch.round_up(total_rows, 8))
 
 
 def _grid(total_rows: int, blk: int):
@@ -199,7 +208,7 @@ def adam_update(g, p, m, v, *, beta1, beta2, eps, weight_decay, lr, step,
     Returns (p, m, v) — inputs are donated/aliased.
     """
     total_rows = p.shape[0]
-    blk = _row_block(total_rows)
+    blk = _row_block(total_rows, n_bufs=7)  # g,p,m,v in + p,m,v out
     one = jnp.float32(1.0)
     step = jnp.asarray(step, jnp.float32)
     if bias_correction:

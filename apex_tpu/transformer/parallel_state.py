@@ -45,6 +45,7 @@ def initialize_model_parallel(
     *,
     context_parallel_size_: int = 1,
     devices=None,
+    dcn_data_parallel_size_: int = 1,
 ) -> Mesh:
     """Build and install the global mesh.
 
@@ -52,6 +53,8 @@ def initialize_model_parallel(
     the Mesh so callers can also use it directly with ``pjit``/``shard_map``.
     ``context_parallel_size_`` is a beyond-reference extension (ring
     attention); the reference has no context parallelism (SURVEY.md §2.4).
+    ``dcn_data_parallel_size_`` requests hybrid ICI-inner/DCN-outer placement
+    for multi-slice pods (see ``apex_tpu.mesh.build_mesh``).
     """
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
@@ -61,6 +64,7 @@ def initialize_model_parallel(
         pipeline_model_parallel_size_,
         context_parallel_size_,
         devices=devices,
+        dcn_data_parallel_size=dcn_data_parallel_size_,
     )
     mesh_lib.set_global_mesh(m)
     # reference sets the virtual rank to 0 whenever a virtual pp size is given

@@ -571,3 +571,29 @@ def test_generate_gspmd_dp_sharded_batch(rng):
                                        axis_name="unbound"))
         out = np.asarray(fn(vs, ps))
     np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.slow
+def test_beam_length_penalty_normalizes_full_hypothesis(rng):
+    """ADVICE r4: with length_penalty=1 and no EOS the returned score must
+    be sum-logprob / (prompt_len + gen_len) — HF's BeamSearchScorer
+    normalizes by the FULL hypothesis length, not just generated tokens."""
+    from apex_tpu.models.generation import generate_beam
+
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    s0, t = 4, 3
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, s0)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), prompt)
+
+    seqs, scores = generate_beam(model, v, prompt, max_new_tokens=t,
+                                 num_beams=2, length_penalty=1.0)
+    seqs, scores = np.asarray(seqs), np.asarray(scores)
+    for j in range(2):
+        ids = seqs[0, j]
+        logits = np.asarray(model.apply(v, jnp.asarray(ids[None])),
+                            np.float32)[0]
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        raw = sum(logp[s0 - 1 + k, ids[s0 + k]] for k in range(t))
+        np.testing.assert_allclose(scores[0, j], raw / (s0 + t),
+                                   rtol=2e-4, atol=2e-4)

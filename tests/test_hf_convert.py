@@ -221,3 +221,24 @@ def test_t5_logits_match_transformers(rng, ff, tie):
                                   jnp.asarray(enc_ids, jnp.int32),
                                   jnp.asarray(dec_ids, jnp.int32)))
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_t5_config_decode_cap_override_and_n_positions():
+    """ADVICE r4: the decode cap must be overridable and derive from
+    hf_config.n_positions when present instead of hard-coding 512."""
+    from types import SimpleNamespace
+
+    from apex_tpu.models.hf_convert import t5_config_from_hf
+
+    base = dict(feed_forward_proj="relu", num_layers=2, vocab_size=32,
+                d_model=16, d_ff=32, num_heads=2, d_kv=8,
+                relative_attention_num_buckets=8,
+                layer_norm_epsilon=1e-6, decoder_start_token_id=0,
+                tie_word_embeddings=True)
+    cfg = t5_config_from_hf(SimpleNamespace(**base))
+    assert cfg.max_position_embeddings == 512          # default unchanged
+    cfg = t5_config_from_hf(SimpleNamespace(**base, n_positions=2048))
+    assert cfg.max_position_embeddings == 2048         # derived
+    cfg = t5_config_from_hf(SimpleNamespace(**base, n_positions=2048),
+                            max_position_embeddings=4096)
+    assert cfg.max_position_embeddings == 4096         # explicit wins

@@ -60,6 +60,7 @@ def test_two_process_cluster_tp_gpt_step():
     results = []
     for i, out in enumerate(outs):
         assert f"PASS mesh pid={i}" in out, out[-2000:]
+        assert f"PASS hybrid pid={i}" in out, out[-2000:]
         m = re.search(rf"PASS step pid={i} loss=([\d.eE+-]+) "
                       rf"gnorm=([\d.eE+-]+)", out)
         assert m, out[-2000:]

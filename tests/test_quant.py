@@ -185,3 +185,25 @@ def test_quantized_t5_logits_faithful(rng, ff, tie):
     out = np.asarray(t5_generate(qmodel, {"params": qparams}, enc_ids,
                                  max_new_tokens=5))
     assert out.shape == (2, 5)
+
+
+def test_assert_quantized_loaded_guards_placeholders(rng):
+    """ADVICE r4: a quantize_int8 model init()s to all-zero int8 weights;
+    the guard must reject that tree, accept the converted one, and reject
+    a tree with no int8 leaves at all."""
+    from apex_tpu.models.quantize import assert_quantized_loaded
+
+    cfg = dataclasses.replace(gpt_tiny_config(), quantize_int8=True)
+    qmodel = GPTModel(cfg)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), jnp.int32)
+    placeholder = qmodel.init(jax.random.PRNGKey(0), ids)["params"]
+    with pytest.raises(ValueError, match="all zeros"):
+        assert_quantized_loaded(placeholder)
+
+    fp_model = GPTModel(gpt_tiny_config())
+    v = fp_model.init(jax.random.PRNGKey(0), ids)
+    qparams = quantize_model_params(qmodel, v, ids)
+    assert_quantized_loaded(qparams)  # must not raise
+
+    with pytest.raises(ValueError, match="no int8"):
+        assert_quantized_loaded(v["params"])

@@ -1,0 +1,554 @@
+"""Offline AOT-Mosaic evidence tier (VERDICT r4 next-round #1).
+
+Compiles every Pallas kernel at the on-chip suite's exact shapes — plus the
+full BERT-Large train step at the bench gate config and the flash-attention
+autotune candidate set — against a **device-less TPU topology**
+(``jax.experimental.topologies``). No tunnel, no chip: Mosaic block-rule
+violations, illegal layouts, and HBM blowups (the r3 86 GB relayout class)
+all surface at this compile/memory level.
+
+Recipe (judge-verified on this box, offline):
+  - ``get_topology_desc("v5e:2x4", platform="tpu")`` + ``make_mesh``
+  - wrap the kernel in ``shard_map`` with fully-replicated ``P()`` specs
+    (plain jit hits "Mosaic kernels cannot be automatically partitioned");
+    every device then runs the FULL arrays, so ``memory_analysis()`` is the
+    single-chip memory picture
+  - ``APEX_TPU_FORCE_MOSAIC=1`` so ``ops._dispatch.interpret()`` picks the
+    Mosaic path even though the default backend is CPU
+  - assert ``tpu_custom_call`` present in the lowered text and
+    argument+output+temp bytes under the v5e 16 GiB HBM budget
+
+Writes ``AOT_<tag>.json`` and prints one summary JSON line. Runs standalone
+(``python tpu_aot.py``) and is invoked by run_tpu_round.sh BEFORE the tunnel
+probe so a dead-tunnel round still banks this artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+import traceback
+
+os.environ["APEX_TPU_FORCE_MOSAIC"] = "1"
+# the CI subset (tests/test_aot_mosaic.py) may run while this sweep or the
+# tunnel watcher holds the libtpu lockfile — allow concurrent loads
+os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "1")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+HBM_BUDGET = 16 * 1024 ** 3  # v5e HBM per chip
+
+SEQ, HIDDEN, VOCAB = 512, 1024, 30528
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _setup_jax():
+    import jax
+
+    # sitecustomize imports jax with JAX_PLATFORMS=axon; flip the default
+    # backend to CPU so constant materialization never touches the (possibly
+    # dead) tunnel. The TPU work here is all topology-AOT.
+    jax.config.update("jax_platforms", "cpu")
+    import bench
+
+    bench._enable_compile_cache(jax)
+    return jax
+
+
+#: topology candidates, shared with tpu_profile.aot_overlap_check — keep
+#: the list in ONE place so the sweep and the overlap check never disagree
+TOPOLOGY_NAMES = ("v5e:2x4", "v5litepod-8", "v5e-8")
+
+
+def _topology():
+    from jax.experimental import topologies
+
+    errs = []
+    for name in TOPOLOGY_NAMES:
+        try:
+            topo = topologies.get_topology_desc(name, platform="tpu")
+            return name, topo
+        except Exception as e:  # noqa: BLE001
+            errs.append(f"{name}: {type(e).__name__}: {str(e)[:80]}")
+    raise RuntimeError("no TPU topology available: " + "; ".join(errs))
+
+
+def _mesh(topo):
+    from jax.experimental import topologies
+
+    return topologies.make_mesh(topo, (8,), ("data",))
+
+
+def compile_replicated(mesh, fn, arg_structs, donate=()):
+    """shard_map(fn) with all-replicated specs, AOT-compiled for the topology.
+
+    Returns (compiled, lowered_text). Each device runs the full arrays, so
+    per-device memory_analysis == the single-chip footprint.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sm = jax.shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                       check_vma=False)
+    repl = NamedSharding(mesh, P())
+
+    def stamp(s):
+        return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl)
+
+    args = jax.tree.map(stamp, tuple(arg_structs))
+    compiled = jax.jit(sm, donate_argnums=donate).lower(*args).compile()
+    return compiled
+
+
+def case_result(mesh, fn, arg_structs, donate=()):
+    import jax  # noqa: F401
+
+    t0 = time.perf_counter()
+    compiled = compile_replicated(mesh, fn, arg_structs, donate)
+    dt = time.perf_counter() - t0
+    txt = compiled.as_text()
+    ma = compiled.memory_analysis()
+    arg_b = int(ma.argument_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    tmp_b = int(ma.temp_size_in_bytes)
+    alias_b = int(getattr(ma, "alias_size_in_bytes", 0))
+    # donated inputs alias outputs — don't double count them
+    peak = arg_b + out_b + tmp_b - alias_b
+    return {
+        "ok": True,
+        "tpu_custom_call_sites": txt.count("tpu_custom_call"),
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "alias_bytes": alias_b,
+        "peak_estimate_bytes": peak,
+        "peak_estimate_gib": round(peak / 1024 ** 3, 3),
+        "under_16gib_budget": peak < HBM_BUDGET,
+        "compile_s": round(dt, 1),
+    }
+
+
+# ---------------------------------------------------------------------------
+# kernel cases — shapes mirror tests/test_real_tpu_kernels.py exactly
+# ---------------------------------------------------------------------------
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def kernel_cases():
+    """Yield (name, fn, arg_structs[, donate]) for every on-chip test config."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops import (flash_attention, flash_attention_with_lse,
+                              flat_buffer, optim_kernels,
+                              softmax_cross_entropy)
+    from apex_tpu.ops.group_norm import group_norm_nhwc
+    from apex_tpu.ops.layer_norm import layer_norm
+    from apex_tpu.ops.scaled_softmax import scaled_upper_triang_masked_softmax
+
+    f32, bf16, i32 = jnp.float32, jnp.bfloat16, jnp.int32
+
+    # -- test_layer_norm_fwd_bwd_bench_shapes
+    ln = functools.partial(layer_norm, eps=1e-12)
+    yield ("layer_norm_fwd", ln,
+           [_sds((8 * SEQ, HIDDEN), f32), _sds((HIDDEN,), f32),
+            _sds((HIDDEN,), f32)])
+    yield ("layer_norm_bwd",
+           jax.grad(lambda x, g, b: jnp.sum(ln(x, g, b) ** 2),
+                    argnums=(0, 1, 2)),
+           [_sds((8 * SEQ, HIDDEN), f32), _sds((HIDDEN,), f32),
+            _sds((HIDDEN,), f32)])
+
+    # -- test_flash_attention_fwd_bwd_seq512
+    qkv = [_sds((2, 16, SEQ, 64), bf16)] * 3
+    yield ("flash_fwd_seq512", flash_attention, qkv)
+    yield ("flash_bwd_seq512",
+           jax.grad(lambda q, k, v: jnp.sum(
+               flash_attention(q, k, v).astype(f32) ** 2),
+               argnums=(0, 1, 2)), qkv)
+
+    # -- test_flash_attention_causal_and_dropout_compile
+    q8 = _sds((2, 8, SEQ, 64), bf16)
+    cd = functools.partial(flash_attention, causal=True, dropout_rate=0.1,
+                           dropout_seed=7)
+    yield ("flash_causal_dropout_fwd", lambda q: cd(q, q, q), [q8])
+    yield ("flash_causal_dropout_bwd",
+           jax.grad(lambda q: jnp.sum(cd(q, q, q).astype(f32))), [q8])
+
+    # -- test_xentropy_vocab30528
+    n = 2 * SEQ
+    yield ("xentropy_fwd", softmax_cross_entropy,
+           [_sds((n, VOCAB), f32), _sds((n,), i32)])
+    yield ("xentropy_bwd",
+           jax.grad(lambda l, y: softmax_cross_entropy(l, y).sum()),
+           [_sds((n, VOCAB), f32), _sds((n,), i32)])
+
+    # -- test_scaled_masked_softmax_seq512
+    yield ("scaled_upper_triang_softmax",
+           functools.partial(scaled_upper_triang_masked_softmax, scale=0.125),
+           [_sds((64, SEQ, SEQ), bf16)])
+
+    # -- test_fused_optimizer_kernels_bert_large_size
+    opt_shapes = {"emb": (VOCAB, 64), "w1": (HIDDEN, HIDDEN),
+                  "w2": (4 * HIDDEN, HIDDEN), "b": (HIDDEN,)}
+    opt_tree = {k: _sds(s, f32) for k, s in opt_shapes.items()}
+    spec = flat_buffer.build_spec(opt_tree)
+    seg = np.asarray(spec.segment_rows())
+    buf = _sds((spec.total_rows, flat_buffer.LANE), f32)
+    yield ("optim_adam_bert_large_buffer",
+           functools.partial(optim_kernels.adam_update, beta1=0.9, beta2=0.999,
+                             eps=1e-8, weight_decay=0.01, lr=1e-3, step=1),
+           [buf] * 4, (1, 2, 3))
+    yield ("optim_lamb_bert_large_buffer",
+           lambda g, p, m, v: optim_kernels.lamb_update(
+               g, p, m, v, jnp.asarray(seg), spec.num_tensors, beta1=0.9,
+               beta2=0.999, eps=1e-6, weight_decay=0.01, lr=1e-3, step=1),
+           [buf] * 4, (1, 2, 3))
+    yield ("optim_global_grad_norm",
+           lambda g: optim_kernels.global_grad_norm_and_finite(
+               g, jnp.asarray(seg), spec.num_tensors),
+           [buf])
+
+    # -- test_group_norm_kernel_path / _backward_kernel_path
+    # custom_vjp nondiff_argnums must stay positional
+    gn = lambda x, w, b: group_norm_nhwc(x, w, b, 4, 1e-5, "silu")  # noqa: E731
+    yield ("group_norm_fwd_bf16", gn,
+           [_sds((4, 16, 16, 512), bf16), _sds((512,), f32),
+            _sds((512,), f32)])
+    yield ("group_norm_bwd_fp32",
+           jax.grad(lambda x, w, b: jnp.sum(gn(x, w, b) ** 2),
+                    argnums=(0, 1, 2)),
+           [_sds((2, 16, 16, 512), f32), _sds((512,), f32),
+            _sds((512,), f32)])
+
+    # -- test_flash_attention_with_lse_on_chip
+    yield ("flash_lse_fwd", flash_attention_with_lse,
+           [q8, q8, q8])
+    yield ("flash_lse_bwd_with_lse_cotangent",
+           jax.grad(lambda q, k, v: (
+               lambda o_lse: jnp.sum(o_lse[1]) +
+               jnp.sum(o_lse[0].astype(f32)))(
+               flash_attention_with_lse(q, k, v))),
+           [q8, q8, q8])
+
+    # -- test_flash_attention_sliding_window
+    yield ("flash_window_wide_fwd",
+           lambda q: flash_attention(q, q, q, causal=True, window=SEQ), [q8])
+    yield ("flash_window128_bwd",
+           jax.grad(lambda q: jnp.sum(flash_attention(
+               q, q, q, causal=True, window=128).astype(f32) ** 2)), [q8])
+
+
+def tight_headdim_cases():
+    """The compile half of the tight-head-dim gate (VERDICT r4 next #3):
+    module flag set, d=64 stays unpadded instead of zero-padding to 128."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa_impl = importlib.import_module("apex_tpu.ops.flash_attention")
+    flash_attention = fa_impl.flash_attention
+    q8 = _sds((2, 8, SEQ, 64), jnp.bfloat16)
+    qkv16 = [_sds((2, 16, SEQ, 64), jnp.bfloat16)] * 3
+
+    cases = [
+        ("flash_tight_headdim_fwd",
+         functools.partial(flash_attention, causal=True), [q8, q8, q8]),
+        ("flash_tight_headdim_bwd",
+         jax.grad(lambda q: jnp.sum(flash_attention(
+             q, q, q, causal=True).astype(jnp.float32) ** 2)), [q8]),
+        ("flash_tight_headdim_bench_shape_bwd",
+         jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+             q, k, v, causal=True).astype(jnp.float32) ** 2),
+             argnums=(0, 1, 2)), qkv16),
+    ]
+    return fa_impl, cases
+
+
+def moe_case():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.transformer.moe import MoEMLP
+
+    d, ff, e, k, t = 1024, 4096, 8, 2, 2048
+    layer = MoEMLP(hidden_size=d, ffn_hidden_size=ff, num_experts=e, k=k,
+                   capacity_factor=1.25, expert_world_size=1,
+                   axis_name="nope")
+    x_s = _sds((t, d), jnp.bfloat16)
+    abs_vars = jax.eval_shape(
+        lambda: layer.init(jax.random.PRNGKey(0),
+                           jnp.zeros((t, d), jnp.bfloat16)))
+    params_abs = abs_vars["params"]
+
+    def loss_and_grad(p, xx):
+        def f(pp):
+            y, aux = layer.apply({"params": pp}, xx)
+            return jnp.sum(y.astype(jnp.float32) ** 2) + aux.total
+        return jax.value_and_grad(f)(p)
+
+    return ("moe_dense_dispatch_grad", loss_and_grad, [params_abs, x_s])
+
+
+def bert_train_step_case(batch_per_chip=8, remat=False):
+    """The full bench-gate program: BERT-Large loss+grads+FusedLAMB update at
+    batch ``batch_per_chip``, seq 512 — all kernels in one compiled program.
+    Params/optimizer state are abstract (eval_shape + a field-initialized
+    FusedLAMB), so no 1.4 GB host arrays are materialized."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models import (BertForPreTraining, bert_large_config,
+                                 make_pretrain_step, synthetic_batch)
+    from apex_tpu.ops import flat_buffer
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.optimizers.common import path_name
+
+    cfg = bert_large_config()
+    if remat:
+        cfg = dataclasses.replace(cfg, remat=True)
+    model = BertForPreTraining(cfg)
+    rng = np.random.default_rng(0)
+    batch = synthetic_batch(rng, cfg, batch_per_chip, SEQ)
+    abs_params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), batch["input_ids"],
+                           batch["token_type_ids"],
+                           batch["attention_mask"])["params"])
+    spec = flat_buffer.build_spec(abs_params)
+    seg_rows = spec.segment_rows()
+
+    # field-initialize the optimizer facade (the ctor would materialize the
+    # master/state buffers; only spec/seg_rows/defaults matter for tracing)
+    opt = object.__new__(FusedLAMB)
+    opt.defaults = dict(lr=1e-4, beta1=0.9, beta2=0.999, eps=1e-6,
+                        weight_decay=0.01, max_grad_norm=1.0)
+    opt.spec = spec
+    opt.seg_rows = seg_rows
+    opt.bias_correction = True
+    opt.grad_averaging = True
+    opt.use_nvlamb = False
+    exclude = lambda n: "bias" in n or "norm" in n.lower()  # noqa: E731
+    paths, _ = jax.tree_util.tree_flatten_with_path(abs_params)
+    opt.wd_per_segment = np.asarray(
+        [0.0 if exclude(path_name(p)) else 0.01 for p, _ in paths],
+        np.float32)
+
+    step_fn = make_pretrain_step(model)
+    hyper = {k: jnp.float32(v) for k, v in opt.defaults.items()}
+
+    def train_step(params, master, m, v, stepc, batch, i):
+        loss, grads = step_fn(params, batch, i)
+        g_flat = flat_buffer.flatten(grads, spec)
+        new_step = stepc + 1
+        new_master, new_state = opt._update(
+            g_flat, master, {"m": m, "v": v}, new_step,
+            dict(hyper, grad_scale=jnp.float32(1.0), noop=jnp.float32(0.0),
+                 wd_per_segment=jnp.asarray(opt.wd_per_segment)))
+        params_out = flat_buffer.unflatten(new_master, spec)
+        return loss, params_out, new_master, new_state["m"], new_state["v"], new_step
+
+    buf = _sds((spec.total_rows, flat_buffer.LANE), jnp.float32)
+    batch_s = {k: _sds(tuple(np.shape(val)), jnp.asarray(val).dtype)
+               for k, val in batch.items()}
+    args = [abs_params, buf, buf, buf, _sds((), jnp.int32), batch_s,
+            _sds((), jnp.int32)]
+    name = f"bert_large_train_step_b{batch_per_chip}" + (
+        "_remat" if remat else "")
+    # donate master/m/v — mirrors FusedOptimizerBase's donate_argnums=(1, 2)
+    return (name, train_step, args, (1, 2, 3))
+
+
+# ---------------------------------------------------------------------------
+# autotune candidate compile sweep (VERDICT r4 next #3)
+# ---------------------------------------------------------------------------
+
+def autotune_candidate_sweep(mesh, tight_shapes=((8, 16, 512, 64),)):
+    """AOT-compile every (block_q, block_k) autotune candidate fwd+bwd at the
+    sweep shapes (tpu_autotune.SHAPES x CANDS) so the on-chip autotuner only
+    times, never debugs. Tight-head-dim variants at ``tight_shapes``."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_autotune
+
+    fa_impl = importlib.import_module("apex_tpu.ops.flash_attention")
+    flash_attention = fa_impl.flash_attention
+    out = {}
+    for shape in tpu_autotune.SHAPES:
+        b, h, s, d = shape
+        key = "x".join(map(str, shape))
+        out[key] = {}
+        for tight in (False, True):
+            if tight and shape not in tight_shapes:
+                continue
+            for bq, bk in tpu_autotune.CANDS:
+                if bq > s or bk > s:
+                    continue
+
+                def loss(q, k, v, bq=bq, bk=bk):
+                    o = flash_attention(q, k, v, causal=True,
+                                        block_q=bq, block_k=bk)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+
+                grad = jax.grad(loss, argnums=(0, 1, 2))
+                qkv = [_sds((b, h, s, d), jnp.bfloat16)] * 3
+                label = f"{bq},{bk}" + (",tight" if tight else "")
+                orig_tight = fa_impl._TIGHT_HEADDIM
+                fa_impl._TIGHT_HEADDIM = tight
+                try:
+                    t0 = time.perf_counter()
+                    compiled = compile_replicated(mesh, grad, qkv)
+                    txt = compiled.as_text()
+                    out[key][label] = {
+                        "ok": True,
+                        "sites": txt.count("tpu_custom_call"),
+                        "compile_s": round(time.perf_counter() - t0, 1),
+                    }
+                except Exception as e:  # noqa: BLE001
+                    out[key][label] = {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {str(e)[:160]}",
+                    }
+                finally:
+                    # restore the ambient default (may be True once the
+                    # on-chip marker lands), not a literal
+                    fa_impl._TIGHT_HEADDIM = orig_tight
+                log(f"  autotune {key} ({label}): "
+                    f"{'ok' if out[key][label]['ok'] else 'FAIL'}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def run(skip_autotune=False, skip_overlap=False, only=None):
+    jax = _setup_jax()  # noqa: F841
+    topo_name, topo = _topology()
+    mesh = _mesh(topo)
+    log(f"topology {topo_name}: {len(topo.devices)} devices")
+
+    results = {}
+
+    def run_case(name, fn, structs, donate=()):
+        if only and name not in only:
+            return
+        log(f"case {name}...")
+        try:
+            results[name] = case_result(mesh, fn, structs, donate)
+            r = results[name]
+            log(f"  ok: {r['tpu_custom_call_sites']} custom-call sites, "
+                f"peak {r['peak_estimate_gib']} GiB, {r['compile_s']}s")
+        except Exception as e:  # noqa: BLE001
+            log(traceback.format_exc())
+            results[name] = {"ok": False,
+                             "error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+    for case in kernel_cases():
+        run_case(*case)
+
+    fa_impl, tcases = tight_headdim_cases()
+    orig_tight = fa_impl._TIGHT_HEADDIM
+    fa_impl._TIGHT_HEADDIM = True
+    try:
+        for case in tcases:
+            run_case(*case)
+    finally:
+        fa_impl._TIGHT_HEADDIM = orig_tight
+
+    try:
+        run_case(*moe_case())
+    except Exception as e:  # noqa: BLE001
+        results["moe_dense_dispatch_grad"] = {
+            "ok": False, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+    for bpc, remat in ((8, False), (32, True)):
+        try:
+            run_case(*bert_train_step_case(bpc, remat))
+        except Exception as e:  # noqa: BLE001
+            log(traceback.format_exc())
+            results[f"bert_large_train_step_b{bpc}"] = {
+                "ok": False, "error": f"{type(e).__name__}: {str(e)[:300]}"}
+
+    out = {
+        "metric": "aot_mosaic_sweep",
+        "topology": topo_name,
+        "hbm_budget_bytes": HBM_BUDGET,
+        "cases": results,
+    }
+
+    if not skip_autotune and not only:
+        log("autotune candidate compile sweep...")
+        try:
+            out["autotune_candidates"] = autotune_candidate_sweep(mesh)
+        except Exception as e:  # noqa: BLE001
+            log(traceback.format_exc())
+            out["autotune_candidates_error"] = (
+                f"{type(e).__name__}: {str(e)[:300]}")
+
+    if not skip_overlap and not only:
+        log("AOT overlap check (tpu_profile)...")
+        try:
+            import tpu_profile
+
+            out["aot_overlap"] = tpu_profile.aot_overlap_check()
+        except Exception as e:  # noqa: BLE001
+            log(traceback.format_exc())
+            out["aot_overlap_error"] = f"{type(e).__name__}: {str(e)[:300]}"
+
+    n_ok = sum(1 for r in results.values() if r.get("ok"))
+    n_over = sum(1 for r in results.values()
+                 if r.get("ok") and not r.get("under_16gib_budget", True))
+    out["n_ok"] = n_ok
+    out["n_fail"] = len(results) - n_ok
+    out["n_over_budget"] = n_over
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-autotune", action="store_true")
+    ap.add_argument("--skip-overlap", action="store_true")
+    ap.add_argument("--only", nargs="*", default=None,
+                    help="run only the named cases (smoke/debug)")
+    args = ap.parse_args()
+
+    tag = os.environ.get("APEX_TPU_TAG", "session")
+    try:
+        out = run(args.skip_autotune, args.skip_overlap, args.only)
+    except Exception as e:  # noqa: BLE001
+        log(traceback.format_exc())
+        out = {"metric": "aot_mosaic_sweep",
+               "error": f"{type(e).__name__}: {e}"}
+    path = os.path.join(REPO, f"AOT_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"wrote {path}")
+    print(json.dumps({
+        "metric": "aot_mosaic_sweep",
+        "n_ok": out.get("n_ok", 0),
+        "n_fail": out.get("n_fail", 0),
+        "n_over_budget": out.get("n_over_budget", 0),
+        "wrote": os.path.basename(path),
+    }))
+
+
+if __name__ == "__main__":
+    main()
