@@ -47,13 +47,20 @@ def _force_mosaic():
 
 
 @pytest.fixture(scope="module")
-def mesh():
+def topo():
     import tpu_aot
 
     try:
-        _, topo = tpu_aot._topology()
+        _, t = tpu_aot._topology()
     except RuntimeError as e:
         pytest.skip(f"no TPU topology support in this jaxlib: {e}")
+    return t
+
+
+@pytest.fixture(scope="module")
+def mesh(topo):
+    import tpu_aot
+
     return tpu_aot._mesh(topo)
 
 
@@ -75,6 +82,20 @@ def test_kernel_compiles_to_mosaic_under_budget(name, mesh, cases):
     assert r["tpu_custom_call_sites"] >= 1, (
         "kernel lowered without a tpu_custom_call — interpret-mode leak?")
     assert r["under_16gib_budget"], r
+
+
+def test_multichip_ring_cp_compiles_for_tpu(topo):
+    """The context-parallel path has only ever RUN on the virtual CPU mesh
+    (interpret mode); this pins that the same sharded program — ring
+    attention rotating K/V by ppermute around Mosaic flash kernels —
+    COMPILES for the real v5e topology."""
+    import tpu_aot
+
+    r = tpu_aot.multichip_aot(topo, only=["cp2_ring_attention_grad"])
+    r = r["cp2_ring_attention_grad"]
+    assert r["ok"], r
+    assert r["tpu_custom_call_sites"] >= 2, "flash kernels missing"
+    assert r["collective_permutes"] >= 1, "ring rotation missing"
 
 
 def test_tight_headdim_compiles(mesh):
