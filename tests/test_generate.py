@@ -99,11 +99,15 @@ def test_generate_greedy_matches_teacher_forced(rng):
     assert out.shape == (2, 13)
     np.testing.assert_array_equal(out[:, :5], np.asarray(prompt))
 
-    seq = np.asarray(prompt)
-    for _ in range(8):
-        logits = _full_logits(model, v, jnp.asarray(seq))
-        nxt = logits[:, -1].argmax(-1).astype(np.int32)
-        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    # teacher-forced loop at ONE fixed shape: GPT is causal, so trailing
+    # padding can't influence position t-1 — one jitted apply reused 8
+    # times instead of 8 growing-length compiles (r5 rebalance)
+    apply = jax.jit(lambda ids: model.apply(v, ids))
+    seq = np.zeros((2, 13), np.int32)
+    seq[:, :5] = np.asarray(prompt)
+    for t in range(5, 13):
+        logits = np.asarray(apply(jnp.asarray(seq)), np.float32)
+        seq[:, t] = logits[:, t - 1].argmax(-1).astype(np.int32)
     np.testing.assert_array_equal(out, seq)
 
 
@@ -401,21 +405,28 @@ def test_beam_exhaustive_width_finds_global_optimum(rng):
 
     import itertools
 
-    def seq_score(row, cont):
-        ids = np.concatenate([np.asarray(prompt[row]), np.asarray(cont)])
-        logits = np.asarray(model.apply(v, jnp.asarray(ids[None])),
-                            np.float32)[0]
-        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
-        return sum(logp[2 + t, cont[t]] for t in range(len(cont)))
+    # brute force, BATCHED: all 64 continuations of one row score in a
+    # single jitted forward (was 128 un-jitted applies = 400+ s of test
+    # time for identical oracle strength)
+    conts = np.asarray(list(itertools.product(range(4), repeat=3)),
+                       np.int32)                              # (64, 3)
+
+    @jax.jit
+    def all_scores(full_ids):                                 # (64, 6)
+        logits = model.apply(v, full_ids)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        pos = jnp.arange(3) + 2
+        tok = full_ids[:, 3:]
+        return jnp.take_along_axis(
+            logp[:, pos, :], tok[..., None], axis=-1)[..., 0].sum(-1)
 
     for row in range(2):
-        best_cont, best = None, -np.inf
-        for cont in itertools.product(range(4), repeat=3):
-            s = seq_score(row, list(cont))
-            if s > best:
-                best_cont, best = cont, s
-        np.testing.assert_array_equal(seqs[row, 0, 3:], best_cont)
-        np.testing.assert_allclose(scores[row, 0], best, rtol=2e-4,
+        full = np.concatenate(
+            [np.broadcast_to(np.asarray(prompt[row]), (64, 3)), conts], 1)
+        s = np.asarray(all_scores(jnp.asarray(full)))
+        best = int(np.argmax(s))
+        np.testing.assert_array_equal(seqs[row, 0, 3:], conts[best])
+        np.testing.assert_allclose(scores[row, 0], s[best], rtol=2e-4,
                                    atol=2e-4)
 
 

@@ -258,8 +258,11 @@ def test_zigzag_gqa_matches_single_device(rng):
 
 
 @pytest.mark.slow
+# (4, 96) was dropped in the r5 tier rebalance: same "window spans chunks"
+# regime as (4, 48) with no new hop-liveness pattern, at ~72 s of
+# compile-bound test time on the 1-core box
 @pytest.mark.parametrize("cp,window", [(2, 24), (4, 48), (4, 300), (2, 1),
-                                       (4, 96), (4, 16)])
+                                       (4, 16)])
 def test_zigzag_sliding_window_matches_single_device(rng, cp, window):
     # (4, 16): hop 2 is wholly out-of-band (d_max=1) while hop 3 is live
     # via the LL wrap — the ONLY case exercising the composed delta=2
