@@ -33,6 +33,7 @@ CASE_NAMES = [
     "group_norm_bwd_fp32",
     "flash_lse_bwd_with_lse_cotangent",
     "flash_window128_bwd",
+    "gpt2_small_decode128_int8",      # serving path: scan decode + W8A8
 ]
 
 
@@ -82,6 +83,9 @@ def test_kernel_compiles_to_mosaic_under_budget(name, mesh, cases):
     assert r["tpu_custom_call_sites"] >= 1, (
         "kernel lowered without a tpu_custom_call — interpret-mode leak?")
     assert r["under_16gib_budget"], r
+    # static perf-lint: no copy/transpose result over 256 MiB (the r3
+    # 86 GB relayout class is visible in compiled text)
+    assert not r["giant_copy_flags"], r["giant_copy_flags"]
 
 
 def test_multichip_ring_cp_compiles_for_tpu(topo):

@@ -29,6 +29,7 @@ import argparse
 import functools
 import json
 import os
+import re
 import sys
 import time
 import traceback
@@ -106,6 +107,39 @@ def compile_replicated(mesh, fn, arg_structs, donate=()):
     return compiled
 
 
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s16": 2, "u16": 2,
+                "s64": 8, "u64": 8, "c64": 8, "c128": 16}
+
+
+def hlo_red_flags(txt, threshold_bytes=256 * 1024 * 1024):
+    """Static perf-lint over compiled HLO: copy/transpose ops whose RESULT
+    exceeds ``threshold_bytes``. The r3 86 GB incident was exactly this
+    class — a relayout intermediate far larger than any program tensor —
+    and it is visible in compiled text before a chip ever runs. Returns a
+    list of {op, bytes} (empty = clean).
+
+    Scans only the ENTRY computation: ops inside fusion bodies never
+    materialize their own buffers, so a big fused transpose is not a red
+    flag (code-review r5)."""
+    entry = txt.find("\nENTRY ")
+    if entry >= 0:
+        txt = txt[entry:]
+    flags = []
+    pat = re.compile(r"= (\w+)\[([\d,]*)\][^ ]* (copy|transpose)\(")
+    for m in pat.finditer(txt):
+        dt, dims, op = m.group(1), m.group(2), m.group(3)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        b = n * _DTYPE_BYTES.get(dt, 4)
+        if b > threshold_bytes:
+            flags.append({"op": op, "dtype": dt, "shape": dims, "bytes": b})
+    flags.sort(key=lambda f: -f["bytes"])
+    return flags[:8]
+
+
 def case_result(mesh, fn, arg_structs, donate=()):
     import jax  # noqa: F401
 
@@ -130,6 +164,7 @@ def case_result(mesh, fn, arg_structs, donate=()):
         "peak_estimate_bytes": peak,
         "peak_estimate_gib": round(peak / 1024 ** 3, 3),
         "under_16gib_budget": peak < HBM_BUDGET,
+        "giant_copy_flags": hlo_red_flags(txt),
         "compile_s": round(dt, 1),
     }
 
@@ -248,6 +283,37 @@ def kernel_cases():
     yield ("flash_window128_bwd",
            jax.grad(lambda q: jnp.sum(flash_attention(
                q, q, q, causal=True, window=128).astype(f32) ** 2)), [q8])
+
+    # -- serving path (r5): tpu_decode_bench.py's exact programs — flash
+    # prefill + lax.scan single-token decode + argmax, GPT-2 small at the
+    # bench config (batch 8, prompt 128, 128 new tokens, bf16), fp AND
+    # int8 W8A8. The decode path had only ever compiled on CPU.
+    import dataclasses
+
+    from apex_tpu.models.generation import generate
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+
+    dcfg = gpt2_small_config(dtype=bf16)
+    dmodel = GPTModel(dcfg)
+    prompt_s = _sds((8, 128), i32)
+    dvars = jax.eval_shape(
+        lambda: dmodel.init(jax.random.PRNGKey(0), jnp.zeros((8, 8), i32)))
+
+    def decode_fp(variables, prompt):
+        return generate(dmodel, variables, prompt, max_new_tokens=128,
+                        max_len=256, axis_name="unbound")
+
+    yield ("gpt2_small_decode128_fp", decode_fp, [dvars, prompt_s])
+
+    qmodel = GPTModel(dataclasses.replace(dcfg, quantize_int8=True))
+    qvars = jax.eval_shape(
+        lambda: qmodel.init(jax.random.PRNGKey(0), jnp.zeros((8, 8), i32)))
+
+    def decode_int8(variables, prompt):
+        return generate(qmodel, variables, prompt, max_new_tokens=128,
+                        max_len=256, axis_name="unbound")
+
+    yield ("gpt2_small_decode128_int8", decode_int8, [qvars, prompt_s])
 
 
 def tight_headdim_cases():
@@ -613,6 +679,7 @@ def multichip_aot(topo, only=None):
                 "all_to_alls": txt.count("all-to-all"),
                 "all_reduces": txt.count("all-reduce"),
                 "temp_bytes": int(ma.temp_size_in_bytes),
+                "giant_copy_flags": hlo_red_flags(txt),
                 "compile_s": round(time.perf_counter() - t0, 1),
             }
             r = out[name]
