@@ -79,6 +79,50 @@ if [ ! -f "apex_tpu/ops/_flash_block_table.json" ]; then
     > "AUTOTUNE_${TAG}.json.local" 2> "autotune_${TAG}.stderr.log" || true
   tail -2 "autotune_${TAG}.stderr.log"
 fi
+# tight-head-dim default flip (r5 pre-staged): enable the unpadded d=64
+# layout for future runs ONLY once (a) the on-chip parity test passed and
+# (b) the autotuner timed it faster than the 128-padded default on chip.
+# flash_attention._tight_default() consults the marker at import.
+if [ ! -f "apex_tpu/ops/_flash_tight_ok.json" ]; then
+  python - "$TAG" <<'EOF'
+import glob, json, sys
+tag = sys.argv[1]
+passed = False
+for path in glob.glob("TPU_TESTS_*.jsonl"):
+    for line in open(path):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (rec.get("test") == "test_flash_attention_tight_head_dim"
+                and rec.get("outcome") == "passed"
+                and rec.get("when") == "call"):
+            passed = True
+# consult EVERY round's autotune artifact: the parity pass and the timing
+# may land in different windows/rounds (autotune is skipped once the block
+# table exists), and both proofs remain valid across rounds
+speedup = None
+for path in sorted(glob.glob("AUTOTUNE_*.json.local")):
+    try:
+        with open(path) as f:
+            data = json.loads(f.read().strip().splitlines()[-1])
+    except Exception:
+        continue
+    if data.get("device") != "tpu":
+        continue
+    speedups = [s.get("tight_speedup") for s in data.get("shapes", {}).values()
+                if isinstance(s, dict) and s.get("tight_speedup")]
+    if speedups:
+        speedup = min(speedups) if speedup is None else min(speedup, min(speedups))
+if passed and speedup and speedup > 1.0:
+    with open("apex_tpu/ops/_flash_tight_ok.json", "w") as f:
+        json.dump({"ok": True, "min_speedup": speedup,
+                   "proof": "on-chip parity test + autotune timing"}, f)
+    print(f"[tight-headdim] ENABLED (min speedup {speedup:.2f}x)")
+else:
+    print(f"[tight-headdim] not enabled (passed={passed}, speedup={speedup})")
+EOF
+fi
 if [ ! -f "PROFILE_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] profiler trace + overlap check..."
   APEX_TPU_TAG="$TAG" timeout 3600 python tpu_profile.py \
@@ -138,50 +182,6 @@ if best_b != 8:
     shutil.copy(f"BENCH_{tag}_b{best_b}.json.local",
                 f"BENCH_{tag}.json.local")
 print(f"[batch escalation] winner: {best_b}/chip at {best_v:.0f} tok/s")
-EOF
-fi
-# tight-head-dim default flip (r5 pre-staged): enable the unpadded d=64
-# layout for future runs ONLY once (a) the on-chip parity test passed and
-# (b) the autotuner timed it faster than the 128-padded default on chip.
-# flash_attention._tight_default() consults the marker at import.
-if [ ! -f "apex_tpu/ops/_flash_tight_ok.json" ]; then
-  python - "$TAG" <<'EOF'
-import glob, json, sys
-tag = sys.argv[1]
-passed = False
-for path in glob.glob("TPU_TESTS_*.jsonl"):
-    for line in open(path):
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if (rec.get("test") == "test_flash_attention_tight_head_dim"
-                and rec.get("outcome") == "passed"
-                and rec.get("when") == "call"):
-            passed = True
-# consult EVERY round's autotune artifact: the parity pass and the timing
-# may land in different windows/rounds (autotune is skipped once the block
-# table exists), and both proofs remain valid across rounds
-speedup = None
-for path in sorted(glob.glob("AUTOTUNE_*.json.local")):
-    try:
-        with open(path) as f:
-            data = json.loads(f.read().strip().splitlines()[-1])
-    except Exception:
-        continue
-    if data.get("device") != "tpu":
-        continue
-    speedups = [s.get("tight_speedup") for s in data.get("shapes", {}).values()
-                if isinstance(s, dict) and s.get("tight_speedup")]
-    if speedups:
-        speedup = min(speedups) if speedup is None else min(speedup, min(speedups))
-if passed and speedup and speedup > 1.0:
-    with open("apex_tpu/ops/_flash_tight_ok.json", "w") as f:
-        json.dump({"ok": True, "min_speedup": speedup,
-                   "proof": "on-chip parity test + autotune timing"}, f)
-    print(f"[tight-headdim] ENABLED (min speedup {speedup:.2f}x)")
-else:
-    print(f"[tight-headdim] not enabled (passed={passed}, speedup={speedup})")
 EOF
 fi
 # decode-throughput harvest (beyond reference — no gate dependency beyond
