@@ -1,0 +1,70 @@
+"""Version-drift shims: run the package on older jax releases.
+
+The library is written against the current jax surface (``jax.shard_map``,
+``jax.typeof``, ``lax.axis_size``, ``lax.pcast``,
+``pallas.tpu.CompilerParams``). Older jaxlibs (0.4.x) ship the same
+functionality under earlier names — or, for the varying-manual-axes
+(vma) typing, not at all, in which case the correct degradation is a
+no-op (vma is a trace-time refinement; numerics are unchanged).
+
+Each patch is gated on the attribute being ABSENT, so on a current jax
+this module does nothing. Imported for its side effects from
+``apex_tpu/__init__.py`` before any kernel/layer module loads.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _install() -> None:
+    if not hasattr(jax, "typeof"):
+        # new-style jax.typeof(x) -> aval; .vma consumers use getattr with
+        # a frozenset() default, so the missing attribute degrades cleanly
+        def typeof(x):
+            return getattr(x, "aval", None) or jax.core.get_aval(x)
+
+        jax.typeof = typeof
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental import shard_map as _sm
+
+        def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kw):
+            # the old spelling of check_vma is check_rep
+            if check_vma is not None:
+                kw.setdefault("check_rep", check_vma)
+            return _sm.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of a unit literal constant-folds to the axis size and
+            # raises the same NameError on an unbound axis as the real API
+            # (axis_is_bound relies on that contract)
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size
+
+    if not hasattr(lax, "pcast"):
+        def pcast(x, axis_name, *, to=None):
+            # no vma typing on this jax: replicated->varying casts are
+            # identity (shard_map check_rep handles replication checks)
+            del axis_name, to
+            return x
+
+        lax.pcast = pcast
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas not available at all: kernels unusable
+        pass
+
+
+_install()

@@ -125,10 +125,21 @@ def check_chunk_bounds(cache, s: int, max_position_embeddings: int, *,
     return t0
 
 
+def is_paged(cache) -> bool:
+    """True for a paged serving cache (``apex_tpu/serving/kv_pool.py``):
+    per-layer page pools + per-SLOT block tables and lengths, recognized
+    by the ``block_tables`` key. ``cache["len"]`` is then a
+    ``(num_slots,)`` vector, not a scalar."""
+    return "block_tables" in cache
+
+
 def layer_cache(cache, i: int):
-    """Per-layer view for decoder block ``i`` (adds the shared length)."""
+    """Per-layer view for decoder block ``i`` (adds the shared length —
+    and, for a paged cache, the shared block tables)."""
     lc = dict(cache["layers"][i])
     lc["len"] = cache["len"]
+    if is_paged(cache):
+        lc["block_tables"] = cache["block_tables"]
     return lc
 
 
@@ -156,6 +167,28 @@ def update_layer_cache(lc, k_chunk, v_chunk):
                                         k_chunk.astype(lc["k"].dtype), start)
     out["v"] = lax.dynamic_update_slice(lc["v"],
                                         v_chunk.astype(lc["v"].dtype), start)
+    return out
+
+
+def update_paged_layer_cache(lc, k_chunk, v_chunk):
+    """Write a single-token ``(slots, kv, 1, d)`` K/V chunk into the page
+    pool at each slot's current length: slot ``b``'s token lands in page
+    ``block_tables[b, len_b // page_size]`` at offset ``len_b % page_size``.
+    Distinct slots own distinct pages, so the scatter indices never
+    collide; an idle slot (block table row all null-page) writes into the
+    reserved page 0, which no live sequence ever reads."""
+    ps = lc["k_pages"].shape[2]
+    max_pages = lc["block_tables"].shape[1]
+    t = lc["len"]                                            # (slots,)
+    page = jnp.take_along_axis(
+        lc["block_tables"], jnp.clip(t // ps, 0, max_pages - 1)[:, None],
+        axis=1)[:, 0]
+    off = t % ps
+    out = dict(lc)
+    out["k_pages"] = lc["k_pages"].at[page, :, off, :].set(
+        k_chunk[:, :, 0, :].astype(lc["k_pages"].dtype))
+    out["v_pages"] = lc["v_pages"].at[page, :, off, :].set(
+        v_chunk[:, :, 0, :].astype(lc["v_pages"].dtype))
     return out
 
 
@@ -226,13 +259,17 @@ def cached_attention_rolling(q, lc, *, window: int,
 def advance_cache(cache, new_layers, s: int):
     """Model-level reassembly after all blocks ran a chunk of length s.
     Plain-int arithmetic keeps a static length static across chunks; the
-    per-layer entries keep everything but the (shared) length — including
-    model-specific extras like T5's cross ``ck``/``cv``."""
-    return {
-        "layers": [{k: v for k, v in lc.items() if k != "len"}
-                   for lc in new_layers],
-        "len": cache["len"] + s,
-    }
+    per-layer entries keep everything but the shared keys (length, paged
+    block tables) — including model-specific extras like T5's cross
+    ``ck``/``cv``. Top-level extras (a paged cache's block tables and free
+    list) pass through untouched; a paged ``len`` is a per-slot vector and
+    advances elementwise."""
+    out = dict(cache)
+    out["layers"] = [{k: v for k, v in lc.items()
+                      if k not in ("len", "block_tables")}
+                     for lc in new_layers]
+    out["len"] = cache["len"] + s
+    return out
 
 
 def seal_cache(cache):
@@ -365,7 +402,8 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
              max_len: Optional[int] = None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng=None, eos_token_id: Optional[int] = None,
-             axis_name: str = MODEL_AXIS):
+             axis_name: str = MODEL_AXIS, paged: bool = False,
+             num_slots: Optional[int] = None, page_size: int = 16):
     """Prefill the prompt (flash-kernel path), then scan ``max_new_tokens``
     single-token decode steps. Returns ``(batch, prompt_len +
     max_new_tokens)`` token ids (prompt included). After ``eos_token_id``
@@ -373,7 +411,28 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
 
     Jittable end to end (``max_new_tokens`` static). Works plain, under
     ``jit`` with a dp-sharded batch, or inside ``shard_map`` with the
-    ``model`` axis bound (vocab-/head-sharded decode)."""
+    ``model`` axis bound (vocab-/head-sharded decode).
+
+    ``paged=True`` routes the batch through the continuous-batching
+    serving engine (``apex_tpu/serving``): each row becomes a queued
+    request over ``num_slots`` decode slots (default: the batch size)
+    backed by a paged KV pool — same greedy output, but EOS rows retire
+    and free their slot/pages instead of padding to ``max_new_tokens``.
+    Host-driven (not jittable as one program); greedy path is
+    token-identical to the lock-step scan."""
+    if paged:
+        from apex_tpu.serving import generate_paged
+
+        # same bounds contract as the lock-step path (max_len has no
+        # paged meaning beyond validation — the pool allocates by need)
+        validate_decode_bounds(prompt_ids.shape[1], max_new_tokens,
+                               model.config.max_position_embeddings,
+                               max_len)
+        return generate_paged(
+            model, variables, prompt_ids, max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+            eos_token_id=eos_token_id, axis_name=axis_name,
+            num_slots=num_slots, page_size=page_size)
     cfg = model.config
     b, s0 = prompt_ids.shape
     t_max = validate_decode_bounds(s0, max_new_tokens,
@@ -459,9 +518,12 @@ def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
     Finished beams extend only with EOS at zero added score. Final ranking
     divides by ``(length_offset + gen_length)^length_penalty`` where
     ``gen_length`` counts generated tokens up to and including the first
-    EOS; callers pass ``length_offset`` = prompt (or decoder-start) token
-    count so the normalizer is the FULL hypothesis length, matching HF's
-    ``BeamSearchScorer`` (ADVICE r4; penalty 0 = pure sum-logprob).
+    EOS. ``length_offset`` DEFAULTS TO 0 — the normalizer is the generated
+    length only, matching transformers >= 4.36 (``BeamSearchScorer``
+    divides by ``cur_len + 1 - decoder_prompt_len``, i.e. prompt and
+    decoder-start excluded; ADVICE r5 — the r4 full-hypothesis offset was
+    pre-4.36 legacy semantics). Penalty 0 = pure sum-logprob; the offset
+    knob remains for callers that want the legacy normalizer.
     Returns ``(sequences (batch, num_beams, max_new_tokens),
     scores (batch, num_beams))``, best beam first.
 
@@ -528,7 +590,7 @@ def beam_search_loop(step_apply, prefill_logits, cache, max_new_tokens: int,
         lengths = jnp.where(is_eos.any(axis=-1), first_eos, max_new_tokens)
     else:
         lengths = jnp.full((b, w), max_new_tokens)
-    lengths = lengths + length_offset  # full-hypothesis length (HF)
+    lengths = lengths + length_offset  # 0 by default: generated-only (HF)
     final = scores / (lengths.astype(jnp.float32) ** jnp.float32(
         length_penalty))
     order = jnp.argsort(-final, axis=1)
@@ -561,7 +623,7 @@ def generate_beam(model, variables, prompt_ids, max_new_tokens: int, *,
         lambda tok, c: model.apply(variables, tok[:, None], cache=c),
         logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
         eos_token_id=eos_token_id, length_penalty=length_penalty,
-        length_offset=s0, axis_name=axis_name)
+        axis_name=axis_name)
     prompt_rep = jnp.broadcast_to(prompt_ids[:, None].astype(jnp.int32),
                                   (b, num_beams, s0))
     return jnp.concatenate([prompt_rep, seqs], axis=-1), scores

@@ -24,8 +24,10 @@ from jax import lax
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.models.generation import (advance_cache, cached_attention,
-                                        check_chunk_bounds, is_static_prefill,
-                                        layer_cache, update_layer_cache)
+                                        check_chunk_bounds, is_paged,
+                                        is_static_prefill, layer_cache,
+                                        update_layer_cache,
+                                        update_paged_layer_cache)
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops import (flash_attention, ring_attention,
                           ring_attention_zigzag)
@@ -145,7 +147,19 @@ class ParallelDecoderBlock(nn.Module):
         def to_bhsd(t):
             return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
 
-        if cache is not None:
+        if cache is not None and is_paged(cache):
+            # paged serving decode (apex_tpu/serving): write this token's
+            # K/V into the slot's current page, then gather-attend over
+            # the block table with the Pallas paged kernel. Prefill never
+            # comes through here (the scheduler prefills via the
+            # contiguous flash path and scatters into pages).
+            from apex_tpu.ops.paged_attention import paged_attention
+
+            cache = update_paged_layer_cache(cache, to_bhsd(k), to_bhsd(v))
+            ctx = paged_attention(to_bhsd(q), cache["k_pages"],
+                                  cache["v_pages"], cache["block_tables"],
+                                  cache["len"] + 1)
+        elif cache is not None:
             # incremental decoding: append this chunk's K/V into the static
             # per-layer cache; a trace-time-provable prefill (static len 0)
             # attends with the training flash kernel (O(tile) memory),
@@ -233,8 +247,23 @@ class GPTModel(nn.Module):
                     "incremental decoding does not compose with context "
                     "parallelism; decode on a dp/tp mesh instead")
 
-            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
-            pos_s = lax.dynamic_slice_in_dim(pos, t0, s)
+            if is_paged(cache):
+                # paged serving decode: one token per SLOT, each at its own
+                # absolute position — gather per-slot position rows (the
+                # scheduler guards the position cap; idle slots sit at 0)
+                if s != 1:
+                    raise ValueError(
+                        "paged decode takes single-token steps only "
+                        "(prefill rides the contiguous flash path and is "
+                        "scattered into pages by the scheduler)")
+                pos_s = jnp.take(
+                    pos, jnp.clip(cache["len"], 0,
+                                  cfg.max_position_embeddings - 1),
+                    axis=0)[:, None, :]                      # (b, 1, e)
+            else:
+                t0 = check_chunk_bounds(cache, s,
+                                        cfg.max_position_embeddings)
+                pos_s = lax.dynamic_slice_in_dim(pos, t0, s)
         elif cfg.context_parallel and _axis_bound(CONTEXT_AXIS):
             # sequence sharded over ``context``: local chunk i covers global
             # positions [i*s, (i+1)*s) (or, zigzag, the two half-chunk
@@ -259,7 +288,9 @@ class GPTModel(nn.Module):
                 pos_s = lax.dynamic_slice_in_dim(pos, i * s, s)
         else:
             pos_s = pos[:s]
-        x = (x + pos_s[None, :, :]).astype(dt)
+        # paged decode built a per-slot (b, 1, e) gather; the other paths
+        # share one (s, e) row block broadcast over the batch
+        x = (x + (pos_s if pos_s.ndim == 3 else pos_s[None, :, :])).astype(dt)
         # nn.remat (lifted jax.checkpoint): same param tree, same sown
         # intermediates, recompute-in-backward per block
         block_cls = nn.remat(ParallelDecoderBlock) if cfg.remat and cache is None \

@@ -28,8 +28,9 @@ from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import CONTEXT_AXIS, MODEL_AXIS
 from apex_tpu.models.generation import (advance_cache, cached_attention,
                                         cached_attention_rolling,
-                                        check_chunk_bounds, is_static_prefill,
-                                        layer_cache, update_layer_cache,
+                                        check_chunk_bounds, is_paged,
+                                        is_static_prefill, layer_cache,
+                                        update_layer_cache,
                                         update_layer_cache_rolling)
 from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
@@ -273,6 +274,11 @@ class LlamaModel(nn.Module):
                     "incremental decoding does not compose with context "
                     "parallelism; decode on a dp/tp mesh instead")
 
+            if is_paged(cache):
+                raise NotImplementedError(
+                    "paged serving decode (apex_tpu/serving) is wired for "
+                    "GPT only so far; Llama needs per-slot RoPE tables and "
+                    "window-banded paged attention")
             if cfg.rolling_cache and not cfg.sliding_window:
                 raise ValueError("rolling_cache requires sliding_window")
             t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings,

@@ -366,8 +366,13 @@ class T5Model(nn.Module):
         else:
             from apex_tpu.models.generation import (advance_cache,
                                                     check_chunk_bounds,
-                                                    layer_cache)
+                                                    is_paged, layer_cache)
 
+            if is_paged(cache):
+                raise NotImplementedError(
+                    "paged serving decode (apex_tpu/serving) is wired for "
+                    "GPT only so far; T5 needs per-slot relative-position "
+                    "bias and paged cross-attention")
             t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
             t_max = cache["layers"][0]["k"].shape[2]
             q_pos = t0 + jnp.arange(s, dtype=jnp.int32)
@@ -478,5 +483,7 @@ def t5_beam_search(model: T5Model, variables, encoder_ids,
                                    method=T5Model.decode),
         logits, cache, max_new_tokens, batch=b, num_beams=num_beams,
         eos_token_id=eos_token_id, length_penalty=length_penalty,
-        length_offset=1,  # the decoder_start token counts in HF's normalizer
+        # length_offset stays 0: transformers >= 4.36 normalizes by
+        # cur_len + 1 - decoder_prompt_len — the decoder_start token is
+        # EXCLUDED (generated tokens only; ADVICE r5)
         axis_name=axis_name)

@@ -11,6 +11,10 @@ from apex_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention_with_lse,
     mha_reference,
 )
+from apex_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_reference,
+)
 from apex_tpu.ops.ring_attention import (  # noqa: F401
     from_zigzag,
     ring_attention,

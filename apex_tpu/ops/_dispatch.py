@@ -63,7 +63,10 @@ def pallas_call(kernel, *, out_shape, **kw):
             return lax.pcast(a, tuple(missing), to="varying") if missing else a
 
         def stamp(s):
-            if isinstance(s, jax.ShapeDtypeStruct):
+            # empty vma: pass s through untouched (also keeps older jax,
+            # whose ShapeDtypeStruct has no vma kwarg, working — there the
+            # union is always empty)
+            if isinstance(s, jax.ShapeDtypeStruct) and vma:
                 return jax.ShapeDtypeStruct(s.shape, s.dtype, vma=vma)
             return s
 

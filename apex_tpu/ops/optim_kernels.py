@@ -407,7 +407,10 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
     (apex/contrib/optimizers/distributed_fused_lamb.py).
     """
     total_rows = p.shape[0]
-    blk = _row_block(total_rows)
+    # phase 1 holds SEVEN big (blk, LANE) fp32 buffers (g,p,m,v in +
+    # u,m,v out) — the same count that pushed Adam to 17.91 MB of scoped
+    # VMEM at blk=256; cap the block at 128 (ADVICE r5)
+    blk = _row_block(total_rows, n_bufs=7)
     s_pad = _seg_pad(num_segments)
     one = jnp.float32(1.0)
     step = jnp.asarray(step, jnp.float32)

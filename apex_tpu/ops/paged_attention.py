@@ -1,0 +1,214 @@
+"""Pallas paged-attention decode kernel (vLLM-style, Kwon et al. 2023).
+
+Serving keeps each sequence's KV cache in fixed-size PAGES of a shared
+static pool (``apex_tpu/serving/kv_pool.py``) instead of one contiguous
+``(batch, kv, max_len, d)`` buffer per request batch: a sequence owns
+``ceil(len/page_size)`` pages named by its int32 block table, so HBM is
+allocated by actual length, freed pages are reusable the moment a request
+retires, and admission never reshapes anything.
+
+This kernel computes GQA attention for single-token (``s=1``) decode
+queries directly against the page pool. The block table rides in as a
+SCALAR-PREFETCH operand (``pltpu.PrefetchScalarGridSpec``) so the k/v
+BlockSpec index maps resolve the physical page for grid step ``j`` —
+``block_tables[b, j]`` — before the body runs: each (page_size, d) page
+tile is DMA'd HBM->VMEM exactly once, and the gather never materializes a
+contiguous copy of the sequence. Online softmax (m, l, acc) carries across
+the sequential page axis exactly like flash_attention's k-block axis; fp32
+scores and accumulation (same numerics contract).
+
+Layout: the pool is ``(num_pages, kv_heads, page_size, head_dim)`` — the
+page tile's minor two dims are then ``(page_size, head_dim)``, which
+satisfies Mosaic's (sublane, lane)-or-full-dim block rule for
+``page_size`` a sublane multiple and the usual head dims (64 = full minor
+dim, 128 = lane multiple). GQA queries reshape to ``(b, kv, rep, d)`` and
+contract against the UNexpanded kv-head pages (``rep`` = full dim), the
+same no-repeat discipline as flash_attention and cached_attention.
+
+Off-TPU the kernel runs through the Pallas interpreter
+(``ops/_dispatch.interpret``), so CPU tests cover the real kernel code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops import _dispatch
+from apex_tpu.ops.flash_attention import DEFAULT_MASK_VALUE
+
+_INTERPRET = _dispatch.interpret
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, page_size, max_pages):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = len_ref[b]
+
+    # page j holds absolute positions [j*ps, (j+1)*ps): dead pages (at or
+    # past the sequence end) skip both their FLOPs and their accumulator
+    # update; their DMA fetched whatever page id the table holds (0 = the
+    # reserved null page) — never read, so never wrong
+    @pl.when(j * page_size < seq_len)
+    def _body():
+        q = q_ref[0, 0]                                   # (rep, d)
+        k = k_ref[0, 0]                                   # (ps, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (rep, ps)
+        pos = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
+        live = pos < seq_len
+        s = jnp.where(live, s, DEFAULT_MASK_VALUE)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        v = v_ref[0, 0]
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == max_pages - 1)
+    def _finish():
+        l = l_ref[...]
+        # a zero-length slot (idle serving slot) outputs exactly 0
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _validate(q, k_pages, v_pages, block_tables, lengths):
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(f"q must be (batch, heads, 1, d) single-token "
+                         f"decode queries, got {q.shape}")
+    if k_pages.shape != v_pages.shape:
+        raise ValueError(f"k_pages {k_pages.shape} != v_pages "
+                         f"{v_pages.shape}")
+    num_pages, kv, page_size, d = k_pages.shape
+    b, h, _, qd = q.shape
+    if qd != d:
+        raise ValueError(f"head_dim mismatch: q {qd} vs pages {d}")
+    if h % kv != 0:
+        raise ValueError(f"q heads ({h}) must be a multiple of kv heads "
+                         f"({kv})")
+    if page_size % 8 != 0:
+        raise ValueError(f"page_size must be a sublane multiple (8), got "
+                         f"{page_size}")
+    if block_tables.ndim != 2 or block_tables.shape[0] != b:
+        raise ValueError(f"block_tables must be (batch, max_pages), got "
+                         f"{block_tables.shape} for batch {b}")
+    if lengths.shape != (b,):
+        raise ValueError(f"lengths must be ({b},), got {lengths.shape}")
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    scale: Optional[float] = None):
+    """Single-step GQA attention over a paged KV pool.
+
+    Args:
+      q: ``(batch, heads, 1, head_dim)`` — this step's queries, one token
+        per sequence slot.
+      k_pages / v_pages: ``(num_pages, kv_heads, page_size, head_dim)``
+        shared page pool (``kv_heads`` divides ``heads``; GQA never
+        expands).
+      block_tables: int32 ``(batch, max_pages)``; entry ``[b, j]`` is the
+        physical page holding slot ``b``'s positions
+        ``[j*page_size, (j+1)*page_size)``. Entries past a sequence's
+        allocation must hold a VALID page id (the pool reserves page 0 as
+        a null page) — they are fetched by the pipeline but never read.
+      lengths: int32 ``(batch,)`` — valid positions per slot INCLUDING the
+        current token (its K/V must already be written to the pool).
+        Length 0 (idle slot) outputs exactly 0.
+      scale: softmax scale; default ``1/sqrt(head_dim)``.
+
+    Returns ``(batch, heads, 1, head_dim)`` in ``q.dtype``.
+    """
+    _validate(q, k_pages, v_pages, block_tables, lengths)
+    num_pages, kv, page_size, d = k_pages.shape
+    b, h = q.shape[0], q.shape[1]
+    rep = h // kv
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    qr = q.reshape(b, kv, rep, d)
+    bt = block_tables.astype(jnp.int32)
+    ln = lengths.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, d),
+                         lambda b, h, j, bt, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, d),
+                               lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, d), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((rep, 1), jnp.float32),
+        ],
+    )
+    out = _dispatch.pallas_call(
+        functools.partial(_paged_kernel, scale=float(scale),
+                          page_size=page_size, max_pages=max_pages),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, rep, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_INTERPRET(),
+    )(bt, ln, qr, k_pages, v_pages)
+    return out.reshape(b, h, 1, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
+                              scale: Optional[float] = None):
+    """Pure-jnp ground truth: gather every table entry into a contiguous
+    ``(b, kv, max_pages*page_size, d)`` view and run dense masked GQA
+    attention — O(batch * max_len) HBM, exactly what the kernel avoids."""
+    _validate(q, k_pages, v_pages, block_tables, lengths)
+    num_pages, kv, page_size, d = k_pages.shape
+    b, h = q.shape[0], q.shape[1]
+    rep = h // kv
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    def contig(pages):
+        g = jnp.take(pages, block_tables, axis=0)      # (b, mp, kv, ps, d)
+        return g.transpose(0, 2, 1, 3, 4).reshape(b, kv, max_pages * page_size, d)
+
+    k = contig(k_pages).astype(jnp.float32)
+    v = contig(v_pages).astype(jnp.float32)
+    qf = q.reshape(b, kv, rep, d).astype(jnp.float32)
+    s = jnp.einsum("bkrd,bktd->bkrt", qf, k,
+                   preferred_element_type=jnp.float32) * jnp.float32(scale)
+    mask = (jnp.arange(max_pages * page_size, dtype=jnp.int32)[None, None, None]
+            < lengths[:, None, None, None])
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask, p, 0.0)  # length-0 rows: softmax(-inf row) -> NaN
+    ctx = jnp.einsum("bkrt,bktd->bkrd", p, v,
+                     preferred_element_type=jnp.float32)
+    return ctx.reshape(b, h, 1, d).astype(q.dtype)

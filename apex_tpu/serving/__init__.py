@@ -1,0 +1,38 @@
+"""The serving subsystem: paged KV cache + continuous-batching decode.
+
+Lock-step ``generate`` allocates one monolithic ``(batch, kv, max_len, d)``
+cache per request batch and pads every sequence to the longest — a finished
+sequence wastes its slot (and its cache HBM) until the whole batch drains.
+This package replaces that with the two serving-stack staples:
+
+- **Paged KV cache** (``kv_pool``): per-layer K/V live in a static
+  ``(num_pages, kv, page_size, d)`` pool; each sequence owns
+  ``ceil(len/page_size)`` pages named by an int32 block table. Alloc /
+  free / defrag are pure-JAX index ops over a fixed-size free stack — no
+  shape ever changes, so nothing recompiles at admission or retirement.
+  (vLLM / PagedAttention, Kwon et al. 2023.)
+- **Continuous batching** (``scheduler``): a fixed-size SLOT array of
+  in-flight sequences; at step boundaries finished slots retire (pages
+  freed) and queued requests admit into the vacancy — iteration-level
+  scheduling (Orca, Yu et al. 2022). The decode step itself is one jitted
+  program over the slot array, with per-slot lengths, EOS masks, and
+  remaining-token counts carried through a ``lax.scan``.
+
+The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
+that gathers pages via the block table with scalar-prefetch index maps.
+"""
+
+from apex_tpu.serving.kv_pool import (  # noqa: F401
+    alloc_slot,
+    defrag,
+    free_page_count,
+    free_slot,
+    init_paged_cache,
+    pages_for,
+    prefill_into_pages,
+)
+from apex_tpu.serving.scheduler import (  # noqa: F401
+    PagedDecodeEngine,
+    Request,
+    generate_paged,
+)

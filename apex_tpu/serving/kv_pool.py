@@ -1,0 +1,217 @@
+"""Paged KV pool: static page-granular cache storage + block tables.
+
+Layout (the PAGED cache pytree — a drop-in ``cache=`` argument for the
+models' incremental-decode path, recognized by its ``block_tables`` key):
+
+    pcache = {
+      "layers": [{"k_pages": (num_pages, kv_local, page_size, d),
+                  "v_pages": ...}] * num_layers,
+      "block_tables": (num_slots, max_pages_per_seq) int32,
+      "len":          (num_slots,) int32   # tokens written per slot
+      "alloc_pages":  (num_slots,) int32,  # pages OWNED per slot
+      "free_stack":   (num_pages,) int32,  # stack[0:free_top] = free pages
+      "free_top":     () int32,
+    }
+
+``alloc_pages`` tracks ownership, not occupancy: the scheduler allocates a
+request's worst case (``ceil((prompt+max_new)/page_size)``) up front, so a
+slot owns pages its length has not reached yet — free/defrag must treat
+those as live (freeing by ``ceil(len/page_size)`` would leak the tail).
+
+Page 0 is the reserved NULL page: never allocated, and every dead block
+table entry (idle slot, tail of a short sequence) points at it, so index
+maps and masked writes always resolve to a valid page — static shapes,
+no bounds branches. It is a SINK, not untouched storage: idle/done slots
+write their fill tokens' K/V there and attend over it (outputs masked or
+discarded) — no LIVE sequence ever reads it, but its contents are
+arbitrary finite garbage, so never repurpose it as zeroed or poisonable
+storage. The free list is a fixed-size int32 stack; alloc pops
+``n`` pages off the top with a masked gather, free pushes them back with
+a masked ``mode="drop"`` scatter — both jittable at one shape forever
+(the ``n`` is a traced scalar, the mask is what varies).
+
+The lane-alignment discipline mirrors ``ops/flat_buffer.py``: a page tile
+is ``(page_size, head_dim)``, so ``page_size`` must be a sublane multiple
+(8) and should be >= 16 for bf16 pools.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.ops._dispatch import cdiv
+from apex_tpu.transformer.utils import divide
+
+
+def page_size_of(cache) -> int:
+    return cache["layers"][0]["k_pages"].shape[2]
+
+
+def num_pages_of(cache) -> int:
+    return cache["layers"][0]["k_pages"].shape[0]
+
+
+def pages_for(length, page_size: int):
+    """Pages needed for ``length`` tokens (traced or static)."""
+    if isinstance(length, int):
+        return cdiv(length, page_size)
+    return (length + page_size - 1) // page_size
+
+
+def init_paged_cache(config, num_slots: int, *, num_pages: int,
+                     page_size: int = 16,
+                     max_pages_per_seq: Optional[int] = None, dtype=None):
+    """Allocate the shared page pool + empty slot state.
+
+    ``num_pages`` includes the reserved null page 0, so the usable
+    capacity is ``(num_pages - 1) * page_size`` tokens across all
+    in-flight sequences. ``max_pages_per_seq`` bounds one sequence's block
+    table (default: enough for ``max_position_embeddings``)."""
+    if page_size % 8 != 0:
+        raise ValueError(f"page_size must be a sublane multiple (8), got "
+                         f"{page_size}")
+    if num_pages < 2:
+        raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+    kv_heads = getattr(config, "num_kv_heads", config.num_heads)
+    kv_local = divide(kv_heads, config.tensor_parallel_size)
+    d = config.head_dim
+    dt = dtype if dtype is not None else resolve_compute_dtype(config.dtype)
+    if max_pages_per_seq is None:
+        max_pages_per_seq = cdiv(config.max_position_embeddings, page_size)
+    shape = (num_pages, kv_local, page_size, d)
+    layers = [{"k_pages": jnp.zeros(shape, dt), "v_pages": jnp.zeros(shape, dt)}
+              for _ in range(config.num_layers)]
+    return {
+        "layers": layers,
+        "block_tables": jnp.zeros((num_slots, max_pages_per_seq), jnp.int32),
+        "len": jnp.zeros((num_slots,), jnp.int32),
+        "alloc_pages": jnp.zeros((num_slots,), jnp.int32),
+        # pages 1..num_pages-1 free; popped from the top of the stack
+        "free_stack": jnp.arange(1, num_pages + 1, dtype=jnp.int32
+                                 ) % num_pages,
+        "free_top": jnp.asarray(num_pages - 1, jnp.int32),
+    }
+
+
+def free_page_count(cache):
+    return cache["free_top"]
+
+
+def alloc_slot(cache, slot, n_pages):
+    """Pop ``n_pages`` pages off the free stack and install them as slot
+    ``slot``'s block table row (entries past ``n_pages`` point at the null
+    page). ``slot``/``n_pages`` may be traced. The CALLER must ensure
+    ``free_page_count(cache) >= n_pages`` (the scheduler's admission
+    check) — the stack read clamps, so an over-alloc would silently hand
+    out duplicate pages."""
+    bt, stack, top = (cache["block_tables"], cache["free_stack"],
+                      cache["free_top"])
+    max_pages = bt.shape[1]
+    num_pages = stack.shape[0]
+    idx = jnp.arange(max_pages, dtype=jnp.int32)
+    take = idx < n_pages
+    src = jnp.clip(top - 1 - idx, 0, num_pages - 1)
+    row = jnp.where(take, stack[src], 0)
+    out = dict(cache)
+    out["free_top"] = top - jnp.asarray(n_pages, jnp.int32)
+    out["block_tables"] = bt.at[slot].set(row)
+    out["alloc_pages"] = cache["alloc_pages"].at[slot].set(
+        jnp.asarray(n_pages, jnp.int32))
+    return out
+
+
+def free_slot(cache, slot):
+    """Retire slot ``slot``: push ALL its owned pages (``alloc_pages``,
+    not just the length-covered prefix) back onto the free stack, reset
+    its block table row to the null page, and zero its length."""
+    bt, stack, top = (cache["block_tables"], cache["free_stack"],
+                      cache["free_top"])
+    max_pages = bt.shape[1]
+    num_pages = stack.shape[0]
+    row = bt[slot]
+    n = cache["alloc_pages"][slot]
+    idx = jnp.arange(max_pages, dtype=jnp.int32)
+    take = idx < n
+    dst = jnp.where(take, top + idx, num_pages)      # OOB -> dropped
+    out = dict(cache)
+    out["free_stack"] = stack.at[dst].set(row, mode="drop")
+    out["free_top"] = top + n.astype(jnp.int32)
+    out["block_tables"] = bt.at[slot].set(jnp.zeros((max_pages,), jnp.int32))
+    out["len"] = cache["len"].at[slot].set(0)
+    out["alloc_pages"] = cache["alloc_pages"].at[slot].set(0)
+    return out
+
+
+def defrag(cache):
+    """Compact live pages to the low end of the pool (stable order) and
+    rebuild the free stack from actual liveness.
+
+    With a block-table indirection fragmentation never costs correctness
+    or speed — any free page is as good as another — but compaction keeps
+    the live set prefix-dense (cheap pool-prefix checkpointing / shrink)
+    and doubles as a leak collector: a page reachable from no slot's table
+    returns to the free stack even if an earlier free miscounted. O(pool)
+    gather per layer — an explicit maintenance op, not a per-step one."""
+    bt = cache["block_tables"]
+    num_pages = num_pages_of(cache)
+    max_pages = bt.shape[1]
+
+    # liveness bound = OWNED pages (a slot's preallocated-but-unwritten
+    # tail is live: its future tokens land there)
+    used_entries = (jnp.arange(max_pages, dtype=jnp.int32)[None, :]
+                    < cache["alloc_pages"][:, None])         # (slots, mp)
+    live = jnp.zeros((num_pages,), bool).at[
+        jnp.where(used_entries, bt, 0)].set(True)
+    live = live.at[0].set(True)                  # null page stays page 0
+    n_live = jnp.sum(live.astype(jnp.int32))
+    new_idx = jnp.where(live, jnp.cumsum(live.astype(jnp.int32)) - 1,
+                        n_live + jnp.cumsum((~live).astype(jnp.int32)) - 1
+                        ).astype(jnp.int32)
+    old_of_new = jnp.zeros((num_pages,), jnp.int32).at[new_idx].set(
+        jnp.arange(num_pages, dtype=jnp.int32))
+
+    out = dict(cache)
+    out["layers"] = [{"k_pages": lc["k_pages"][old_of_new],
+                      "v_pages": lc["v_pages"][old_of_new]}
+                     for lc in cache["layers"]]
+    out["block_tables"] = jnp.where(used_entries, new_idx[bt], 0)
+    idx = jnp.arange(num_pages, dtype=jnp.int32)
+    out["free_stack"] = jnp.where(idx < num_pages - n_live, n_live + idx, 0)
+    out["free_top"] = (num_pages - n_live).astype(jnp.int32)
+    return out
+
+
+def prefill_into_pages(cache, slot, contig_layers, s0):
+    """Scatter a CONTIGUOUS prefill cache (the models' flash-prefill
+    output: per-layer ``k``/``v`` of shape ``(1, kv, len_bucket, d)``)
+    into slot ``slot``'s already-allocated pages, and set its length to
+    ``s0`` (traced OK; positions past ``s0`` — prompt-bucket padding —
+    scatter to the null page). Position ``p`` lands in table entry
+    ``p // page_size`` at offset ``p % page_size``."""
+    bt = cache["block_tables"]
+    ps = page_size_of(cache)
+    max_pages = bt.shape[1]
+    len_bucket = contig_layers[0]["k"].shape[2]
+    pos = jnp.arange(len_bucket, dtype=jnp.int32)
+    valid = pos < s0
+    row = bt[slot]
+    phys = jnp.where(valid, row[jnp.clip(pos // ps, 0, max_pages - 1)], 0)
+    off = pos % ps
+
+    out = dict(cache)
+    new_layers = []
+    for lc, src in zip(cache["layers"], contig_layers):
+        k = src["k"][0].transpose(1, 0, 2)       # (len_bucket, kv, d)
+        v = src["v"][0].transpose(1, 0, 2)
+        new_layers.append({
+            "k_pages": lc["k_pages"].at[phys, :, off, :].set(
+                k.astype(lc["k_pages"].dtype)),
+            "v_pages": lc["v_pages"].at[phys, :, off, :].set(
+                v.astype(lc["v_pages"].dtype)),
+        })
+    out["layers"] = new_layers
+    out["len"] = cache["len"].at[slot].set(jnp.asarray(s0, jnp.int32))
+    return out

@@ -1,0 +1,348 @@
+"""Continuous-batching decode engine over the paged KV pool.
+
+Iteration-level scheduling (Orca, Yu et al. 2022): a fixed array of
+``num_slots`` decode slots advances one token per engine step in a SINGLE
+jitted program; at step boundaries the host retires finished slots (EOS or
+token budget — their pages return to the free stack immediately) and
+admits queued requests into the vacancies. Short requests therefore never
+pad to the batch's longest, and a drained slot is re-filled instead of
+idling until the batch ends — the two wastes of lock-step ``generate``.
+
+Static shapes throughout: admission PREFILLS through the models' existing
+contiguous flash path at a page-size-rounded prompt bucket (one compile
+per bucket, reused forever), scatters the resulting K/V into the slot's
+pages, and the decode step is one program at one shape. Inside the step
+scan the carry holds per-slot (token, EOS-done mask, remaining-token
+count) — a finished slot keeps emitting EOS at its frozen state until the
+host syncs, exactly like ``decode_loop``'s EOS rows, so ``sync_every > 1``
+trades host syncs for (bounded) post-finish padding steps.
+
+Sampling reuses ``models/generation``'s helpers. Greedy decode is
+token-identical to per-request lock-step ``generate``; sampled decode
+derives each request's key stream from ``fold_in(rng, request_index)`` so
+outputs are SCHEDULING-INVARIANT (they depend on the request and the key,
+not on which slot or step the request landed in — stronger than lock-step,
+whose draws change with batch composition).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.models.generation import (_greedy_token, _sample_token,
+                                        init_cache, validate_sampling)
+from apex_tpu.ops._dispatch import round_up
+from apex_tpu.serving import kv_pool
+
+
+@dataclasses.dataclass
+class Request:
+    """One decode request: a 1-D int32 prompt and its token budget."""
+
+    prompt: Any                      # (s0,) int array
+    max_new_tokens: int
+
+
+def _donate_cache():
+    # buffer donation keeps the page pool in place across step/admit calls
+    # on TPU; the CPU backend has no donation and would warn every call
+    return (0,) if jax.default_backend() == "tpu" else ()
+
+
+class PagedDecodeEngine:
+    """Continuous-batching greedy/sampled decode over ``num_slots`` slots.
+
+    ``run(requests)`` processes the whole queue and returns
+    ``(outputs, stats)`` where ``outputs[i]`` is request ``i``'s generated
+    tokens (up to and including its first EOS) and ``stats`` counts engine
+    decode steps — the serving cost driver lock-step padding inflates.
+    """
+
+    def __init__(self, model, variables, *, num_slots: int,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_pages_per_seq: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, rng=None,
+                 sync_every: int = 1, axis_name: str = MODEL_AXIS):
+        cfg = model.config
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if sync_every < 1:
+            raise ValueError("sync_every must be >= 1")
+        self.model = model
+        self.variables = variables
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.page_size = page_size
+        self.eos_token_id = eos_token_id
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.rng = validate_sampling(temperature, top_k, top_p, rng)
+        self.sync_every = sync_every
+        self.axis_name = axis_name
+        if max_pages_per_seq is None:
+            max_pages_per_seq = kv_pool.cdiv(cfg.max_position_embeddings,
+                                             page_size)
+        if num_pages is None:
+            # worst case: every slot holds a max-length sequence (+ null)
+            num_pages = 1 + num_slots * max_pages_per_seq
+        self.cache = kv_pool.init_paged_cache(
+            cfg, num_slots, num_pages=num_pages, page_size=page_size,
+            max_pages_per_seq=max_pages_per_seq)
+        self._admit_jit = {}             # prompt bucket -> compiled admit
+        self._step_jit = None
+        self._free_jit = jax.jit(kv_pool.free_slot,
+                                 donate_argnums=_donate_cache())
+
+    # --- request-key sampling (scheduling-invariant streams) ----------------
+
+    def _first_token(self, last_logits, req_key):
+        if not self.temperature:
+            return _greedy_token(last_logits, self.axis_name)
+        return _sample_token(last_logits, jax.random.fold_in(req_key, 0),
+                             temperature=self.temperature, top_k=self.top_k,
+                             top_p=self.top_p, axis_name=self.axis_name)
+
+    # --- compiled programs --------------------------------------------------
+
+    def _admit_fn(self, bucket: int):
+        """Compile (once per prompt bucket): contiguous flash prefill at
+        ``bucket`` tokens, page alloc + scatter, first-token sample."""
+        if bucket in self._admit_jit:
+            return self._admit_jit[bucket]
+        model = self.model                       # static via closure
+
+        def admit(cache, variables, ids, s0, slot, n_pages, req_key):
+            contig = init_cache(self.cfg, 1, bucket)
+            logits, contig = model.apply(variables, ids, cache=contig)
+            last = lax.dynamic_slice_in_dim(logits, s0 - 1, 1, axis=1)[:, 0]
+            cache = kv_pool.alloc_slot(cache, slot, n_pages)
+            cache = kv_pool.prefill_into_pages(cache, slot,
+                                               contig["layers"], s0)
+            tok0 = self._first_token(last, req_key)[0]
+            return cache, tok0
+
+        fn = jax.jit(admit, donate_argnums=_donate_cache())
+        self._admit_jit[bucket] = fn
+        return fn
+
+    def _step_fn(self):
+        """Compile (once): ``sync_every`` decode steps as a ``lax.scan``
+        whose carry holds the paged cache and per-slot (token, done mask,
+        remaining-token count)."""
+        if self._step_jit is not None:
+            return self._step_jit
+        model = self.model
+        eos = self.eos_token_id
+
+        def one_step(variables, carry, _):
+            cache, tok, done, n_left, req_keys, samp_i = carry
+            len_before = cache["len"]
+            logits, cache = model.apply(variables, tok[:, None], cache=cache)
+            # freeze done/idle slots' lengths: their forward ran (static
+            # shapes) against the null-page sink, but their position must
+            # not creep — unbounded growth would walk the position table
+            # and scale null-page attention work with idle time
+            cache = dict(cache, len=jnp.where(done, len_before,
+                                              cache["len"]))
+            last = logits[:, 0]
+            if not self.temperature:
+                nxt = _greedy_token(last, self.axis_name)
+            else:
+                # key = fold_in(request key, the request's OWN token
+                # index) -> draws are scheduling-invariant (independent of
+                # slot, step, and batch composition)
+                keys = jax.vmap(jax.random.fold_in)(req_keys, samp_i)
+                nxt = jax.vmap(
+                    lambda lg, k: _sample_token(
+                        lg[None], k, temperature=self.temperature,
+                        top_k=self.top_k, top_p=self.top_p,
+                        axis_name=self.axis_name)[0])(last, keys)
+            fill = jnp.int32(eos if eos is not None else 0)
+            nxt = jnp.where(done, fill, nxt)
+            n_left = jnp.where(done, n_left, n_left - 1)
+            samp_i = samp_i + 1
+            if eos is not None:
+                done = jnp.logical_or(done, nxt == eos)
+            done = jnp.logical_or(done, n_left <= 0)
+            return (cache, nxt, done, n_left, req_keys, samp_i), nxt
+
+        def step(cache, variables, tok, done, n_left, req_keys, samp_i):
+            (cache, tok, done, n_left, _, samp_i), toks = lax.scan(
+                functools.partial(one_step, variables),
+                (cache, tok, done, n_left, req_keys, samp_i),
+                None, length=self.sync_every)
+            return cache, tok, done, n_left, samp_i, toks
+
+        self._step_jit = jax.jit(step, donate_argnums=_donate_cache())
+        return self._step_jit
+
+    # --- the host scheduling loop -------------------------------------------
+
+    def run(self, requests: Sequence[Request]):
+        """Drain the request queue; returns ``(outputs, stats)``.
+
+        ``outputs[i]``: np.int32 generated tokens for request ``i`` —
+        length ``max_new_tokens``, or shorter when the request hit EOS
+        (the EOS token is included). ``stats``: dict with
+        ``decode_steps`` (engine steps actually executed), ``admitted``,
+        and ``peak_slots_in_use``.
+        """
+        cfg, ps = self.cfg, self.page_size
+        max_pages = self.cache["block_tables"].shape[1]
+        for r in requests:
+            s0 = int(np.asarray(r.prompt).shape[0])
+            if r.max_new_tokens < 1:
+                raise ValueError("max_new_tokens must be >= 1")
+            if s0 + r.max_new_tokens > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"prompt ({s0}) + max_new_tokens ({r.max_new_tokens}) "
+                    f"exceeds max_position_embeddings="
+                    f"{cfg.max_position_embeddings}")
+            if kv_pool.pages_for(s0 + r.max_new_tokens, ps) > max_pages:
+                raise ValueError(
+                    f"request needs more than max_pages_per_seq="
+                    f"{max_pages} pages")
+
+        queue = deque(enumerate(requests))
+        outputs: List[Optional[np.ndarray]] = [None] * len(requests)
+        active = {}                       # slot -> mutable request record
+        tok = jnp.zeros((self.num_slots,), jnp.int32)
+        done = jnp.ones((self.num_slots,), bool)
+        n_left = jnp.zeros((self.num_slots,), jnp.int32)
+        samp_i = jnp.zeros((self.num_slots,), jnp.int32)
+        req_keys = jnp.broadcast_to(self.rng, (self.num_slots,)
+                                    + self.rng.shape)
+        steps = 0
+        peak = 0
+
+        def retire(slot):
+            rec = active.pop(slot)
+            outputs[rec["idx"]] = np.asarray(rec["tokens"], np.int32)
+            self.cache = self._free_jit(self.cache, jnp.int32(slot))
+
+        while queue or active:
+            # --- admission: fill vacant slots while pages last ----------
+            free_slots = [s for s in range(self.num_slots)
+                          if s not in active]
+            admitted_any = False
+            for slot in free_slots:
+                if not queue:
+                    break
+                idx, req = queue[0]
+                prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+                s0 = prompt.shape[0]
+                need = kv_pool.pages_for(s0 + req.max_new_tokens, ps)
+                if int(kv_pool.free_page_count(self.cache)) < need:
+                    break                 # head-of-line: wait for pages
+                queue.popleft()
+                bucket = min(round_up(max(s0, 1), ps),
+                             cfg.max_position_embeddings)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :s0] = prompt
+                req_key = jax.random.fold_in(self.rng, idx)
+                self.cache, tok0 = self._admit_fn(bucket)(
+                    self.cache, self.variables, jnp.asarray(ids),
+                    jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                    req_key)
+                tok0 = int(tok0)
+                rec = {"idx": idx, "tokens": [tok0],
+                       "max_new": req.max_new_tokens}
+                active[slot] = rec
+                admitted_any = True
+                if (self.eos_token_id is not None
+                        and tok0 == self.eos_token_id) \
+                        or req.max_new_tokens == 1:
+                    retire(slot)
+                    continue
+                tok = tok.at[slot].set(tok0)
+                done = done.at[slot].set(False)
+                n_left = n_left.at[slot].set(req.max_new_tokens - 1)
+                samp_i = samp_i.at[slot].set(1)   # token 0 drawn at admit
+                req_keys = req_keys.at[slot].set(req_key)
+            if not active:
+                if queue and not admitted_any:
+                    raise RuntimeError(
+                        "scheduler deadlock: queued request cannot be "
+                        "admitted (pool too small for its page demand?)")
+                continue
+            peak = max(peak, len(active))
+
+            # --- one jitted multi-step decode chunk ---------------------
+            self.cache, tok, done, n_left, samp_i, toks = self._step_fn()(
+                self.cache, self.variables, tok, done, n_left, req_keys,
+                samp_i)
+            steps += self.sync_every
+
+            # --- harvest + retirement at the sync boundary --------------
+            toks_np = np.asarray(toks)               # (sync_every, slots)
+            for slot in list(active):
+                rec = active[slot]
+                finished = False
+                for t in toks_np[:, slot]:
+                    t = int(t)
+                    rec["tokens"].append(t)
+                    if ((self.eos_token_id is not None
+                         and t == self.eos_token_id)
+                            or len(rec["tokens"]) >= rec["max_new"]):
+                        finished = True
+                        break
+                if finished:
+                    retire(slot)
+                    done = done.at[slot].set(True)
+
+        stats = {"decode_steps": steps, "admitted": len(requests),
+                 "peak_slots_in_use": peak}
+        return outputs, stats
+
+
+def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
+                   temperature: float = 0.0, top_k: Optional[int] = None,
+                   top_p: Optional[float] = None, rng=None,
+                   eos_token_id: Optional[int] = None,
+                   axis_name: str = MODEL_AXIS,
+                   num_slots: Optional[int] = None, page_size: int = 16,
+                   num_pages: Optional[int] = None, sync_every: int = 1,
+                   return_stats: bool = False):
+    """`generate`-shaped front end over the engine.
+
+    ``prompt_ids`` may be a rectangular ``(batch, s0)`` array (the
+    ``generate`` contract — returns ``(batch, s0 + max_new_tokens)`` with
+    prompts included and EOS padding after a row finishes, matching
+    lock-step output exactly under greedy decode) or a list of 1-D
+    prompts of MIXED lengths (returns a list of 1-D outputs)."""
+    rect = hasattr(prompt_ids, "ndim") and prompt_ids.ndim == 2
+    prompts = [np.asarray(p, np.int32).reshape(-1)
+               for p in (prompt_ids if not rect else np.asarray(prompt_ids))]
+    engine = PagedDecodeEngine(
+        model, variables,
+        num_slots=num_slots if num_slots is not None else len(prompts),
+        page_size=page_size, num_pages=num_pages,
+        eos_token_id=eos_token_id, temperature=temperature, top_k=top_k,
+        top_p=top_p, rng=rng, sync_every=sync_every, axis_name=axis_name)
+    reqs = [Request(prompt=p, max_new_tokens=max_new_tokens)
+            for p in prompts]
+    outs, stats = engine.run(reqs)
+
+    fill = eos_token_id if eos_token_id is not None else 0
+    full = []
+    for p, g in zip(prompts, outs):
+        g = np.asarray(g, np.int32)
+        pad = np.full((max_new_tokens - g.shape[0],), fill, np.int32)
+        full.append(np.concatenate([p, g, pad]))
+    if rect:
+        out = jnp.asarray(np.stack(full))
+        return (out, stats) if return_stats else out
+    out = [jnp.asarray(f) for f in full]
+    return (out, stats) if return_stats else out

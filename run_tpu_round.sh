@@ -115,10 +115,22 @@ for path in sorted(glob.glob("AUTOTUNE_*.json.local")):
     if speedups:
         speedup = min(speedups) if speedup is None else min(speedup, min(speedups))
 if passed and speedup and speedup > 1.0:
+    # key the marker to the revision + shape set it proves: _tight_default()
+    # ignores markers from other revisions / shape sets (ADVICE r5), so a
+    # later flash-kernel change can't serve a stale proof
+    from apex_tpu.ops.flash_attention import TIGHT_PROOF_SHAPES, _git_rev
+    rev = _git_rev() or ""
+    if not rev or rev.endswith("-dirty"):
+        # _tight_default() only accepts clean-tree proofs (a dirty rev
+        # names no reproducible code state) — don't write a dead marker
+        print(f"[tight-headdim] proof held but tree not clean (rev={rev!r});"
+              " commit first, then re-run")
+        raise SystemExit(0)
     with open("apex_tpu/ops/_flash_tight_ok.json", "w") as f:
-        json.dump({"ok": True, "min_speedup": speedup,
+        json.dump({"ok": True, "min_speedup": speedup, "rev": rev,
+                   "shapes": [list(s) for s in TIGHT_PROOF_SHAPES],
                    "proof": "on-chip parity test + autotune timing"}, f)
-    print(f"[tight-headdim] ENABLED (min speedup {speedup:.2f}x)")
+    print(f"[tight-headdim] ENABLED (min speedup {speedup:.2f}x, rev {rev[:12]})")
 else:
     print(f"[tight-headdim] not enabled (passed={passed}, speedup={speedup})")
 EOF

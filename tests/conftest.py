@@ -28,7 +28,14 @@ import jax  # noqa: E402
 if not REAL_TPU:
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: no jax_num_cpu_devices option — the XLA_FLAGS route
+        # works as long as the host backend has not been initialized yet
+        # (conftest runs before any test touches a device)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -585,10 +585,11 @@ def test_generate_gspmd_dp_sharded_batch(rng):
 
 
 @pytest.mark.slow
-def test_beam_length_penalty_normalizes_full_hypothesis(rng):
-    """ADVICE r4: with length_penalty=1 and no EOS the returned score must
-    be sum-logprob / (prompt_len + gen_len) — HF's BeamSearchScorer
-    normalizes by the FULL hypothesis length, not just generated tokens."""
+def test_beam_length_penalty_normalizes_generated_length(rng):
+    """ADVICE r5 (reverting the r4 change): with length_penalty=1 and no
+    EOS the returned score must be sum-logprob / gen_len — transformers
+    >= 4.36 normalizes by GENERATED length only (BeamSearchScorer divides
+    by cur_len + 1 - decoder_prompt_len; prompt excluded)."""
     from apex_tpu.models.generation import generate_beam
 
     cfg = gpt_tiny_config()
@@ -606,5 +607,5 @@ def test_beam_length_penalty_normalizes_full_hypothesis(rng):
                             np.float32)[0]
         logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
         raw = sum(logp[s0 - 1 + k, ids[s0 + k]] for k in range(t))
-        np.testing.assert_allclose(scores[0, j], raw / (s0 + t),
+        np.testing.assert_allclose(scores[0, j], raw / t,
                                    rtol=2e-4, atol=2e-4)

@@ -89,7 +89,8 @@ def _mesh(topo):
 def compile_replicated(mesh, fn, arg_structs, donate=()):
     """shard_map(fn) with all-replicated specs, AOT-compiled for the topology.
 
-    Returns (compiled, lowered_text). Each device runs the full arrays, so
+    Returns the compiled executable (callers read the lowered text via
+    ``compiled.as_text()``). Each device runs the full arrays, so
     per-device memory_analysis == the single-chip footprint.
     """
     import jax
@@ -250,6 +251,25 @@ def kernel_cases():
                g, p, m, v, jnp.asarray(seg), spec.num_tensors, beta1=0.9,
                beta2=0.999, eps=1e-6, weight_decay=0.01, lr=1e-3, step=1),
            [buf] * 4, (1, 2, 3))
+    # LAMB at more shapes (ADVICE r5): its phase-1 kernel holds 7 big
+    # (blk, LANE) buffers — the Adam-class scoped-VMEM risk — so sweep a
+    # GPT-2-small-sized buffer and an odd-row tail, not just BERT-Large
+    for lamb_tag, lamb_tree in (
+            ("gpt2s", {"emb": (50304, 16), "w1": (768, 768),
+                       "w2": (3072, 768), "b": (768,)}),
+            ("odd_tail", {"w": (1000, 1001), "b": (7,)}),
+    ):
+        lspec = flat_buffer.build_spec(
+            {k: _sds(s, f32) for k, s in lamb_tree.items()})
+        lseg = np.asarray(lspec.segment_rows())
+        lbuf = _sds((lspec.total_rows, flat_buffer.LANE), f32)
+        yield (f"optim_lamb_{lamb_tag}_buffer",
+               lambda g, p, m, v, lseg=lseg, lspec=lspec:
+               optim_kernels.lamb_update(
+                   g, p, m, v, jnp.asarray(lseg), lspec.num_tensors,
+                   beta1=0.9, beta2=0.999, eps=1e-6, weight_decay=0.01,
+                   lr=1e-3, step=1),
+               [lbuf] * 4, (1, 2, 3))
     yield ("optim_global_grad_norm",
            lambda g: optim_kernels.global_grad_norm_and_finite(
                g, jnp.asarray(seg), spec.num_tensors),
@@ -283,6 +303,17 @@ def kernel_cases():
     yield ("flash_window128_bwd",
            jax.grad(lambda q: jnp.sum(flash_attention(
                q, q, q, causal=True, window=128).astype(f32) ** 2)), [q8])
+
+    # -- paged-attention serving decode kernel (apex_tpu/serving): GPT-2
+    # small pool at 8 slots — 512 usable pages of 16 tokens (+ null page),
+    # 32-page tables (512-token sequences). Scalar-prefetch block tables
+    # are the new Mosaic feature this case gates.
+    from apex_tpu.ops.paged_attention import paged_attention
+
+    yield ("paged_attention_gpt2s_decode", paged_attention,
+           [_sds((8, 12, 1, 64), bf16), _sds((513, 12, 16, 64), bf16),
+            _sds((513, 12, 16, 64), bf16), _sds((8, 32), i32),
+            _sds((8,), i32)])
 
     # -- serving path (r5): tpu_decode_bench.py's exact programs — flash
     # prefill + lax.scan single-token decode + argmax, GPT-2 small at the
