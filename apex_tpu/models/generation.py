@@ -403,7 +403,8 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng=None, eos_token_id: Optional[int] = None,
              axis_name: str = MODEL_AXIS, paged: bool = False,
-             num_slots: Optional[int] = None, page_size: int = 16):
+             num_slots: Optional[int] = None, page_size: int = 16,
+             prefix_cache: bool = False):
     """Prefill the prompt (flash-kernel path), then scan ``max_new_tokens``
     single-token decode steps. Returns ``(batch, prompt_len +
     max_new_tokens)`` token ids (prompt included). After ``eos_token_id``
@@ -419,7 +420,13 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
     backed by a paged KV pool — same greedy output, but EOS rows retire
     and free their slot/pages instead of padding to ``max_new_tokens``.
     Host-driven (not jittable as one program); greedy path is
-    token-identical to the lock-step scan."""
+    token-identical to the lock-step scan. ``prefix_cache=True`` (paged
+    only) additionally shares cached K/V pages across requests with a
+    common prompt prefix — same outputs, prefill skipped for the shared
+    pages (``apex_tpu/serving/prefix_cache.py``)."""
+    if prefix_cache and not paged:
+        raise ValueError("prefix_cache requires paged=True (sharing lives "
+                         "in the page pool)")
     if paged:
         from apex_tpu.serving import generate_paged
 
@@ -432,7 +439,8 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
             model, variables, prompt_ids, max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
             eos_token_id=eos_token_id, axis_name=axis_name,
-            num_slots=num_slots, page_size=page_size)
+            num_slots=num_slots, page_size=page_size,
+            prefix_cache=prefix_cache)
     cfg = model.config
     b, s0 = prompt_ids.shape
     t_max = validate_decode_bounds(s0, max_new_tokens,

@@ -31,7 +31,8 @@ from apex_tpu.models.generation import (advance_cache, cached_attention,
                                         check_chunk_bounds, is_paged,
                                         is_static_prefill, layer_cache,
                                         update_layer_cache,
-                                        update_layer_cache_rolling)
+                                        update_layer_cache_rolling,
+                                        update_paged_layer_cache)
 from apex_tpu.models.gpt import lm_token_loss
 from apex_tpu.normalization import FusedRMSNorm
 from apex_tpu.ops import (flash_attention, ring_attention,
@@ -109,18 +110,25 @@ def llama_tiny_config(**overrides) -> LlamaConfig:
     return dataclasses.replace(base, **overrides)
 
 
-def _rope_cos_sin(cfg: LlamaConfig, s: int, offset):
-    """cos/sin tables for local positions [offset, offset+s), shape
-    (s, 1, 1, head_dim) — the cached-RoPE layout ([sq, b, np, hn])."""
+def _rope_freqs(cfg: LlamaConfig, pos):
+    """cos/sin rows for a vector of absolute positions — the ONE place
+    the RoPE frequency formula lives (contiguous offsets and the paged
+    per-slot gather both shape these rows). Returns ``(n, head_dim)``
+    pairs in the fused_rope rotate-half convention
+    ([first-half | second-half])."""
     d = cfg.head_dim
     inv = 1.0 / (cfg.rope_theta
                  ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = (jnp.arange(s, dtype=jnp.float32) + offset)[:, None]  # (s, d/2)
-    ang = pos * inv[None, :]
-    # fused_rope rotate-half convention: [first-half | second-half] pairs
-    freqs = jnp.concatenate([ang, ang], axis=-1)                # (s, d)
-    return (jnp.cos(freqs)[:, None, None, :],
-            jnp.sin(freqs)[:, None, None, :])
+    ang = pos.astype(jnp.float32)[:, None] * inv[None, :]       # (n, d/2)
+    freqs = jnp.concatenate([ang, ang], axis=-1)                # (n, d)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _rope_cos_sin(cfg: LlamaConfig, s: int, offset):
+    """cos/sin tables for local positions [offset, offset+s), shape
+    (s, 1, 1, head_dim) — the cached-RoPE layout ([sq, b, np, hn])."""
+    cos, sin = _rope_freqs(cfg, jnp.arange(s, dtype=jnp.int32) + offset)
+    return cos[:, None, None, :], sin[:, None, None, :]
 
 
 class LlamaDecoderBlock(nn.Module):
@@ -178,7 +186,18 @@ class LlamaDecoderBlock(nn.Module):
         # non-divisible ratios at the source.
         divide(h_local, kv_local)
 
-        if cache is not None:
+        if cache is not None and is_paged(cache):
+            # paged serving decode (apex_tpu/serving): write this token's
+            # RoPE'd K (and V) into the slot's current page, then
+            # gather-attend over the block table with the Pallas paged
+            # kernel — same wiring as gpt.py, with the model handing in
+            # per-slot cos/sin tables for each slot's own position
+            from apex_tpu.ops.paged_attention import paged_attention
+
+            cache = update_paged_layer_cache(cache, k, v)
+            ctx = paged_attention(q, cache["k_pages"], cache["v_pages"],
+                                  cache["block_tables"], cache["len"] + 1)
+        elif cache is not None:
             # incremental decoding: append K/V at the cache offset; a
             # trace-time-provable prefill rides the training flash kernel,
             # decode steps the absolute-position (windowed) masked product.
@@ -275,15 +294,39 @@ class LlamaModel(nn.Module):
                     "parallelism; decode on a dp/tp mesh instead")
 
             if is_paged(cache):
-                raise NotImplementedError(
-                    "paged serving decode (apex_tpu/serving) is wired for "
-                    "GPT only so far; Llama needs per-slot RoPE tables and "
-                    "window-banded paged attention")
-            if cfg.rolling_cache and not cfg.sliding_window:
-                raise ValueError("rolling_cache requires sliding_window")
-            t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings,
-                                    rolling=cfg.rolling_cache)
-            cos_, sin_ = _rope_cos_sin(cfg, s, t0)
+                # paged serving decode: one token per SLOT, each at its
+                # own absolute position — per-slot RoPE tables gather by
+                # the length vector (the paged analog of gpt.py's
+                # per-slot position-embedding gather; the scheduler
+                # guards the position cap, idle slots sit at 0)
+                if s != 1:
+                    raise ValueError(
+                        "paged decode takes single-token steps only "
+                        "(prefill rides the contiguous flash path and is "
+                        "scattered into pages by the scheduler)")
+                if cfg.sliding_window is not None:
+                    raise NotImplementedError(
+                        "paged serving decode does not band the paged "
+                        "kernel to a sliding window yet; decode windowed "
+                        "models on the contiguous or rolling cache")
+                if cfg.rolling_cache:
+                    raise NotImplementedError(
+                        "rolling_cache (ring buffer) does not compose "
+                        "with the paged pool — pages already bound HBM")
+                pos = jnp.clip(cache["len"], 0,
+                               cfg.max_position_embeddings - 1)  # (slots,)
+                cos, sin = _rope_freqs(cfg, pos)
+                # rope layout [sq=1, b, np=1, hn]: per-slot tables ride
+                # the batch axis and broadcast over heads
+                cos_ = cos[None, :, None, :]
+                sin_ = sin[None, :, None, :]
+            else:
+                if cfg.rolling_cache and not cfg.sliding_window:
+                    raise ValueError("rolling_cache requires sliding_window")
+                t0 = check_chunk_bounds(cache, s,
+                                        cfg.max_position_embeddings,
+                                        rolling=cfg.rolling_cache)
+                cos_, sin_ = _rope_cos_sin(cfg, s, t0)
         else:
             cp = (lax.axis_size(CONTEXT_AXIS)
                   if cfg.context_parallel and _axis_bound(CONTEXT_AXIS) else 1)

@@ -370,9 +370,10 @@ class T5Model(nn.Module):
 
             if is_paged(cache):
                 raise NotImplementedError(
-                    "paged serving decode (apex_tpu/serving) is wired for "
-                    "GPT only so far; T5 needs per-slot relative-position "
-                    "bias and paged cross-attention")
+                    "paged serving decode (apex_tpu/serving) covers the "
+                    "decoder-only families (GPT, Llama); T5 needs "
+                    "per-slot relative-position bias and paged "
+                    "cross-attention")
             t0 = check_chunk_bounds(cache, s, cfg.max_position_embeddings)
             t_max = cache["layers"][0]["k"].shape[2]
             q_pos = t0 + jnp.arange(s, dtype=jnp.int32)

@@ -18,21 +18,35 @@ This package replaces that with the two serving-stack staples:
   program over the slot array, with per-slot lengths, EOS masks, and
   remaining-token counts carried through a ``lax.scan``.
 
+- **Shared-prefix caching** (``prefix_cache``): a host-side radix tree
+  over token ids whose nodes name pool pages already holding that
+  prefix's K/V — requests sharing a system prompt / few-shot header skip
+  its prefill entirely, sharing the pages read-only under per-page int32
+  refcounts with LRU eviction at refcount 0 (RadixAttention, Zheng et
+  al. 2023). Opt-in via ``PagedDecodeEngine(..., prefix_cache=True)`` /
+  ``generate(..., paged=True, prefix_cache=True)``.
+
 The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
 that gathers pages via the block table with scalar-prefetch index maps.
 """
 
 from apex_tpu.serving.kv_pool import (  # noqa: F401
     alloc_slot,
+    alloc_slot_shared,
     defrag,
+    defrag_map,
+    evict_pages,
     free_page_count,
     free_slot,
     init_paged_cache,
     pages_for,
     prefill_into_pages,
+    release_slot,
 )
+from apex_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     PagedDecodeEngine,
     Request,
     generate_paged,
+    make_shared_admit,
 )

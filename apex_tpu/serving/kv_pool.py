@@ -9,6 +9,8 @@ models' incremental-decode path, recognized by its ``block_tables`` key):
       "block_tables": (num_slots, max_pages_per_seq) int32,
       "len":          (num_slots,) int32   # tokens written per slot
       "alloc_pages":  (num_slots,) int32,  # pages OWNED per slot
+      "shared_pages": (num_slots,) int32,  # leading SHARED (cached) entries
+      "page_ref":     (num_pages,) int32,  # active readers per shared page
       "free_stack":   (num_pages,) int32,  # stack[0:free_top] = free pages
       "free_top":     () int32,
     }
@@ -17,6 +19,18 @@ models' incremental-decode path, recognized by its ``block_tables`` key):
 request's worst case (``ceil((prompt+max_new)/page_size)``) up front, so a
 slot owns pages its length has not reached yet — free/defrag must treat
 those as live (freeing by ``ceil(len/page_size)`` would leak the tail).
+
+Prefix caching (``serving/prefix_cache.py``) adds page SHARING on top of
+ownership: a slot's block-table row is ``[shared cached pages | owned
+private pages | null...]``. The first ``shared_pages[slot]`` entries are
+owned by the radix prefix cache and only READ by the slot (pages are
+position-indexed, and the decode step never writes below a slot's length,
+so read-only sharing is safe); ``page_ref`` counts, per page, how many
+active slots currently share it — the eviction guard: a cached page may
+return to the free stack only at refcount 0. Shared entries are installed
+by ``alloc_slot_shared`` (refcount +1) and released by ``free_slot`` /
+``release_slot`` (refcount -1, page NOT pushed to the free stack — the
+cache still holds it).
 
 Page 0 is the reserved NULL page: never allocated, and every dead block
 table entry (idle slot, tail of a short sequence) points at it, so index
@@ -89,6 +103,8 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
         "block_tables": jnp.zeros((num_slots, max_pages_per_seq), jnp.int32),
         "len": jnp.zeros((num_slots,), jnp.int32),
         "alloc_pages": jnp.zeros((num_slots,), jnp.int32),
+        "shared_pages": jnp.zeros((num_slots,), jnp.int32),
+        "page_ref": jnp.zeros((num_pages,), jnp.int32),
         # pages 1..num_pages-1 free; popped from the top of the stack
         "free_stack": jnp.arange(1, num_pages + 1, dtype=jnp.int32
                                  ) % num_pages,
@@ -120,51 +136,133 @@ def alloc_slot(cache, slot, n_pages):
     out["block_tables"] = bt.at[slot].set(row)
     out["alloc_pages"] = cache["alloc_pages"].at[slot].set(
         jnp.asarray(n_pages, jnp.int32))
+    out["shared_pages"] = cache["shared_pages"].at[slot].set(0)
+    return out
+
+
+def alloc_slot_shared(cache, slot, shared_row, n_shared, n_private):
+    """Install slot ``slot``'s block table row as ``[shared cached pages |
+    freshly popped private pages | null...]``: the first ``n_shared``
+    entries come from ``shared_row`` (physical pages the prefix cache
+    holds — refcount +1 each, NOT popped from the stack), the next
+    ``n_private`` pop off the free stack as in ``alloc_slot``. Same caller
+    contract: ``free_page_count(cache) >= n_private``."""
+    bt, stack, top = (cache["block_tables"], cache["free_stack"],
+                      cache["free_top"])
+    max_pages = bt.shape[1]
+    num_pages = stack.shape[0]
+    n_shared = jnp.asarray(n_shared, jnp.int32)
+    n_private = jnp.asarray(n_private, jnp.int32)
+    idx = jnp.arange(max_pages, dtype=jnp.int32)
+    take_priv = jnp.logical_and(idx >= n_shared, idx < n_shared + n_private)
+    src = jnp.clip(top - 1 - (idx - n_shared), 0, num_pages - 1)
+    row = jnp.where(idx < n_shared, shared_row,
+                    jnp.where(take_priv, stack[src], 0))
+    out = dict(cache)
+    out["free_top"] = top - n_private
+    out["block_tables"] = bt.at[slot].set(row)
+    out["alloc_pages"] = cache["alloc_pages"].at[slot].set(n_private)
+    out["shared_pages"] = cache["shared_pages"].at[slot].set(n_shared)
+    ref_ids = jnp.where(idx < n_shared, shared_row, num_pages)  # OOB drops
+    out["page_ref"] = cache["page_ref"].at[ref_ids].add(1, mode="drop")
+    return out
+
+
+def release_slot(cache, slot, keep):
+    """Retire slot ``slot`` with page-level disposition: every table entry
+    in the slot's ``shared + owned`` range with ``keep[j]`` False returns
+    to the free stack; entries with ``keep[j]`` True leave the slot WITHOUT
+    touching the stack (they are — or just became — prefix-cache property).
+    The leading ``shared_pages[slot]`` entries additionally drop their
+    ``page_ref`` by 1 (this slot stops reading them; whether they were
+    kept or freed is the CALLER's eviction decision — the prefix cache
+    only frees them at refcount 0). Resets the row/len/alloc/shared."""
+    bt, stack, top = (cache["block_tables"], cache["free_stack"],
+                      cache["free_top"])
+    max_pages = bt.shape[1]
+    num_pages = stack.shape[0]
+    row = bt[slot]
+    sh = cache["shared_pages"][slot]
+    total = sh + cache["alloc_pages"][slot]
+    idx = jnp.arange(max_pages, dtype=jnp.int32)
+    freeable = jnp.logical_and(idx < total, jnp.logical_not(keep))
+    n_free = jnp.sum(freeable.astype(jnp.int32))
+    pos = jnp.cumsum(freeable.astype(jnp.int32)) - 1
+    dst = jnp.where(freeable, top + pos, num_pages)   # OOB -> dropped
+    out = dict(cache)
+    out["free_stack"] = stack.at[dst].set(row, mode="drop")
+    out["free_top"] = top + n_free
+    ref_ids = jnp.where(idx < sh, row, num_pages)
+    out["page_ref"] = cache["page_ref"].at[ref_ids].add(-1, mode="drop")
+    out["block_tables"] = bt.at[slot].set(jnp.zeros((max_pages,), jnp.int32))
+    out["len"] = cache["len"].at[slot].set(0)
+    out["alloc_pages"] = cache["alloc_pages"].at[slot].set(0)
+    out["shared_pages"] = cache["shared_pages"].at[slot].set(0)
     return out
 
 
 def free_slot(cache, slot):
     """Retire slot ``slot``: push ALL its owned pages (``alloc_pages``,
     not just the length-covered prefix) back onto the free stack, reset
-    its block table row to the null page, and zero its length."""
-    bt, stack, top = (cache["block_tables"], cache["free_stack"],
-                      cache["free_top"])
-    max_pages = bt.shape[1]
+    its block table row to the null page, and zero its length. Shared
+    (prefix-cached) leading entries are NOT pushed — they stay cache
+    property and only drop their refcount (``release_slot`` with
+    ``keep = shared prefix``); without prefix caching ``shared_pages`` is
+    0 and this frees exactly the owned set as before."""
+    max_pages = cache["block_tables"].shape[1]
+    keep = (jnp.arange(max_pages, dtype=jnp.int32)
+            < cache["shared_pages"][slot])
+    return release_slot(cache, slot, keep)
+
+
+def evict_pages(cache, pages_row, n):
+    """Push the first ``n`` entries of ``pages_row`` back onto the free
+    stack — the prefix cache evicting refcount-0 pages it owns. The CALLER
+    (the cache's LRU walk) guarantees the pages are reachable from no
+    block table and have ``page_ref == 0``; this is the stack push only."""
+    stack, top = cache["free_stack"], cache["free_top"]
     num_pages = stack.shape[0]
-    row = bt[slot]
-    n = cache["alloc_pages"][slot]
-    idx = jnp.arange(max_pages, dtype=jnp.int32)
-    take = idx < n
-    dst = jnp.where(take, top + idx, num_pages)      # OOB -> dropped
+    n = jnp.asarray(n, jnp.int32)
+    idx = jnp.arange(pages_row.shape[0], dtype=jnp.int32)
+    dst = jnp.where(idx < n, top + idx, num_pages)    # OOB -> dropped
     out = dict(cache)
-    out["free_stack"] = stack.at[dst].set(row, mode="drop")
-    out["free_top"] = top + n.astype(jnp.int32)
-    out["block_tables"] = bt.at[slot].set(jnp.zeros((max_pages,), jnp.int32))
-    out["len"] = cache["len"].at[slot].set(0)
-    out["alloc_pages"] = cache["alloc_pages"].at[slot].set(0)
+    out["free_stack"] = stack.at[dst].set(pages_row, mode="drop")
+    out["free_top"] = top + n
     return out
 
 
-def defrag(cache):
-    """Compact live pages to the low end of the pool (stable order) and
-    rebuild the free stack from actual liveness.
+def defrag_map(cache, extra_live=None):
+    """Compact live pages to the low end of the pool (stable order),
+    rebuild the free stack from actual liveness, and return
+    ``(cache, new_idx)`` where ``new_idx[old_page] = new_page`` — the
+    remap a host-side prefix cache needs to follow its pages.
 
     With a block-table indirection fragmentation never costs correctness
     or speed — any free page is as good as another — but compaction keeps
     the live set prefix-dense (cheap pool-prefix checkpointing / shrink)
     and doubles as a leak collector: a page reachable from no slot's table
     returns to the free stack even if an earlier free miscounted. O(pool)
-    gather per layer — an explicit maintenance op, not a per-step one."""
+    gather per layer — an explicit maintenance op, not a per-step one.
+
+    ``extra_live``: optional ``(num_pages,)`` bool mask of pages live for
+    reasons no block table shows — the prefix cache's refcount-0 resident
+    pages. Omitting it with a prefix cache attached would collect the
+    cache's pages as leaks (and hand them out while the radix tree still
+    names them)."""
     bt = cache["block_tables"]
     num_pages = num_pages_of(cache)
     max_pages = bt.shape[1]
 
-    # liveness bound = OWNED pages (a slot's preallocated-but-unwritten
-    # tail is live: its future tokens land there)
+    # liveness bound = SHARED + OWNED entries (a slot's
+    # preallocated-but-unwritten tail is live: its future tokens land
+    # there; its shared prefix is live: its reads land there)
+    n_used = cache["shared_pages"] + cache["alloc_pages"]
     used_entries = (jnp.arange(max_pages, dtype=jnp.int32)[None, :]
-                    < cache["alloc_pages"][:, None])         # (slots, mp)
+                    < n_used[:, None])                       # (slots, mp)
     live = jnp.zeros((num_pages,), bool).at[
         jnp.where(used_entries, bt, 0)].set(True)
+    if extra_live is not None:
+        live = jnp.logical_or(live, extra_live)
     live = live.at[0].set(True)                  # null page stays page 0
     n_live = jnp.sum(live.astype(jnp.int32))
     new_idx = jnp.where(live, jnp.cumsum(live.astype(jnp.int32)) - 1,
@@ -178,25 +276,39 @@ def defrag(cache):
                       "v_pages": lc["v_pages"][old_of_new]}
                      for lc in cache["layers"]]
     out["block_tables"] = jnp.where(used_entries, new_idx[bt], 0)
+    out["page_ref"] = cache["page_ref"][old_of_new]
     idx = jnp.arange(num_pages, dtype=jnp.int32)
     out["free_stack"] = jnp.where(idx < num_pages - n_live, n_live + idx, 0)
     out["free_top"] = (num_pages - n_live).astype(jnp.int32)
-    return out
+    return out, new_idx
 
 
-def prefill_into_pages(cache, slot, contig_layers, s0):
+def defrag(cache, extra_live=None):
+    """``defrag_map`` without the remap (callers with no host-side page
+    names to rewrite)."""
+    return defrag_map(cache, extra_live)[0]
+
+
+def prefill_into_pages(cache, slot, contig_layers, s0, *, start=0):
     """Scatter a CONTIGUOUS prefill cache (the models' flash-prefill
     output: per-layer ``k``/``v`` of shape ``(1, kv, len_bucket, d)``)
     into slot ``slot``'s already-allocated pages, and set its length to
     ``s0`` (traced OK; positions past ``s0`` — prompt-bucket padding —
     scatter to the null page). Position ``p`` lands in table entry
-    ``p // page_size`` at offset ``p % page_size``."""
+    ``p // page_size`` at offset ``p % page_size``.
+
+    ``start``: first position to write (default 0). A shared-prefix
+    admission prefills only the uncached tail — positions below ``start``
+    are the prefix-cache pages the slot merely reads, and MUST NOT be
+    scattered (they are shared, and the partially-computed prefix slots of
+    the contiguous buffer may hold gathered — not recomputed — values
+    anyway); they mask to the null-page sink like bucket padding."""
     bt = cache["block_tables"]
     ps = page_size_of(cache)
     max_pages = bt.shape[1]
     len_bucket = contig_layers[0]["k"].shape[2]
     pos = jnp.arange(len_bucket, dtype=jnp.int32)
-    valid = pos < s0
+    valid = jnp.logical_and(pos >= start, pos < s0)
     row = bt[slot]
     phys = jnp.where(valid, row[jnp.clip(pos // ps, 0, max_pages - 1)], 0)
     off = pos % ps

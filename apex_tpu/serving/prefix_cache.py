@@ -1,0 +1,223 @@
+"""Shared-prefix KV cache: a ref-counted radix tree over the page pool.
+
+The dominant serving pattern is thousands of requests sharing a system
+prompt or few-shot header; without cross-request reuse every one of them
+re-prefills the shared tokens. This module is the host-side half of
+RadixAttention-style prefix caching (SGLang, Zheng et al. 2023) over the
+existing paged pool (``kv_pool.py``): a radix tree keyed by PAGE-sized
+runs of token ids whose nodes name physical pages already holding that
+run's K/V at those absolute positions.
+
+Granularity is one page (``page_size`` tokens), matching the pool's unit
+of allocation and the copy-on-write boundary: a cached page is complete
+and immutable, so matching, sharing, and eviction are all whole-page
+moves. The partially-filled boundary page of a sequence is therefore
+never shared — a new request recomputes (copies) it into a private page.
+
+Lifecycle, as driven by ``scheduler.PagedDecodeEngine``:
+
+- **Match** (admission): walk the tree down the prompt's full pages —
+  capped at ``(s0 - 1) // page_size`` so at least one prompt token is
+  always prefilled (the last-token logits seed sampling). Matched nodes
+  are ``acquire``d (refcount +1, mirrored into the device-side
+  ``page_ref``) and the slot's block table points straight at their
+  pages; only the uncached tail is prefilled.
+- **Insert** (retirement): the request's full-page prefix — prompt AND
+  written generated tokens — moves into the tree instead of the free
+  stack (``release_and_insert`` returns the per-entry keep mask for
+  ``kv_pool.release_slot``). A page whose key a concurrent twin already
+  inserted is a duplicate and frees normally.
+- **Evict** (on demand): when admission finds the free stack short, LRU
+  refcount-0 LEAVES leave the tree and return to the stack
+  (``kv_pool.evict_pages``). Interior nodes are never evicted before
+  their children (a child's positions extend the parent's — evicting the
+  parent would orphan reachable state), and a refcount > 0 node is
+  pinned by its active readers.
+
+Correctness of sharing rests on two pool invariants: pages are
+position-indexed (a cached page is only ever matched at the positions it
+was written for — matches start at position 0 and extend page by page),
+and the decode step never writes below a slot's length (a sharer's
+writes land in its private tail pages, so cached pages are read-only).
+
+The authoritative refcounts live in the device cache state
+(``cache["page_ref"]``, int32 per page) so pool invariants are checkable
+on-device; nodes mirror them host-side so admission and eviction never
+force a device sync.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    """One cached page: ``key`` is its page_size-token run, ``page`` the
+    physical page id holding that run's K/V. ``refs`` mirrors the device
+    ``page_ref`` entry (active slots reading this page); ``last_used`` is
+    the LRU clock tick of the last match that walked through it."""
+
+    __slots__ = ("key", "page", "parent", "children", "refs", "last_used")
+
+    def __init__(self, key, page: int, parent: Optional["_Node"]):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.refs = 0
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Host-side radix tree naming pool pages by their token-run prefix.
+
+    Pure bookkeeping — every device mutation (refcounts, stack pushes,
+    block-table rows) goes through the ``kv_pool`` ops the scheduler
+    jits; this class decides WHICH pages to share, keep, and evict."""
+
+    def __init__(self, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.root = _Node(key=None, page=-1, parent=None)
+        self._nodes: set = set()
+        self._tick = 0
+
+    # --- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        """Number of cached pages (= tree nodes, root excluded)."""
+        return len(self._nodes)
+
+    def pages(self) -> List[int]:
+        """Physical page ids the cache currently holds (order arbitrary)."""
+        return [n.page for n in self._nodes]
+
+    def _page_key(self, tokens, j: int):
+        ps = self.page_size
+        return tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+
+    # --- admission ----------------------------------------------------------
+
+    def match(self, prompt) -> List[_Node]:
+        """Longest cached full-page prefix of ``prompt``: the node path,
+        shallowest first (``[n.page for n in path]`` is the block-table
+        prefix). Capped at ``(len(prompt) - 1) // page_size`` pages so the
+        admission always prefills >= 1 token — the tail forward's
+        last-position logits are what seed the first sampled token. Bumps
+        the LRU clock along the path. Does NOT take references — call
+        ``acquire`` once the admission commits (and nothing if it defers)."""
+        prompt = np.asarray(prompt).reshape(-1)
+        cap = max(int(prompt.shape[0]) - 1, 0) // self.page_size
+        self._tick += 1
+        path: List[_Node] = []
+        node = self.root
+        for j in range(cap):
+            child = node.children.get(self._page_key(prompt, j))
+            if child is None:
+                break
+            child.last_used = self._tick
+            path.append(child)
+            node = child
+        return path
+
+    def acquire(self, nodes: Sequence[_Node]) -> None:
+        """Pin matched nodes for an admitted request (host mirror of the
+        ``page_ref`` +1 that ``kv_pool.alloc_slot_shared`` applies)."""
+        for n in nodes:
+            n.refs += 1
+
+    def release(self, nodes: Sequence[_Node]) -> None:
+        """Undo ``acquire`` for a request that never got a device-side
+        footprint (admission deferred after matching)."""
+        for n in nodes:
+            n.refs -= 1
+
+    # --- retirement ---------------------------------------------------------
+
+    def release_and_insert(self, tokens, written: int,
+                           matched: Sequence[_Node], row,
+                           ) -> np.ndarray:
+        """Retire a request: drop its references on the matched prefix and
+        move its newly-written full pages into the tree.
+
+        ``tokens``: the request's WRITTEN token sequence (prompt followed
+        by the generated tokens whose K/V actually landed in the pool);
+        ``written``: its length — only full pages (``written //
+        page_size``) are cacheable, the partial boundary page frees.
+        ``matched``: the node path ``match`` returned at admission (their
+        pages are the row's leading shared entries). ``row``: the slot's
+        block-table row (host copy) — entry ``j`` holds the physical page
+        for positions ``[j*ps, (j+1)*ps)``.
+
+        Returns the bool keep mask for ``kv_pool.release_slot``: True
+        entries stay cache property (the shared prefix + newly inserted
+        pages), False entries return to the free stack (the partial tail,
+        the preallocated-but-unused pages, and duplicates — pages whose
+        key a concurrently-retired twin already inserted)."""
+        row = np.asarray(row).reshape(-1)
+        m = len(matched)
+        n_cache = int(written) // self.page_size
+        keep = np.zeros(row.shape[0], dtype=bool)
+        keep[:m] = True                  # shared pages stay with the cache
+        node = matched[-1] if matched else self.root
+        self._tick += 1
+        for j in range(m, n_cache):
+            key = self._page_key(tokens, j)
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key=key, page=int(row[j]), parent=node)
+                child.last_used = self._tick
+                node.children[key] = child
+                self._nodes.add(child)
+                keep[j] = True           # ownership transfers to the cache
+            # else: a twin inserted this run first — our copy is a
+            # duplicate and frees (keep[j] stays False); continue the walk
+            # under the canonical node so deeper pages chain correctly
+            node = child
+        self.release(matched)
+        return keep
+
+    # --- eviction -----------------------------------------------------------
+
+    def evict(self, n: int) -> List[int]:
+        """Evict up to ``n`` pages — LRU first, leaves only, refcount-0
+        only — removing their nodes and returning the physical page ids
+        for ``kv_pool.evict_pages``. Evicting a leaf can expose its parent
+        as the next candidate, so candidates heap by ``last_used`` and a
+        parent enters the heap the moment its last child leaves —
+        O((candidates + n) log candidates), no per-victim rescans. Pinned
+        (refcount > 0) or interior pages never leave."""
+        out: List[int] = []
+        heap = [(nd.last_used, id(nd), nd) for nd in self._nodes
+                if not nd.children and nd.refs == 0]
+        heapq.heapify(heap)
+        while heap and len(out) < n:
+            _, _, victim = heapq.heappop(heap)
+            if (victim not in self._nodes or victim.children
+                    or victim.refs != 0):
+                continue                 # stale entry (state moved on)
+            parent = victim.parent
+            del parent.children[victim.key]
+            self._nodes.remove(victim)
+            out.append(victim.page)
+            if (parent is not self.root and not parent.children
+                    and parent.refs == 0):
+                heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        return out
+
+    # --- maintenance --------------------------------------------------------
+
+    def remap(self, new_idx) -> None:
+        """Follow a ``kv_pool.defrag_map`` compaction: rewrite every
+        node's physical page through ``new_idx[old_page] = new_page``. The
+        scheduler passes the cache's pages as ``extra_live``, so every
+        node's page survived the compaction by construction."""
+        new_idx = np.asarray(new_idx)
+        for node in self._nodes:
+            node.page = int(new_idx[node.page])

@@ -23,6 +23,20 @@ derives each request's key stream from ``fold_in(rng, request_index)`` so
 outputs are SCHEDULING-INVARIANT (they depend on the request and the key,
 not on which slot or step the request landed in — stronger than lock-step,
 whose draws change with batch composition).
+
+``prefix_cache=True`` adds cross-request KV reuse (RadixAttention, Zheng
+et al. 2023; ``serving/prefix_cache.py``): admission walks a radix tree
+of cached full pages, points the slot's block table at the matched pages
+(refcounted, read-only) and prefills only the uncached tail through
+``make_shared_admit``; retirement moves the request's full-page prefix
+into the tree instead of the free stack, and the stack is replenished by
+LRU eviction of refcount-0 cached pages on demand. Greedy outputs stay
+token-identical to the cache-off engine: the shared pages replay
+bitwise-stored K/V, never re-derived. (The re-prefilled TAIL of a hit
+rides dense cached attention where the cold path rides the flash kernel
+— exact in fp32; under bf16 the two summation orders can differ in low
+bits, so a near-tied argmax could flip, the same caveat as
+``speculative_generate``'s chunked-verify exactness note.)
 """
 
 from __future__ import annotations
@@ -42,6 +56,8 @@ from apex_tpu.models.generation import (_greedy_token, _sample_token,
                                         init_cache, validate_sampling)
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.serving import kv_pool
+from apex_tpu.serving.prefix_cache import PrefixCache
+from apex_tpu.utils import metrics
 
 
 @dataclasses.dataclass
@@ -56,6 +72,86 @@ def _donate_cache():
     # buffer donation keeps the page pool in place across step/admit calls
     # on TPU; the CPU backend has no donation and would warn every call
     return (0,) if jax.default_backend() == "tpu" else ()
+
+
+def _bucket_match_pages(m: int) -> int:
+    """Round a radix match depth DOWN to a power of two pages. Retirement
+    inserts prompts AND generated tokens, so raw match depths take many
+    distinct values — and every distinct ``t_start`` is a fresh
+    shared-admit XLA compile stalling the admission loop. The power-of-two
+    floor bounds the compile-key set at ``log2(max_pages)`` per tail
+    bucket, at the cost of re-prefilling at most half the matched pages
+    (none at all for power-of-two-page shared headers, the common case)."""
+    return 1 << (m.bit_length() - 1) if m > 0 else 0
+
+
+def make_shared_admit(model, *, t_start: int, tail_bucket: int,
+                      first_token=None, axis_name: str = MODEL_AXIS):
+    """Build the shared-prefix admission program (one compile per
+    ``(t_start, tail_bucket)`` pair, cached by the engine; also the
+    ``tpu_aot.py`` sweep's prefix-cached decode case).
+
+    The matched prefix (``t_start`` tokens = ``t_start/page_size`` whole
+    cached pages) is GATHERED from the pool into a contiguous buffer, and
+    the model forward runs over ONLY the ``tail_bucket``-padded uncached
+    tail with the buffer as its KV cache at static length ``t_start`` —
+    the tail attends over the shared prefix through the models' existing
+    cached path, but the prefix contributes zero forward FLOPs. The tail's
+    K/V then scatters into the slot's private pages
+    (``prefill_into_pages(start=t_start)`` — shared pages are never
+    written: copy-on-write at page granularity, the partially-filled
+    boundary page is always private) and the first token samples from the
+    prompt-final logits.
+
+    Returns ``admit(cache, variables, tail_ids, s0, slot, shared_row,
+    n_private, req_key) -> (cache, tok0)`` where ``shared_row`` is a
+    ``(max_pages,)`` int32 row whose first ``t_start/page_size`` entries
+    are the matched physical pages."""
+    cfg = model.config
+    if t_start < 1 or tail_bucket < 1:
+        raise ValueError("shared admission needs t_start >= 1 matched "
+                         "tokens and tail_bucket >= 1 tail tokens")
+    if first_token is None:
+        def first_token(last, _key):
+            return _greedy_token(last, axis_name)
+    bucket = t_start + tail_bucket
+
+    def admit(cache, variables, tail_ids, s0, slot, shared_row, n_private,
+              req_key):
+        ps = kv_pool.page_size_of(cache)
+        if t_start % ps:
+            raise ValueError(f"t_start={t_start} must be a page multiple "
+                             f"({ps})")
+        m = t_start // ps
+        contig = init_cache(cfg, 1, bucket)
+        layers = []
+        for pool_lc, lc in zip(cache["layers"], contig["layers"]):
+            def gathered(pages, dst):
+                # (m, kv, ps, d) page tiles -> the buffer's leading
+                # t_start positions
+                kv, d = pages.shape[1], pages.shape[3]
+                block = pages.transpose(1, 0, 2, 3).reshape(
+                    1, kv, t_start, d)
+                return dst.at[:, :, :t_start, :].set(
+                    block.astype(dst.dtype))
+            layers.append(
+                {"k": gathered(pool_lc["k_pages"][shared_row[:m]], lc["k"]),
+                 "v": gathered(pool_lc["v_pages"][shared_row[:m]], lc["v"])})
+        # static len t_start: the tail chunk is a chunked continuation —
+        # bounds check at trace time, dense cached attention over the
+        # buffer (the flash path needs len 0, which the prefix occupies)
+        contig = {"layers": layers, "len": t_start}
+        logits, contig = model.apply(variables, tail_ids, cache=contig)
+        last = lax.dynamic_slice_in_dim(logits, s0 - t_start - 1, 1,
+                                        axis=1)[:, 0]
+        cache = kv_pool.alloc_slot_shared(cache, slot, shared_row, m,
+                                          n_private)
+        cache = kv_pool.prefill_into_pages(cache, slot, contig["layers"],
+                                           s0, start=t_start)
+        tok0 = first_token(last, req_key)[0]
+        return cache, tok0
+
+    return admit
 
 
 class PagedDecodeEngine:
@@ -73,7 +169,8 @@ class PagedDecodeEngine:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, rng=None,
-                 sync_every: int = 1, axis_name: str = MODEL_AXIS):
+                 sync_every: int = 1, axis_name: str = MODEL_AXIS,
+                 prefix_cache: bool = False):
         cfg = model.config
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -100,10 +197,19 @@ class PagedDecodeEngine:
         self.cache = kv_pool.init_paged_cache(
             cfg, num_slots, num_pages=num_pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq)
+        # cross-request KV reuse: the host radix tree naming cached pages
+        self.prefix = PrefixCache(page_size) if prefix_cache else None
         self._admit_jit = {}             # prompt bucket -> compiled admit
+        self._shared_admit_jit = {}      # (t_start, tail_bucket) -> admit
         self._step_jit = None
         self._free_jit = jax.jit(kv_pool.free_slot,
                                  donate_argnums=_donate_cache())
+        self._release_jit = jax.jit(kv_pool.release_slot,
+                                    donate_argnums=_donate_cache())
+        self._evict_jit = jax.jit(kv_pool.evict_pages,
+                                  donate_argnums=_donate_cache())
+        self._defrag_jit = jax.jit(kv_pool.defrag_map,
+                                   donate_argnums=_donate_cache())
 
     # --- request-key sampling (scheduling-invariant streams) ----------------
 
@@ -136,6 +242,44 @@ class PagedDecodeEngine:
         fn = jax.jit(admit, donate_argnums=_donate_cache())
         self._admit_jit[bucket] = fn
         return fn
+
+    def _admit_shared_fn(self, t_start: int, tail_bucket: int):
+        """Compile (once per ``(t_start, tail_bucket)``): the shared-prefix
+        admission — gather matched pages, tail-only prefill, page-pool
+        scatter, first-token sample (``make_shared_admit``)."""
+        key = (t_start, tail_bucket)
+        if key not in self._shared_admit_jit:
+            fn = make_shared_admit(self.model, t_start=t_start,
+                                   tail_bucket=tail_bucket,
+                                   first_token=self._first_token,
+                                   axis_name=self.axis_name)
+            self._shared_admit_jit[key] = jax.jit(
+                fn, donate_argnums=_donate_cache())
+        return self._shared_admit_jit[key]
+
+    # --- pool maintenance ---------------------------------------------------
+
+    def _leak_suspected(self, free: int, active) -> bool:
+        """True when host liveness accounting says more pages should be
+        free than the stack shows — a free miscount somewhere; ``defrag``
+        rebuilds the stack from actual liveness and recovers them."""
+        owned = sum(rec["n_private"] for rec in active.values())
+        cached = len(self.prefix) if self.prefix is not None else 0
+        usable = kv_pool.num_pages_of(self.cache) - 1    # null page
+        return usable - owned - cached > free
+
+    def _defrag_now(self):
+        """Run ``defrag_map`` with the prefix cache's resident pages as
+        extra liveness (they appear in no block table but must survive),
+        then remap the radix tree through the returned page permutation."""
+        num_pages = kv_pool.num_pages_of(self.cache)
+        extra = np.zeros((num_pages,), bool)
+        if self.prefix is not None:
+            extra[self.prefix.pages()] = True
+        self.cache, new_idx = self._defrag_jit(self.cache,
+                                               jnp.asarray(extra))
+        if self.prefix is not None:
+            self.prefix.remap(np.asarray(new_idx))
 
     def _step_fn(self):
         """Compile (once): ``sync_every`` decode steps as a ``lax.scan``
@@ -195,9 +339,15 @@ class PagedDecodeEngine:
 
         ``outputs[i]``: np.int32 generated tokens for request ``i`` —
         length ``max_new_tokens``, or shorter when the request hit EOS
-        (the EOS token is included). ``stats``: dict with
-        ``decode_steps`` (engine steps actually executed), ``admitted``,
-        and ``peak_slots_in_use``.
+        (the EOS token is included). ``stats``: engine counters for this
+        run — ``decode_steps`` / ``admitted`` / ``retired`` /
+        ``peak_slots_in_use`` / ``slot_occupancy``, the prefix-cache
+        counters (``prefix_hits``, ``prefix_hit_rate``,
+        ``prefill_tokens_{total,computed,skipped}``, ``evicted_pages``,
+        ``prefix_cached_pages``), and the maintenance counters
+        (``deferred_admissions``, ``defrag_runs``). Every numeric counter
+        is also recorded as ``serving.<name>`` through
+        ``apex_tpu.utils.metrics``.
         """
         cfg, ps = self.cfg, self.page_size
         max_pages = self.cache["block_tables"].shape[1]
@@ -226,11 +376,30 @@ class PagedDecodeEngine:
                                     + self.rng.shape)
         steps = 0
         peak = 0
+        c = {"retired": 0, "hits": 0, "prefill_total": 0,
+             "prefill_computed": 0, "evicted_pages": 0, "deferred": 0,
+             "defrag_runs": 0, "busy_slot_steps": 0}
 
         def retire(slot):
             rec = active.pop(slot)
             outputs[rec["idx"]] = np.asarray(rec["tokens"], np.int32)
-            self.cache = self._free_jit(self.cache, jnp.int32(slot))
+            c["retired"] += 1
+            if self.prefix is None:
+                self.cache = self._free_jit(self.cache, jnp.int32(slot))
+                return
+            # written K/V = prompt + every token fed while alive (all but
+            # the final sampled token, which retires before its step);
+            # only full pages of that enter the tree — the partial
+            # boundary page (and the frozen-done garbage position right
+            # at ``written``) never becomes shareable
+            written = rec["s0"] + len(rec["tokens"]) - 1
+            seq = np.concatenate(
+                [rec["prompt"], np.asarray(rec["tokens"][:-1], np.int32)])
+            row = np.asarray(self.cache["block_tables"][slot])
+            keep = self.prefix.release_and_insert(seq, written,
+                                                  rec["nodes"], row)
+            self.cache = self._release_jit(self.cache, jnp.int32(slot),
+                                           jnp.asarray(keep))
 
         while queue or active:
             # --- admission: fill vacant slots while pages last ----------
@@ -243,22 +412,75 @@ class PagedDecodeEngine:
                 idx, req = queue[0]
                 prompt = np.asarray(req.prompt, np.int32).reshape(-1)
                 s0 = prompt.shape[0]
-                need = kv_pool.pages_for(s0 + req.max_new_tokens, ps)
-                if int(kv_pool.free_page_count(self.cache)) < need:
+                need_total = kv_pool.pages_for(s0 + req.max_new_tokens, ps)
+                # prefix match BEFORE the page check: matched pages are
+                # shared, not allocated, so they shrink the demand.
+                # Acquire immediately — the eviction below must see the
+                # matched nodes as pinned, not as LRU victims
+                nodes = (self.prefix.match(prompt)
+                         if self.prefix is not None else [])
+                # bucket the match depth (compile-count bound); the
+                # dropped tail of the match re-prefills and dedups back
+                # into the tree at retirement
+                nodes = nodes[:_bucket_match_pages(len(nodes))]
+                if nodes:
+                    self.prefix.acquire(nodes)
+                m = len(nodes)
+                need = need_total - m
+                free = int(kv_pool.free_page_count(self.cache))
+                if free < need and self.prefix is not None:
+                    # replenish the stack: LRU refcount-0 cached pages
+                    pages = self.prefix.evict(need - free)
+                    if pages:
+                        row = np.zeros((max_pages,), np.int32)
+                        row[:len(pages)] = pages
+                        self.cache = self._evict_jit(
+                            self.cache, jnp.asarray(row),
+                            jnp.int32(len(pages)))
+                        c["evicted_pages"] += len(pages)
+                        free += len(pages)
+                if free < need and self._leak_suspected(free, active):
+                    # liveness says more pages exist than the stack shows:
+                    # compact + rebuild the stack, remap the radix tree
+                    self._defrag_now()
+                    c["defrag_runs"] += 1
+                    free = int(kv_pool.free_page_count(self.cache))
+                if free < need:
+                    if nodes:
+                        self.prefix.release(nodes)
+                    c["deferred"] += 1
                     break                 # head-of-line: wait for pages
                 queue.popleft()
-                bucket = min(round_up(max(s0, 1), ps),
-                             cfg.max_position_embeddings)
-                ids = np.zeros((1, bucket), np.int32)
-                ids[0, :s0] = prompt
                 req_key = jax.random.fold_in(self.rng, idx)
-                self.cache, tok0 = self._admit_fn(bucket)(
-                    self.cache, self.variables, jnp.asarray(ids),
-                    jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
-                    req_key)
+                if m == 0:
+                    bucket = min(round_up(max(s0, 1), ps),
+                                 cfg.max_position_embeddings)
+                    ids = np.zeros((1, bucket), np.int32)
+                    ids[0, :s0] = prompt
+                    self.cache, tok0 = self._admit_fn(bucket)(
+                        self.cache, self.variables, jnp.asarray(ids),
+                        jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                        req_key)
+                else:
+                    c["hits"] += 1
+                    t_start = m * ps
+                    tail_bucket = min(round_up(s0 - t_start, ps),
+                                      cfg.max_position_embeddings - t_start)
+                    ids = np.zeros((1, tail_bucket), np.int32)
+                    ids[0, :s0 - t_start] = prompt[t_start:]
+                    row = np.zeros((max_pages,), np.int32)
+                    row[:m] = [n.page for n in nodes]
+                    self.cache, tok0 = self._admit_shared_fn(
+                        t_start, tail_bucket)(
+                        self.cache, self.variables, jnp.asarray(ids),
+                        jnp.int32(s0), jnp.int32(slot), jnp.asarray(row),
+                        jnp.int32(need), req_key)
+                c["prefill_total"] += s0
+                c["prefill_computed"] += s0 - m * ps
                 tok0 = int(tok0)
                 rec = {"idx": idx, "tokens": [tok0],
-                       "max_new": req.max_new_tokens}
+                       "max_new": req.max_new_tokens, "prompt": prompt,
+                       "s0": s0, "nodes": nodes, "n_private": need}
                 active[slot] = rec
                 admitted_any = True
                 if (self.eos_token_id is not None
@@ -275,11 +497,14 @@ class PagedDecodeEngine:
                 if queue and not admitted_any:
                     raise RuntimeError(
                         "scheduler deadlock: queued request cannot be "
-                        "admitted (pool too small for its page demand?)")
+                        "admitted even with every slot vacant and every "
+                        "evictable cached page evicted (pool too small "
+                        "for its page demand?)")
                 continue
             peak = max(peak, len(active))
 
             # --- one jitted multi-step decode chunk ---------------------
+            c["busy_slot_steps"] += len(active) * self.sync_every
             self.cache, tok, done, n_left, samp_i, toks = self._step_fn()(
                 self.cache, self.variables, tok, done, n_left, req_keys,
                 samp_i)
@@ -302,8 +527,28 @@ class PagedDecodeEngine:
                     retire(slot)
                     done = done.at[slot].set(True)
 
-        stats = {"decode_steps": steps, "admitted": len(requests),
-                 "peak_slots_in_use": peak}
+        stats = {
+            "decode_steps": steps, "admitted": len(requests),
+            "retired": c["retired"], "peak_slots_in_use": peak,
+            "slot_occupancy": (c["busy_slot_steps"]
+                               / max(steps * self.num_slots, 1)),
+            "deferred_admissions": c["deferred"],
+            "defrag_runs": c["defrag_runs"],
+            "prefix_cache_enabled": self.prefix is not None,
+            "prefix_hits": c["hits"],
+            "prefix_hit_rate": c["hits"] / max(len(requests), 1),
+            "prefix_cached_pages": (len(self.prefix)
+                                    if self.prefix is not None else 0),
+            "evicted_pages": c["evicted_pages"],
+            "prefill_tokens_total": c["prefill_total"],
+            "prefill_tokens_computed": c["prefill_computed"],
+            "prefill_tokens_skipped": (c["prefill_total"]
+                                       - c["prefill_computed"]),
+        }
+        for name, val in stats.items():
+            if isinstance(val, bool):
+                continue
+            metrics.record(f"serving.{name}", val)
         return outputs, stats
 
 
@@ -314,14 +559,16 @@ def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
                    axis_name: str = MODEL_AXIS,
                    num_slots: Optional[int] = None, page_size: int = 16,
                    num_pages: Optional[int] = None, sync_every: int = 1,
-                   return_stats: bool = False):
+                   prefix_cache: bool = False, return_stats: bool = False):
     """`generate`-shaped front end over the engine.
 
     ``prompt_ids`` may be a rectangular ``(batch, s0)`` array (the
     ``generate`` contract — returns ``(batch, s0 + max_new_tokens)`` with
     prompts included and EOS padding after a row finishes, matching
     lock-step output exactly under greedy decode) or a list of 1-D
-    prompts of MIXED lengths (returns a list of 1-D outputs)."""
+    prompts of MIXED lengths (returns a list of 1-D outputs).
+    ``prefix_cache=True`` turns on cross-request shared-prefix KV reuse
+    (same outputs, fewer prefill tokens on shared-prefix workloads)."""
     rect = hasattr(prompt_ids, "ndim") and prompt_ids.ndim == 2
     prompts = [np.asarray(p, np.int32).reshape(-1)
                for p in (prompt_ids if not rect else np.asarray(prompt_ids))]
@@ -330,7 +577,8 @@ def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
         num_slots=num_slots if num_slots is not None else len(prompts),
         page_size=page_size, num_pages=num_pages,
         eos_token_id=eos_token_id, temperature=temperature, top_k=top_k,
-        top_p=top_p, rng=rng, sync_every=sync_every, axis_name=axis_name)
+        top_p=top_p, rng=rng, sync_every=sync_every, axis_name=axis_name,
+        prefix_cache=prefix_cache)
     reqs = [Request(prompt=p, max_new_tokens=max_new_tokens)
             for p in prompts]
     outs, stats = engine.run(reqs)

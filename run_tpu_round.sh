@@ -197,7 +197,11 @@ print(f"[batch escalation] winner: {best_b}/chip at {best_v:.0f} tok/s")
 EOF
 fi
 # decode-throughput harvest (beyond reference — no gate dependency beyond
-# the suite's flash/xentropy compiles; cheap: one small-model compile)
+# the suite's flash/xentropy compiles; cheap: one small-model compile).
+# Emits three metrics: lock-step decode, paged continuous batching, and
+# prefix-cached serving (shared-system-prompt workload; the offline AOT
+# sweep above covers the matching compile evidence via the
+# gpt2s_prefix_cached_admit + paged_attention_gpt2s_decode cases)
 if bench_done && [ ! -f "DECODE_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] decode-throughput bench (GPT-2 small KV cache)..."
   timeout 3600 python tpu_decode_bench.py \

@@ -35,6 +35,7 @@ CASE_NAMES = [
     "flash_window128_bwd",
     "gpt2_small_decode128_int8",      # serving path: scan decode + W8A8
     "paged_attention_gpt2s_decode",   # paged serving: scalar-prefetch gather
+    "gpt2s_prefix_cached_admit",      # prefix cache: tail-only admission
 ]
 
 
