@@ -1,0 +1,50 @@
+"""tpu-lint: AST static analysis for jit / Pallas / serving hazards.
+
+The hazard classes this package guards are the ones PR 1-2 established by
+convention and reviewer memory alone:
+
+* host synchronisation reachable from jitted code (``.item()``,
+  ``np.asarray``, ``jax.device_get`` inside the serving scheduler's
+  ``lax.scan`` hot loop would stall every decode step);
+* Pallas kernel contracts (BlockSpec index-map arity vs. the grid,
+  (sublane, lane) tiling multiples, ``out_shape`` dtype drift, Python
+  branches on traced refs inside kernel bodies);
+* recompile hazards (unhashable objects flowing into ``static_argnums``,
+  unbounded f-string compile-cache keys — the prefix cache's
+  power-of-two match-depth flooring is the house style);
+* donation misuse (reading a buffer after passing it through
+  ``donate_argnums``);
+* AOT case-list drift between ``tpu_aot.py`` and the CI tier's
+  ``CASE_NAMES``.
+
+Self-contained: stdlib ``ast`` only, no third-party lint dependencies.
+
+Usage::
+
+    python -m apex_tpu.analysis [paths ...] [--format text|json]
+    apex-tpu-lint --list-rules
+
+Inline suppression (same line, the statement's first line, or a
+comment-only line directly above)::
+
+    x = traced.item()  # tpu-lint: disable=host-sync-in-jit -- why it's ok
+
+Justified legacy findings can instead live in a checked-in baseline
+(``tpu_lint_baseline.json``, written with ``--write-baseline``); only
+findings *above* the baseline fail the run.
+"""
+
+from apex_tpu.analysis.baseline import Baseline
+from apex_tpu.analysis.cli import analyze_paths, main
+from apex_tpu.analysis.walker import Finding, ModuleIndex
+from apex_tpu.analysis.rules import RULES, Rule
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "ModuleIndex",
+    "RULES",
+    "Rule",
+    "analyze_paths",
+    "main",
+]

@@ -1,0 +1,69 @@
+"""Checked-in baseline of justified legacy findings.
+
+The baseline stores *counts* per line-number-free key
+(``path::rule::scope``), so findings survive unrelated edits above them
+but a NEW finding of the same rule in the same function still fails
+the run (the count grows past the recorded one). The file is plain
+sorted JSON so diffs are reviewable: shrink it freely, grow it only
+with a PR that argues why.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Iterable, List, Tuple
+
+from apex_tpu.analysis.walker import Finding
+
+FORMAT_VERSION = 1
+
+
+class Baseline:
+    def __init__(self, counts: Counter | None = None,
+                 path: Path | None = None):
+        self.counts: Counter = counts or Counter()
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls(path=path)
+        data = json.loads(path.read_text())
+        if data.get("version") != FORMAT_VERSION:
+            raise ValueError(
+                f"{path}: unsupported baseline version "
+                f"{data.get('version')!r} (expected {FORMAT_VERSION})")
+        return cls(Counter({k: int(v)
+                            for k, v in data.get("findings", {}).items()}),
+                   path=path)
+
+    def split(self, findings: Iterable[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(new, baselined): the first ``counts[key]`` findings per key
+        are absorbed by the baseline, the rest are live."""
+        budget = Counter(self.counts)
+        new: List[Finding] = []
+        absorbed: List[Finding] = []
+        for f in findings:
+            key = f.baseline_key()
+            if budget[key] > 0:
+                budget[key] -= 1
+                absorbed.append(f)
+            else:
+                new.append(f)
+        return new, absorbed
+
+    @staticmethod
+    def write(path: Path, findings: Iterable[Finding],
+              keep: dict | None = None) -> None:
+        """Record ``findings``; ``keep`` carries out-of-scope entries a
+        path-filtered run must not erase (current findings win on
+        shared keys)."""
+        counts = dict(keep or {})
+        counts.update(Counter(f.baseline_key() for f in findings))
+        payload = {"version": FORMAT_VERSION,
+                   "findings": dict(sorted(counts.items()))}
+        path.write_text(json.dumps(payload, indent=2, sort_keys=False)
+                        + "\n")

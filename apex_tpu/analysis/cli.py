@@ -1,0 +1,210 @@
+"""tpu-lint command line: discovery, engine, exit codes.
+
+``python -m apex_tpu.analysis`` (or the ``apex-tpu-lint`` console
+script) with no path arguments scans the production surface — the
+``apex_tpu/`` package plus the repo-root ``tpu_*.py`` / ``bench*.py``
+drivers — exactly the set ``run_tpu_round.sh`` gates on. Exit status:
+
+* 0 — clean (every finding suppressed inline or absorbed by the
+  baseline);
+* 1 — findings above the baseline;
+* 2 — usage error / unreadable baseline.
+
+Files that fail to parse produce a ``parse-error`` finding rather than
+crashing the run: a syntax error in one driver must not hide findings
+in the other twenty files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis import report
+from apex_tpu.analysis.baseline import Baseline
+from apex_tpu.analysis.rules import RULES, module_rules, project_rules
+from apex_tpu.analysis.suppressions import Suppressions
+from apex_tpu.analysis.walker import Finding, ModuleIndex
+
+DEFAULT_GLOBS = ("apex_tpu/**/*.py", "tpu_*.py", "bench*.py")
+DEFAULT_BASELINE = "tpu_lint_baseline.json"
+
+#: generated/vendored files never worth linting
+_SKIP_PARTS = {"__pycache__", ".git", ".jax_cache"}
+
+
+def discover(root: Path, paths: Sequence[str]) -> List[Path]:
+    files: List[Path] = []
+    if paths:
+        for p in paths:
+            pp = Path(p)
+            if not pp.is_absolute() and not pp.exists() \
+                    and (root / pp).exists():
+                pp = root / pp       # cwd-relative first, root as fallback
+            if pp.is_dir():
+                files.extend(sorted(pp.rglob("*.py")))
+            else:
+                files.append(pp)
+    else:
+        for pattern in DEFAULT_GLOBS:
+            files.extend(sorted(root.glob(pattern)))
+    out, seen = [], set()
+    for f in files:
+        if any(part in _SKIP_PARTS for part in f.parts):
+            continue
+        key = f.resolve()
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _rel(root: Path, path: Path) -> str:
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def analyze_paths(paths: Sequence[str] = (), *,
+                  root: Optional[object] = None,
+                  select: Optional[Iterable[str]] = None,
+                  with_project_rules: bool = True,
+                  ) -> Tuple[List[Finding], int]:
+    """Run the rule set; returns (surviving findings, #suppressed).
+
+    ``select`` limits to a subset of rule names (None = all). Inline
+    suppressions are already applied; baseline handling is the
+    caller's job (`main` does it) so library users see everything.
+    """
+    root = (Path(root) if root is not None else Path.cwd()).resolve()
+    chosen = set(select) if select is not None else set(RULES)
+    unknown = chosen - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+
+    findings: List[Finding] = []
+    suppressed = 0
+    for path in discover(root, paths):
+        rel = _rel(root, path)
+        try:
+            source = path.read_text()
+        except OSError as e:
+            findings.append(Finding(
+                rule="parse-error", severity="error", path=rel, line=1,
+                col=1, message=f"unreadable: {e}"))
+            continue
+        try:
+            mi = ModuleIndex(rel, source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="parse-error", severity="error", path=rel,
+                line=e.lineno or 1, col=(e.offset or 0) + 1,
+                message=f"syntax error: {e.msg}"))
+            continue
+        supp = Suppressions(source)
+        for r in module_rules():
+            if r.name not in chosen:
+                continue
+            for f in r.check(mi):
+                if supp.covers(f):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    if with_project_rules:
+        for r in project_rules():
+            if r.name in chosen:
+                findings.extend(r.check(root))
+    return findings, suppressed
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="apex-tpu-lint",
+        description="AST static analysis for jit/Pallas/serving hazards")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: apex_tpu/, "
+                        "tpu_*.py, bench*.py under --root)")
+    p.add_argument("--root", default=".",
+                   help="repo root for default globs, the baseline file "
+                        "and the cross-file drift rules")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: <root>/"
+                        f"{DEFAULT_BASELINE} when present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="absorb every current finding into the baseline "
+                        "file and exit 0")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings the baseline absorbs")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule names to run (default all)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        width = max(len(n) for n in RULES)
+        for name, r in sorted(RULES.items()):
+            kind = "project" if r.project else "module"
+            print(f"{name:<{width}}  {r.severity:<7} {kind:<7} "
+                  f"{r.summary}")
+        return 0
+
+    root = Path(args.root)
+    if not root.is_dir():
+        print(f"error: --root {root} is not a directory", file=sys.stderr)
+        return 2
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    try:
+        findings, suppressed = analyze_paths(
+            args.paths, root=root, select=select)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = (Path(args.baseline) if args.baseline
+                     else root / DEFAULT_BASELINE)
+    if args.write_baseline:
+        if select:
+            print("error: --write-baseline with --select would record a "
+                  "partial view and erase other rules' baselined findings; "
+                  "run it unfiltered", file=sys.stderr)
+            return 2
+        keep = {}
+        if args.paths:
+            # scoped run: replace entries for the scanned files only,
+            # keep the rest of the baseline untouched
+            scanned = {_rel(root, p) for p in discover(root, args.paths)}
+            try:
+                existing = Baseline.load(baseline_path)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            keep = {k: v for k, v in existing.counts.items()
+                    if k.split("::", 1)[0] not in scanned}
+        Baseline.write(baseline_path, findings, keep=keep)
+        print(f"tpu-lint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}"
+              + (f" (kept {sum(keep.values())} out-of-scope)" if keep
+                 else ""))
+        return 0
+    try:
+        baseline = Baseline.load(baseline_path)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    new, absorbed = baseline.split(findings)
+
+    if args.format == "json":
+        print(report.render_json(new, absorbed, suppressed))
+    else:
+        print(report.render_text(new, absorbed, suppressed,
+                                 show_baselined=args.show_baselined))
+    return 1 if new else 0

@@ -1,0 +1,47 @@
+"""Finding rendering: ``--format text`` (human/CI log) and ``json``
+(machine consumers — the bench harness and future dashboards)."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+from apex_tpu.analysis.walker import Finding
+
+
+def _sorted(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def render_text(new: List[Finding], baselined: List[Finding],
+                suppressed: int, show_baselined: bool = False) -> str:
+    out = []
+    for f in _sorted(new):
+        out.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] {f.severity}: "
+                   f"{f.message}")
+    if show_baselined:
+        for f in _sorted(baselined):
+            out.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] baselined: "
+                       f"{f.message}")
+    errors = sum(1 for f in new if f.severity == "error")
+    warnings = len(new) - errors
+    out.append(
+        f"tpu-lint: {len(new)} finding(s) ({errors} error(s), "
+        f"{warnings} warning(s)), {len(baselined)} baselined, "
+        f"{suppressed} suppressed")
+    return "\n".join(out)
+
+
+def render_json(new: List[Finding], baselined: List[Finding],
+                suppressed: int) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in _sorted(new)],
+        "baselined": [f.to_dict() for f in _sorted(baselined)],
+        "counts": {
+            "new": len(new),
+            "errors": sum(1 for f in new if f.severity == "error"),
+            "warnings": sum(1 for f in new if f.severity == "warning"),
+            "baselined": len(baselined),
+            "suppressed": suppressed,
+        },
+    }, indent=2)

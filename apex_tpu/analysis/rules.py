@@ -1,0 +1,653 @@
+"""tpu-lint rule set: the hazard classes this codebase actually has.
+
+Each rule is registered with a name (the suppression/baseline handle),
+a severity, and a one-line summary (``--list-rules``). Module rules run
+per file over a :class:`~apex_tpu.analysis.walker.ModuleIndex`; project
+rules run once over the repo root (cross-file drift checks).
+
+Design bias: precision over recall. Every check fires only on patterns
+it can resolve statically (literal block shapes, module-local jit
+wrappers, named parameters) — a lint that cries wolf on ``tile``-shaped
+variables it cannot evaluate would be suppressed into uselessness within
+two PRs. The expensive hazards (host syncs in the decode scan, Mosaic
+tiling violations) all show up in exactly these resolvable forms.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.walker import (Finding, FunctionInfo, ModuleIndex,
+                                      call_name, const_int_tuple,
+                                      const_str_tuple, dotted_name, kwarg,
+                                      name_tail, walk_shallow)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    severity: str
+    summary: str
+    check: Callable
+    project: bool = False    # True: check(root) once, not per module
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, severity: str, summary: str, project: bool = False):
+    def deco(fn):
+        RULES[name] = Rule(name=name, severity=severity, summary=summary,
+                           check=fn, project=project)
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# 1. host-sync-in-jit
+# --------------------------------------------------------------------------
+
+_DEVICE_GET = {"jax.device_get", "device_get"}
+_NP_HOST = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "onp.asarray", "onp.array"}
+_PY_CASTS = {"float", "int", "bool"}
+
+
+def _positional_params(info: FunctionInfo) -> Set[str]:
+    a = info.node.args
+    return {p.arg for p in (a.posonlyargs + a.args)}
+
+
+@rule("host-sync-in-jit", "error",
+      "device->host sync (.item()/np.asarray/device_get/float(traced)) "
+      "reachable from a jitted function or scan/while body")
+def check_host_sync(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["host-sync-in-jit"]
+    for info, chain in mi.jit_reachable():
+        params = _positional_params(info)
+        for node in walk_shallow(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = None
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr == "item" and not node.args:
+                    why = "`.item()` forces a device->host transfer"
+                elif node.func.attr == "block_until_ready":
+                    why = "`.block_until_ready()` blocks on the device"
+            cn = call_name(node)
+            if cn in _DEVICE_GET:
+                why = "`jax.device_get` copies device->host"
+            elif cn in _NP_HOST:
+                why = f"`{cn}` materializes a traced value on the host"
+            elif cn in _PY_CASTS and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                why = (f"`{cn}({node.args[0].id})` on a traced argument "
+                       "concretizes it on the host")
+            if why:
+                yield mi.finding(
+                    r, node,
+                    f"{why} inside `{info.qualname}` "
+                    f"(traced via: {' -> '.join(chain)})")
+
+
+# --------------------------------------------------------------------------
+# 2-4. Pallas kernel contracts
+# --------------------------------------------------------------------------
+
+def _is_call_tail(node: ast.AST, tail: str) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    cn = call_name(node)
+    return cn is not None and cn.split(".")[-1] == tail
+
+
+def _pallas_calls(mi: ModuleIndex) -> List[ast.Call]:
+    return [n for n in ast.walk(mi.tree) if _is_call_tail(n, "pallas_call")]
+
+
+def _grid_spec_calls(mi: ModuleIndex) -> List[ast.Call]:
+    out = []
+    for n in ast.walk(mi.tree):
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if cn and cn.split(".")[-1].endswith("GridSpec"):
+                out.append(n)
+    return out
+
+
+def _grid_arity(container: ast.Call) -> Optional[int]:
+    """Number of index_map args the container's grid implies, counting
+    scalar-prefetch operands (PrefetchScalarGridSpec prepends them)."""
+    grid = kwarg(container, "grid")
+    if grid is None:
+        return None
+    if isinstance(grid, (ast.Tuple, ast.List)):
+        n = len(grid.elts)
+    elif isinstance(grid, ast.Constant) and isinstance(grid.value, int):
+        n = 1
+    else:
+        return None
+    nsp = kwarg(container, "num_scalar_prefetch")
+    if nsp is not None:
+        if isinstance(nsp, ast.Constant) and isinstance(nsp.value, int):
+            n += nsp.value
+        else:
+            return None
+    return n
+
+
+def _block_specs(container: ast.Call,
+                 skip: Optional[ast.AST] = None) -> Iterator[ast.Call]:
+    skipped = set()
+    if skip is not None:
+        skipped = {id(x) for x in ast.walk(skip)}
+    for node in ast.walk(container):
+        if id(node) in skipped or node is container:
+            continue
+        if _is_call_tail(node, "BlockSpec"):
+            yield node
+
+
+def _spec_containers(mi: ModuleIndex) -> Iterator[Tuple[ast.Call,
+                                                        Optional[int]]]:
+    """Yield (container, expected index_map arity) for every pallas_call /
+    *GridSpec carrying BlockSpecs. BlockSpecs inside an inline grid_spec=
+    argument are attributed to the GridSpec container, not the call."""
+    for gs in _grid_spec_calls(mi):
+        yield gs, _grid_arity(gs)
+    for pc in _pallas_calls(mi):
+        yield pc, _grid_arity(pc)
+
+
+@rule("pallas-index-map-arity", "error",
+      "BlockSpec index_map parameter count disagrees with the grid rank "
+      "(+ scalar-prefetch operands)")
+def check_index_map_arity(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["pallas-index-map-arity"]
+    for container, arity in _spec_containers(mi):
+        if arity is None:
+            continue
+        gs = kwarg(container, "grid_spec")
+        for spec in _block_specs(container, skip=gs):
+            index_map = (spec.args[1] if len(spec.args) > 1
+                         else kwarg(spec, "index_map"))
+            if not isinstance(index_map, ast.Lambda):
+                continue
+            a = index_map.args
+            if a.vararg is not None or a.kwarg is not None:
+                continue             # lambda *g: ... adapts to any grid
+            got = len(a.posonlyargs + a.args)
+            if got != arity:
+                yield mi.finding(
+                    r, index_map,
+                    f"index_map takes {got} arg(s) but the grid supplies "
+                    f"{arity} (grid rank + num_scalar_prefetch) — Pallas "
+                    "will raise at trace time or silently mis-index")
+
+
+_SMEM_LIKE = {"SMEM", "ANY", "SEMAPHORE"}
+_LANE = 128
+_SUBLANE = 8     # fp32 floor; bf16 needs 16, int8/fp8 32 — 8 is the
+                 # universal minimum any literal block must clear
+
+
+@rule("pallas-block-tiling", "warning",
+      "literal BlockSpec block shape is not a multiple of the TPU tile "
+      "(sublane multiple x 128 lanes)")
+def check_block_tiling(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["pallas-block-tiling"]
+    for container, _ in _spec_containers(mi):
+        gs = kwarg(container, "grid_spec")
+        for spec in _block_specs(container, skip=gs):
+            mem = kwarg(spec, "memory_space")
+            if mem is not None and (name_tail(mem) or "") in _SMEM_LIKE:
+                continue          # scalar/control blocks are untiled
+            shape = (spec.args[0] if spec.args
+                     else kwarg(spec, "block_shape"))
+            if not isinstance(shape, (ast.Tuple, ast.List)) \
+                    or not shape.elts:
+                continue
+
+            def lit(e):
+                return e.value if (isinstance(e, ast.Constant)
+                                   and isinstance(e.value, int)) else None
+
+            lane = lit(shape.elts[-1])
+            # minor dim 1 is a degenerate stat column (Mosaic pads it);
+            # anything else literal must fill whole 128-lane registers
+            if lane is not None and lane != 1 and lane % _LANE:
+                yield mi.finding(
+                    r, shape,
+                    f"minor (lane) block dim {lane} is not a multiple of "
+                    f"{_LANE}; Mosaic pads every tile — size it "
+                    f"{_LANE}*k or 1")
+            if len(shape.elts) >= 2:
+                sub = lit(shape.elts[-2])
+                if sub is not None and sub != 1 and sub % _SUBLANE:
+                    yield mi.finding(
+                        r, shape,
+                        f"second-minor (sublane) block dim {sub} is not a "
+                        f"multiple of {_SUBLANE} (fp32 floor; bf16 needs "
+                        "16, int8/fp8 32)")
+
+
+_DTYPE_NAMES = {
+    "float32", "float16", "bfloat16", "float64", "float8_e4m3fn",
+    "float8_e5m2", "int8", "int16", "int32", "int64", "uint8", "uint32",
+    "bool_",
+}
+
+
+@rule("pallas-dtype-drift", "warning",
+      "pallas_call out_shape copies an input's .shape but hard-codes the "
+      "dtype — drifts when the input dtype changes")
+def check_dtype_drift(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["pallas-dtype-drift"]
+    for pc in _pallas_calls(mi):
+        out_shape = kwarg(pc, "out_shape")
+        if out_shape is None:
+            continue
+        for node in ast.walk(out_shape):
+            if not _is_call_tail(node, "ShapeDtypeStruct"):
+                continue
+            shape = node.args[0] if node.args else None
+            dtype = (node.args[1] if len(node.args) > 1
+                     else kwarg(node, "dtype"))
+            if not (isinstance(shape, ast.Attribute)
+                    and shape.attr == "shape"
+                    and isinstance(shape.value, ast.Name)):
+                continue
+            if isinstance(dtype, ast.Attribute) \
+                    and dtype.attr in _DTYPE_NAMES:
+                src = shape.value.id
+                yield mi.finding(
+                    r, node,
+                    f"out_shape mirrors `{src}.shape` but pins dtype "
+                    f"`{dotted_name(dtype)}` — use `{src}.dtype` (or "
+                    "suppress if the widening is intentional)")
+
+
+@rule("pallas-traced-branch", "error",
+      "Python `if`/`while` on a value loaded from a kernel ref — traced "
+      "values need jnp.where / pl.when, not host control flow")
+def check_traced_branch(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["pallas-traced-branch"]
+    kernels = [info for info in mi.functions.values()
+               if "pallas kernel" in info.jit_reasons]
+    for info in kernels:
+        params = _positional_params(info)
+        for node in walk_shallow(info.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Subscript) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in params:
+                    yield mi.finding(
+                        r, node,
+                        f"branch on `{ast.unparse(sub)}` inside kernel "
+                        f"`{info.qualname}` — ref loads are traced; use "
+                        "`@pl.when` or `jnp.where`")
+                    break
+
+
+# --------------------------------------------------------------------------
+# 5-6. recompile hazards
+# --------------------------------------------------------------------------
+
+def _jit_wrappers(mi: ModuleIndex) -> Dict[str, dict]:
+    """Module-local callables known to be jit-wrapped, with their static
+    and donated argument positions (literal kwargs only)."""
+    wrappers: Dict[str, dict] = {}
+
+    def record(tail: Optional[str], jit_call: ast.Call):
+        if not tail:
+            return
+        info = {"static_pos": (), "static_names": (), "donate_pos": (),
+                "node": jit_call}
+        v = kwarg(jit_call, "static_argnums")
+        if v is not None:
+            info["static_pos"] = const_int_tuple(v) or ()
+        v = kwarg(jit_call, "static_argnames")
+        if v is not None:
+            info["static_names"] = const_str_tuple(v) or ()
+        v = kwarg(jit_call, "donate_argnums")
+        if v is not None:
+            info["donate_pos"] = const_int_tuple(v) or ()
+        if info["static_pos"] or info["static_names"] \
+                or info["donate_pos"]:
+            wrappers[tail] = info
+
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            cn = node.value if isinstance(node.value, ast.Call) else None
+            if cn is not None and call_name(cn) \
+                    and call_name(cn).split(".")[-1] == "jit":
+                record(name_tail(node.targets[0]), cn)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    cn = call_name(dec)
+                    tail = cn.split(".")[-1] if cn else None
+                    if tail == "jit":
+                        record(node.name, dec)
+                    elif tail == "partial" and dec.args:
+                        inner = dotted_name(dec.args[0])
+                        if inner and inner.split(".")[-1] == "jit":
+                            record(node.name, dec)
+    return wrappers
+
+
+_FRESH_CTORS = {"list", "dict", "set"}
+_ARRAY_CTORS = {"array", "asarray", "zeros", "ones", "arange", "full",
+                "empty"}
+_ARRAY_MODS = {"np", "jnp", "numpy", "onp"}
+
+
+def _is_unhashable_arg(node: ast.AST) -> Optional[str]:
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "a list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "a dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set"
+    if isinstance(node, ast.GeneratorExp):
+        return "a generator"
+    if isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn in _FRESH_CTORS:
+            return f"a fresh `{cn}()`"
+        if cn and "." in cn:
+            mod, tail = cn.rsplit(".", 1)
+            if mod in _ARRAY_MODS and tail in _ARRAY_CTORS:
+                return f"an `{cn}` array"
+    return None
+
+
+@rule("jit-unhashable-static", "error",
+      "unhashable / freshly-constructed object flows into a "
+      "static_argnums|static_argnames position — TypeError at best, "
+      "recompile-per-call at worst")
+def check_unhashable_static(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["jit-unhashable-static"]
+    wrappers = {t: w for t, w in _jit_wrappers(mi).items()
+                if w["static_pos"] or w["static_names"]}
+
+    def check_site(call: ast.Call, w: dict, label: str):
+        for pos in w["static_pos"]:
+            if 0 <= pos < len(call.args):
+                what = _is_unhashable_arg(call.args[pos])
+                if what:
+                    yield mi.finding(
+                        r, call.args[pos],
+                        f"{what} is passed at static position {pos} of "
+                        f"`{label}` — static args are hashed into the "
+                        "compile key")
+        for kw in call.keywords:
+            if kw.arg in w["static_names"]:
+                what = _is_unhashable_arg(kw.value)
+                if what:
+                    yield mi.finding(
+                        r, kw.value,
+                        f"{what} is passed as static arg "
+                        f"`{kw.arg}` of `{label}`")
+
+    for node in ast.walk(mi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = name_tail(node.func)
+        if tail in wrappers:
+            yield from check_site(node, wrappers[tail], tail)
+        # immediate invocation: jax.jit(f, static_argnums=...)(args)
+        if isinstance(node.func, ast.Call):
+            inner = node.func
+            cn = call_name(inner)
+            if cn and cn.split(".")[-1] == "jit":
+                sa = kwarg(inner, "static_argnums")
+                sn = kwarg(inner, "static_argnames")
+                w = {"static_pos":
+                     const_int_tuple(sa) or () if sa is not None else (),
+                     "static_names":
+                     const_str_tuple(sn) or () if sn is not None else ()}
+                if w["static_pos"] or w["static_names"]:
+                    yield from check_site(node, w, cn)
+
+
+_COMPILE_CACHE_NAME = re.compile(r"jit|compil")
+
+
+@rule("compile-key-unbounded", "warning",
+      "compile-cache key built from an f-string / str() of a runtime "
+      "value — unbounded key set means unbounded compiles (bucket it, "
+      "like the prefix cache's power-of-two match flooring)")
+def check_compile_key(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["compile-key-unbounded"]
+
+    def stringy(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.JoinedStr):
+                return True
+            if isinstance(sub, ast.Call) and call_name(sub) in ("str",
+                                                                "repr"):
+                return True
+        return False
+
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Subscript):
+            tail = name_tail(node.value)
+            if tail and _COMPILE_CACHE_NAME.search(tail) \
+                    and stringy(node.slice):
+                yield mi.finding(
+                    r, node,
+                    f"`{tail}[...]` is keyed on a stringified runtime "
+                    "value — every distinct value is a fresh XLA "
+                    "compile; floor/bucket the key instead")
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("setdefault", "get") and node.args:
+            tail = name_tail(node.func.value)
+            if tail and _COMPILE_CACHE_NAME.search(tail) \
+                    and stringy(node.args[0]):
+                yield mi.finding(
+                    r, node.args[0],
+                    f"`{tail}.{node.func.attr}(...)` key is a stringified "
+                    "runtime value — bucket it to bound the compile set")
+
+
+# --------------------------------------------------------------------------
+# 7. jit-donated-reuse
+# --------------------------------------------------------------------------
+
+def _expr_key(node: ast.AST) -> Optional[tuple]:
+    """ctx-insensitive identity for Name/Attribute chains."""
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if isinstance(node, ast.Attribute):
+        base = _expr_key(node.value)
+        return ("a", base, node.attr) if base else None
+    return None
+
+
+def _assign_targets(stmt: ast.stmt) -> List[tuple]:
+    keys: List[tuple] = []
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            targets.extend(t.elts)
+        else:
+            k = _expr_key(t)
+            if k:
+                keys.append(k)
+    return keys
+
+
+def _blocks(root: ast.AST) -> Iterator[List[ast.stmt]]:
+    if hasattr(root, "body") and isinstance(root.body, list):
+        yield root.body
+    for node in walk_shallow(root):
+        for attr in ("body", "orelse", "finalbody"):
+            blk = getattr(node, attr, None)
+            if isinstance(blk, list) and blk \
+                    and isinstance(blk[0], ast.stmt):
+                yield blk
+
+
+def _header_walk(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Expressions belonging to ``stmt`` itself — sub-statement bodies
+    (and nested defs) are other blocks and analyzed there."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                continue
+            stack.append(child)
+
+
+def _scope_walk(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """``ast.walk`` that stays in the current runtime scope: nested
+    function/class bodies and lambdas run later (or never)."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule("jit-donated-reuse", "error",
+      "buffer passed through donate_argnums is read again after the "
+      "call — the donated buffer is invalidated on TPU")
+def check_donated_reuse(mi: ModuleIndex) -> Iterator[Finding]:
+    r = RULES["jit-donated-reuse"]
+    wrappers = {t: w for t, w in _jit_wrappers(mi).items()
+                if w["donate_pos"]}
+    if not wrappers:
+        return
+    roots: List[ast.AST] = [mi.tree] + [f.node
+                                        for f in mi.functions.values()]
+    for root in roots:
+        for block in _blocks(root):
+            for i, stmt in enumerate(block):
+                for node in _header_walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    tail = name_tail(node.func)
+                    if tail not in wrappers:
+                        continue
+                    donated = [
+                        _expr_key(node.args[p])
+                        for p in wrappers[tail]["donate_pos"]
+                        if 0 <= p < len(node.args)]
+                    donated = [d for d in donated if d]
+                    if not donated:
+                        continue
+                    rebound = set(_assign_targets(stmt))
+                    live = [d for d in donated if d not in rebound]
+                    yield from _scan_after(mi, r, tail, block[i + 1:],
+                                           live)
+
+
+def _scan_after(mi: ModuleIndex, r: Rule, callee: str,
+                rest: List[ast.stmt], live: List[tuple]
+                ) -> Iterator[Finding]:
+    live = list(live)
+    for stmt in rest:
+        if not live:
+            return
+        rebound = set(_assign_targets(stmt))
+        for node in _scope_walk(stmt):
+            k = _expr_key(node)
+            if k in live and isinstance(getattr(node, "ctx", None),
+                                        ast.Load):
+                yield mi.finding(
+                    r, node,
+                    f"`{ast.unparse(node)}` was donated to `{callee}` "
+                    "above and may alias freed memory — rebind the "
+                    "result or drop the donation")
+                live.remove(k)
+        live = [d for d in live if d not in rebound]
+
+
+# --------------------------------------------------------------------------
+# 8. aot-case-drift (project rule)
+# --------------------------------------------------------------------------
+
+@rule("aot-case-drift", "error",
+      "tests/test_aot_mosaic.py CASE_NAMES names a case tpu_aot.py "
+      "kernel_cases() no longer yields", project=True)
+def check_aot_case_drift(root: Path) -> Iterator[Finding]:
+    r = RULES["aot-case-drift"]
+    aot = root / "tpu_aot.py"
+    ci = root / "tests" / "test_aot_mosaic.py"
+    if not aot.exists() or not ci.exists():
+        return
+
+    try:
+        aot_tree = ast.parse(aot.read_text(), filename=str(aot))
+        ci_tree = ast.parse(ci.read_text(), filename=str(ci))
+    except SyntaxError:
+        return                      # parse errors are reported per-file
+
+    yielded: Set[str] = set()
+    for node in ast.walk(aot_tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "kernel_cases":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Yield) \
+                        and isinstance(sub.value, ast.Tuple) \
+                        and sub.value.elts \
+                        and isinstance(sub.value.elts[0], ast.Constant) \
+                        and isinstance(sub.value.elts[0].value, str):
+                    yielded.add(sub.value.elts[0].value)
+
+    ci_rel = ci.relative_to(root).as_posix()
+    for node in ast.walk(ci_tree):
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "CASE_NAMES"):
+            continue
+        if not isinstance(node.value, (ast.List, ast.Tuple)):
+            continue
+        if not yielded:
+            yield Finding(
+                rule=r.name, severity=r.severity, path=ci_rel,
+                line=node.lineno, col=node.col_offset + 1,
+                scope="CASE_NAMES",
+                message="tpu_aot.kernel_cases() yields no statically "
+                        "visible case names — the CI tier cannot be "
+                        "checked for drift")
+            return
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) \
+                    and isinstance(elt.value, str) \
+                    and elt.value not in yielded:
+                yield Finding(
+                    rule=r.name, severity=r.severity, path=ci_rel,
+                    line=elt.lineno, col=elt.col_offset + 1,
+                    scope="CASE_NAMES",
+                    message=f"CI case `{elt.value}` is not yielded by "
+                            "tpu_aot.kernel_cases() — the pair drifted "
+                            "(PR 1 and PR 2 both had to sync it by hand)")
+
+
+def module_rules() -> List[Rule]:
+    return [r for r in RULES.values() if not r.project]
+
+
+def project_rules() -> List[Rule]:
+    return [r for r in RULES.values() if r.project]

@@ -1,0 +1,73 @@
+"""Inline suppressions: ``# tpu-lint: disable=rule-a,rule-b -- why``.
+
+A suppression applies to findings whose node overlaps the comment's
+line, or — when the comment stands alone on its own line — to the next
+line (the conventional "decorate the statement above it" form).
+``disable=all`` silences every rule on that line; use sparingly.
+
+Parsing is deliberately strict about where rules end and prose begins:
+the rule list stops at ``--`` (everything after is the justification),
+and a comma-separated token only counts as a rule name when it is a
+single word — ``disable=rule -- wrong call, all good here`` must not
+quietly become ``disable=all``. Pragmas are read from real COMMENT
+tokens (via ``tokenize``), so pragma-shaped text inside a string
+literal or docstring is inert.
+
+The repo convention (ISSUE 3) is that an *intentional* finding gets an
+inline suppression **with** a one-line justification, while only
+justified legacy debt goes in the baseline file.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import Dict, Set
+
+from apex_tpu.analysis.walker import Finding
+
+_PRAGMA = re.compile(r"#\s*tpu-lint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+
+def _parse_rules(spec: str) -> Set[str]:
+    rules: Set[str] = set()
+    spec = spec.split("--")[0]          # "-- why" is justification
+    for tok in spec.split(","):
+        words = tok.split()
+        if len(words) == 1:             # multi-word token = prose, skip
+            rules.add(words[0])
+    return rules
+
+
+class Suppressions:
+    """Per-file map of line number -> suppressed rule names."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return      # unparseable files already carry a parse-error
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA.search(tok.string)
+            if not m:
+                continue
+            rules = _parse_rules(m.group(1))
+            if not rules:
+                continue
+            line = tok.start[0]
+            self.by_line.setdefault(line, set()).update(rules)
+            if not tok.line[:tok.start[1]].strip():
+                # comment-only line: also covers the following line
+                self.by_line.setdefault(line + 1, set()).update(rules)
+
+    def covers(self, finding: Finding) -> bool:
+        for line in range(finding.line, finding.end_line + 1):
+            rules = self.by_line.get(line)
+            if rules and (finding.rule in rules or "all" in rules):
+                return True
+        return False

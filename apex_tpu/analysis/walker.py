@@ -1,0 +1,340 @@
+"""AST groundwork for tpu-lint: per-module index + jit reachability.
+
+``ModuleIndex`` parses one file and answers the questions every rule
+needs: what functions exist (including nested defs and their qualnames),
+which of them are *jit entry points* (jitted directly, a ``lax.scan`` /
+``while_loop`` / ``fori_loop`` / ``cond`` / ``switch`` body, or a Pallas
+kernel), and which functions are *reachable* from those entry points
+through same-module calls. Reachability is the backbone of the
+host-sync rule: ``np.asarray`` in the host scheduling loop is fine, the
+same call three frames below a jitted ``lax.scan`` body is a device
+sync every step.
+
+Resolution is deliberately name-based and module-local (no imports are
+followed): precise enough for this codebase's layout, with zero import
+side effects — the analyzer never executes the code it reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: call-position table for tracing-context entry points: dotted-name tail
+#: -> indices of positional args that are traced callables. Positions past
+#: these are operands, NOT callables (cond(pred, t, f, *ops),
+#: switch(index, branches, *ops) — branches is a list, unpacked in _mark).
+_TRACED_CALLEE_ARGS = {
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "custom_vjp": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+}
+
+_JIT_TAILS = {"jit"}
+_PARTIAL_TAILS = {"partial"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding, locatable and baseline-addressable."""
+
+    rule: str
+    severity: str            # "error" | "warning"
+    path: str                # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    scope: str = "<module>"  # enclosing function qualname
+    end_line: int = 0        # last source line of the offending node
+
+    def __post_init__(self):
+        if not self.end_line:
+            self.end_line = self.line
+
+    def baseline_key(self) -> str:
+        """Line-number-free identity: survives unrelated edits above the
+        finding (occurrence disambiguation happens in Baseline)."""
+        return f"{self.path}::{self.rule}::{self.scope}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "scope": self.scope,
+            "message": self.message,
+        }
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def name_tail(node: ast.AST) -> Optional[str]:
+    """Final component of a Name/Attribute chain (``self._free_jit`` ->
+    ``_free_jit``) — how module-local callables are matched."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def unwrap_partial(node: ast.AST) -> ast.AST:
+    """``functools.partial(f, ...)`` -> ``f`` (recursively)."""
+    while isinstance(node, ast.Call):
+        cn = call_name(node)
+        if cn is None or cn.split(".")[-1] not in _PARTIAL_TAILS:
+            break
+        if not node.args:
+            break
+        node = node.args[0]
+    return node
+
+
+def const_int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int or tuple-of-ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def const_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_shallow(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/class
+    definitions (they are indexed — and scanned — separately). Lambdas
+    ARE descended into: they belong to their enclosing scope."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    qualname: str
+    params: Tuple[str, ...]
+    parent: Optional[str]         # enclosing function qualname, if any
+    jit_reasons: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.qualname.split(".")[-1]
+
+
+class ModuleIndex:
+    """Parsed file + function table + jit-entry marking + reachability."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        self._enclosing: Dict[int, str] = {}   # id(node) -> qualname
+        self._calls: Dict[str, Set[str]] = {}  # qualname -> callee tails
+        self._index_functions()
+        self._mark_jit_entries()
+        self.reachable: Dict[str, List[str]] = self._compute_reachable()
+
+    # ---------------------------------------------------------------- index
+
+    def _index_functions(self) -> None:
+        def visit(node: ast.AST, prefix: str,
+                  enclosing: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}" if prefix else child.name
+                    a = child.args
+                    params = tuple(
+                        p.arg for p in
+                        (a.posonlyargs + a.args + a.kwonlyargs))
+                    info = FunctionInfo(node=child, qualname=qn,
+                                        params=params, parent=enclosing)
+                    self.functions[qn] = info
+                    self.by_name.setdefault(child.name, []).append(info)
+                    for sub in walk_shallow(child):
+                        self._enclosing[id(sub)] = qn
+                    visit(child, qn + ".", qn)
+                elif isinstance(child, ast.ClassDef):
+                    # methods keep Class.method qualnames but do not
+                    # count as an enclosing *function*
+                    visit(child, f"{prefix}{child.name}.", enclosing)
+                else:
+                    visit(child, prefix, enclosing)
+
+        visit(self.tree, "", None)
+
+        for qn, info in self.functions.items():
+            called: Set[str] = set()
+            for node in walk_shallow(info.node):
+                if isinstance(node, ast.Call):
+                    tail = name_tail(unwrap_partial(node.func)) \
+                        if isinstance(node.func, ast.Call) \
+                        else name_tail(node.func)
+                    if tail:
+                        called.add(tail)
+                    # callables passed onward (e.g. a local fn handed to
+                    # jnp.where/vmap) keep the graph connected enough
+                    for arg in node.args:
+                        t = name_tail(unwrap_partial(arg))
+                        if t and t in self.by_name:
+                            called.add(t)
+            self._calls[qn] = called
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
+        qn = self._enclosing.get(id(node))
+        return self.functions.get(qn) if qn else None
+
+    def scope_of(self, node: ast.AST) -> str:
+        qn = self._enclosing.get(id(node))
+        return qn if qn else "<module>"
+
+    # ------------------------------------------------------------ jit roots
+
+    def _mark(self, ref: Optional[ast.AST], reason: str) -> None:
+        if ref is None:
+            return
+        if isinstance(ref, (ast.List, ast.Tuple)):
+            # lax.switch takes its branches as one list argument
+            for elt in ref.elts:
+                self._mark(elt, reason)
+            return
+        tail = name_tail(unwrap_partial(ref))
+        if not tail:
+            return
+        for info in self.by_name.get(tail, ()):
+            if reason not in info.jit_reasons:
+                info.jit_reasons.append(reason)
+
+    def _mark_jit_entries(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = unwrap_partial(dec) if isinstance(
+                        dec, ast.Call) else dec
+                    tail = name_tail(target)
+                    if tail in _JIT_TAILS:
+                        info = self._info_for_def(node)
+                        if info and "jit-decorated" not in info.jit_reasons:
+                            info.jit_reasons.append("jit-decorated")
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            tail = cn.split(".")[-1] if cn else None
+            if tail in _JIT_TAILS and node.args:
+                self._mark(node.args[0], "jax.jit")
+            elif tail in _TRACED_CALLEE_ARGS:
+                for i in _TRACED_CALLEE_ARGS[tail]:
+                    if i < len(node.args):
+                        self._mark(node.args[i], f"{tail} body")
+            elif tail == "pallas_call" and node.args:
+                self._mark(node.args[0], "pallas kernel")
+
+    def _info_for_def(self, node: ast.AST) -> Optional[FunctionInfo]:
+        for info in self.functions.values():
+            if info.node is node:
+                return info
+        return None
+
+    # --------------------------------------------------------- reachability
+
+    def _compute_reachable(self) -> Dict[str, List[str]]:
+        """qualname -> chain of reasons, for every function reachable from
+        a jit entry point (through calls or lexical nesting)."""
+        reach: Dict[str, List[str]] = {}
+        work: List[Tuple[str, List[str]]] = []
+        for qn, info in self.functions.items():
+            if info.jit_reasons:
+                reach[qn] = list(info.jit_reasons)
+                work.append((qn, reach[qn]))
+        while work:
+            qn, chain = work.pop()
+            nxt: Set[str] = set()
+            for tail in self._calls.get(qn, ()):
+                for info in self.by_name.get(tail, ()):
+                    nxt.add(info.qualname)
+            # nested defs of a traced function execute at trace time
+            # (``@pl.when`` bodies, scan-step closures)
+            for sub, info in self.functions.items():
+                if info.parent == qn:
+                    nxt.add(sub)
+            for sub in nxt:
+                if sub not in reach:
+                    reach[sub] = chain + [f"called from {qn}"]
+                    work.append((sub, reach[sub]))
+        return reach
+
+    def jit_reachable(self) -> Iterator[Tuple[FunctionInfo, List[str]]]:
+        for qn, chain in self.reachable.items():
+            yield self.functions[qn], chain
+
+    # ------------------------------------------------------------- findings
+
+    def finding(self, rule, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=rule.name, severity=rule.severity, path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message, scope=self.scope_of(node),
+            end_line=getattr(node, "end_lineno", 0)
+            or getattr(node, "lineno", 1))

@@ -256,7 +256,7 @@ def adam_update(g, p, m, v, *, beta1, beta2, eps, weight_decay, lr, step,
         grid=_grid(total_rows, blk),
         in_specs=in_specs,
         out_specs=[_buf_spec(blk)] * 3,
-        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 3,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 3,  # tpu-lint: disable=pallas-dtype-drift -- fp32 master params/state by contract
         input_output_aliases=aliases,
         interpret=_INTERPRET(),
     )(*args)
@@ -314,7 +314,7 @@ def sgd_update(g, p, m, *, lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(_SGD_HP)] + [_buf_spec(blk)] * 3,
         out_specs=[_buf_spec(blk)] * 2,
-        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 2,  # tpu-lint: disable=pallas-dtype-drift -- fp32 master params/momentum by contract
         input_output_aliases={2: 0, 3: 1},
         interpret=_INTERPRET(),
     )(hp, g, p, m)
@@ -444,7 +444,7 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
         + [pl.BlockSpec((_STAT_ROWS, s_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)],
         out_specs=[_buf_spec(blk)] * 3
         + [pl.BlockSpec((_STAT_ROWS, s_pad), lambda i: (0, 0), memory_space=pltpu.VMEM)],
-        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 3
+        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 3  # tpu-lint: disable=pallas-dtype-drift -- fp32 master params/state by contract
         + [jax.ShapeDtypeStruct((_STAT_ROWS, s_pad), jnp.float32)],
         input_output_aliases={3: 1, 4: 2},
         interpret=_INTERPRET(),
@@ -472,7 +472,7 @@ def lamb_update(g, p, m, v, seg_rows, num_segments, *, beta1, beta2, eps,
                   pl.BlockSpec((_STAT_ROWS, s_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
                   _seg_spec(blk)],
         out_specs=_buf_spec(blk),
-        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(p.shape, jnp.float32),  # tpu-lint: disable=pallas-dtype-drift -- fp32 master params by contract
         input_output_aliases={2: 0},
         interpret=_INTERPRET(),
     )(hp2, u, p, ratio_mat, seg2d)
@@ -546,7 +546,7 @@ def novograd_update(g, p, m, v_per_tensor, seg_rows, num_segments, *, beta1, bet
                   pl.BlockSpec((_STAT_ROWS, s_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
                   _seg_spec(blk)],
         out_specs=[_buf_spec(blk)] * 2,
-        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 2,
+        out_shape=[jax.ShapeDtypeStruct(p.shape, jnp.float32)] * 2,  # tpu-lint: disable=pallas-dtype-drift -- fp32 master params/momentum by contract
         input_output_aliases={2: 0, 3: 1},
         interpret=_INTERPRET(),
     )(hp, g, p, m, vden_mat, seg_rows.reshape(-1, 1))
@@ -572,6 +572,6 @@ def multi_tensor_scale(x, scale):
         grid=_grid(total_rows, blk),
         in_specs=[_smem_spec(1), _buf_spec(blk)],
         out_specs=_buf_spec(blk),
-        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),  # tpu-lint: disable=pallas-dtype-drift -- amp unscale emits fp32 master grads
         interpret=_INTERPRET(),
     )(hp, x)

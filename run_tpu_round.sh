@@ -11,6 +11,16 @@ cd "$(dirname "$0")"
 
 bench_done() { python bench_ok.py "BENCH_${TAG}.json.local"; }
 
+# FAIL-FAST static-analysis gate (docs/static_analysis.md): a host sync in
+# the decode scan or a Pallas contract violation should die here, on the
+# CI box, not after burning a tunnel window on chip
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis; then
+  echo "[$(date +%H:%M:%S)] tpu-lint found new hazards; fix, suppress with"
+  echo "  justification, or baseline them (docs/static_analysis.md) first"
+  exit 1
+fi
+
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"

@@ -1,0 +1,527 @@
+"""tpu-lint (apex_tpu.analysis) coverage.
+
+Three layers, matching ISSUE 3's acceptance criteria:
+
+1. fixture pairs — per rule, a bad snippet that triggers EXACTLY that
+   rule and a good twin that is clean. Running the bad fixture with the
+   rule deselected must also be clean, so every rule is individually
+   load-bearing (deleting one makes precisely its fixture pass).
+2. machinery — inline suppressions, the baseline workflow, the JSON
+   format, exit codes, the AOT case-drift project rule.
+3. end-to-end — the repo itself is clean at the current baseline: the
+   tier-1 twin of the ``run_tpu_round.sh`` fail-fast gate.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from apex_tpu.analysis import cli                              # noqa: E402
+from apex_tpu.analysis.rules import RULES, module_rules        # noqa: E402
+
+# --------------------------------------------------------------------------
+# per-rule fixture pairs
+# --------------------------------------------------------------------------
+
+_PALLAS_HEADER = """\
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+"""
+
+
+def _pallas(body):
+    return _PALLAS_HEADER + textwrap.dedent(body)
+
+FIXTURES = {
+    "host-sync-in-jit": (
+        """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return float(x) + np.asarray(x).sum()
+        """,
+        """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.asarray(x).sum() + x
+        """,
+    ),
+    "pallas-index-map-arity": (
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4, 2),
+                in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+    ),
+    "pallas-block-tiling": (
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((7, 100), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((16, 256), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 1), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+    ),
+    "pallas-dtype-drift": (
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, jnp.bfloat16),
+            )(x)
+        """),
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+    ),
+    "pallas-traced-branch": (
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            if x_ref[0, 0] > 0:
+                o_ref[...] = x_ref[...]
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+        _pallas("""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = jnp.where(x_ref[...] > 0, x_ref[...], 0.0)
+
+        def call(x):
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+        """),
+    ),
+    "jit-unhashable-static": (
+        """\
+        import jax
+
+        def f(cfg, x):
+            return x
+
+        g = jax.jit(f, static_argnums=(0,))
+
+        def run(x):
+            return g({"mode": "fast"}, x)
+        """,
+        """\
+        import jax
+
+        def f(cfg, x):
+            return x
+
+        g = jax.jit(f, static_argnums=(0,))
+        CFG = ("mode", "fast")
+
+        def run(x):
+            return g(CFG, x)
+        """,
+    ),
+    "compile-key-unbounded": (
+        """\
+        import jax
+
+        _step_jit = {}
+
+        def get_step(fn, seq_len):
+            if f"s{seq_len}" not in _step_jit:
+                _step_jit[f"s{seq_len}"] = jax.jit(fn)
+            return _step_jit[f"s{seq_len}"]
+        """,
+        """\
+        import jax
+
+        _step_jit = {}
+
+        def get_step(fn, seq_len):
+            bucket = 1 << (seq_len - 1).bit_length()
+            if bucket not in _step_jit:
+                _step_jit[bucket] = jax.jit(fn)
+            return _step_jit[bucket]
+        """,
+    ),
+    "jit-donated-reuse": (
+        """\
+        import jax
+
+        def f(buf):
+            return buf + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def run(buf):
+            out = g(buf)
+            return out + buf.sum()
+        """,
+        """\
+        import jax
+
+        def f(buf):
+            return buf + 1
+
+        g = jax.jit(f, donate_argnums=(0,))
+
+        def run(buf):
+            buf = g(buf)
+            return buf + buf.sum()
+        """,
+    ),
+}
+
+
+def _run_on(tmp_path, source, select=None):
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    findings, suppressed = cli.analyze_paths(
+        [str(f)], root=tmp_path, select=select, with_project_rules=False)
+    return findings, suppressed
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_bad_fixture_triggers_exactly_its_rule(rule, tmp_path):
+    bad, _ = FIXTURES[rule]
+    findings, _ = _run_on(tmp_path, bad)
+    assert findings, f"bad fixture for {rule} produced no findings"
+    assert {f.rule for f in findings} == {rule}, [
+        (f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_good_fixture_is_clean(rule, tmp_path):
+    _, good = FIXTURES[rule]
+    findings, _ = _run_on(tmp_path, good)
+    assert not findings, [(f.rule, f.line, f.message) for f in findings]
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rules_individually_load_bearing(rule, tmp_path):
+    """With the rule deselected (≈ deleted), its bad fixture passes:
+    no other rule shadows it."""
+    bad, _ = FIXTURES[rule]
+    others = [r for r in RULES if r != rule]
+    findings, _ = _run_on(tmp_path, bad, select=others)
+    assert not findings, [(f.rule, f.line, f.message) for f in findings]
+
+
+def test_every_module_rule_has_a_fixture():
+    assert {r.name for r in module_rules()} == set(FIXTURES)
+
+
+# --------------------------------------------------------------------------
+# suppression + baseline machinery
+# --------------------------------------------------------------------------
+
+def test_inline_suppression_same_line(tmp_path):
+    bad, _ = FIXTURES["host-sync-in-jit"]
+    src = bad.replace(
+        "return float(x) + np.asarray(x).sum()",
+        "return float(x) + np.asarray(x).sum()  "
+        "# tpu-lint: disable=host-sync-in-jit -- test justification")
+    findings, suppressed = _run_on(tmp_path, src)
+    assert not findings
+    assert suppressed == 2      # float() and np.asarray on the same line
+
+
+def test_inline_suppression_comment_line_above(tmp_path):
+    bad, _ = FIXTURES["host-sync-in-jit"]
+    src = bad.replace(
+        "            return float(x) + np.asarray(x).sum()",
+        "            # tpu-lint: disable=host-sync-in-jit\n"
+        "            return float(x) + np.asarray(x).sum()")
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings
+
+
+def test_suppression_of_other_rule_does_not_apply(tmp_path):
+    bad, _ = FIXTURES["host-sync-in-jit"]
+    src = bad.replace(
+        "return float(x) + np.asarray(x).sum()",
+        "return float(x) + np.asarray(x).sum()  "
+        "# tpu-lint: disable=pallas-block-tiling")
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_baseline_workflow(tmp_path, capsys):
+    bad, _ = FIXTURES["jit-donated-reuse"]
+    f = tmp_path / "legacy.py"
+    f.write_text(textwrap.dedent(bad))
+    args = [str(f), "--root", str(tmp_path)]
+
+    assert cli.main(args) == 1
+    assert cli.main(args + ["--write-baseline"]) == 0
+    assert (tmp_path / "tpu_lint_baseline.json").exists()
+    # baselined finding no longer fails the run ...
+    assert cli.main(args) == 0
+    # ... but a NEW finding of the same rule in another scope does
+    f.write_text(textwrap.dedent(bad) + textwrap.dedent("""
+        def run2(buf):
+            out = g(buf)
+            return out + buf.sum()
+    """))
+    capsys.readouterr()
+    assert cli.main(args) == 1
+    out = capsys.readouterr().out
+    assert "run2" in out or "jit-donated-reuse" in out
+
+
+def test_json_format(tmp_path, capsys):
+    bad, _ = FIXTURES["pallas-dtype-drift"]
+    f = tmp_path / "snippet.py"
+    f.write_text(textwrap.dedent(bad))
+    rc = cli.main([str(f), "--root", str(tmp_path), "--format", "json"])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["counts"]["new"] == 1
+    (finding,) = data["findings"]
+    assert finding["rule"] == "pallas-dtype-drift"
+    assert finding["path"].endswith("snippet.py")
+    assert finding["line"] > 0
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    findings, _ = cli.analyze_paths([str(f)], root=tmp_path,
+                                    with_project_rules=False)
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_unknown_rule_is_usage_error(tmp_path, capsys):
+    assert cli.main(["--root", str(tmp_path),
+                     "--select", "no-such-rule"]) == 2
+
+
+def test_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in RULES:
+        assert name in out
+
+
+# --------------------------------------------------------------------------
+# aot-case-drift project rule
+# --------------------------------------------------------------------------
+
+_AOT_STUB = """\
+def kernel_cases():
+    yield ("layer_norm_bwd", None, [])
+    yield ("flash_bwd_seq512", None, [])
+"""
+
+
+def _drift_tree(tmp_path, case_names):
+    (tmp_path / "tpu_aot.py").write_text(_AOT_STUB)
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    names = ", ".join(repr(n) for n in case_names)
+    (tests / "test_aot_mosaic.py").write_text(f"CASE_NAMES = [{names}]\n")
+
+
+def test_aot_case_drift_detects_stale_name(tmp_path):
+    _drift_tree(tmp_path, ["layer_norm_bwd", "renamed_case"])
+    findings, _ = cli.analyze_paths([], root=tmp_path,
+                                    select=["aot-case-drift"])
+    assert len(findings) == 1
+    assert "renamed_case" in findings[0].message
+
+
+def test_aot_case_drift_clean_when_in_sync(tmp_path):
+    _drift_tree(tmp_path, ["layer_norm_bwd", "flash_bwd_seq512"])
+    findings, _ = cli.analyze_paths([], root=tmp_path,
+                                    select=["aot-case-drift"])
+    assert not findings
+
+
+# --------------------------------------------------------------------------
+# end-to-end: the repo itself is clean (the run_tpu_round.sh gate, tier-1)
+# --------------------------------------------------------------------------
+
+def test_repo_is_clean_at_current_baseline(capsys):
+    rc = cli.main(["--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, f"tpu-lint found new issues in the repo:\n{out}"
+
+
+def test_repo_case_names_in_sync():
+    """Direct tier-1 pin of the drift pair, independent of the CLI."""
+    findings, _ = cli.analyze_paths([], root=REPO,
+                                    select=["aot-case-drift"])
+    assert not findings, [f.message for f in findings]
+
+
+# --------------------------------------------------------------------------
+# jit-entry marking regressions (code-review repros)
+# --------------------------------------------------------------------------
+
+def test_switch_branch_list_is_traced(tmp_path):
+    """lax.switch branches arrive as ONE list argument; each element is a
+    traced body and must be reachable for the host-sync rule."""
+    src = """\
+        import jax
+        import numpy as np
+        from jax import lax
+
+        def branch_a(x):
+            return np.asarray(x).sum()
+
+        def branch_b(x):
+            return x
+
+        @jax.jit
+        def step(i, x):
+            return lax.switch(i, [branch_a, branch_b], x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+    assert any("branch_a" in f.message for f in findings)
+
+
+def test_cond_operand_is_not_marked_traced(tmp_path):
+    """cond(pred, true_fun, false_fun, *operands): an operand that happens
+    to be a host-side function must NOT be marked as a traced body."""
+    src = """\
+        import jax
+        import numpy as np
+        from jax import lax
+
+        def helper(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def step(pred, v):
+            return lax.cond(pred, lambda a: a + 1, lambda a: a, v)
+
+        def host_drive(v):
+            return helper(v)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# suppression-parsing / baseline-write hardening (code-review repros)
+# --------------------------------------------------------------------------
+
+def test_justification_comma_does_not_leak_rules(tmp_path):
+    """'disable=<other-rule> -- wrong rule, all good here' must not parse
+    the prose token 'all' as a disable-everything suppression."""
+    bad, _ = FIXTURES["host-sync-in-jit"]
+    src = bad.replace(
+        "return float(x) + np.asarray(x).sum()",
+        "return float(x) + np.asarray(x).sum()  "
+        "# tpu-lint: disable=pallas-block-tiling -- wrong rule, all good here")
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_pragma_inside_string_literal_is_inert(tmp_path):
+    bad, _ = FIXTURES["host-sync-in-jit"]
+    src = bad.replace(
+        "return float(x) + np.asarray(x).sum()",
+        'doc = "example: # tpu-lint: disable=all"\n'
+        "            return float(x) + np.asarray(x).sum()")
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_write_baseline_refuses_select(tmp_path, capsys):
+    assert cli.main(["--root", str(tmp_path), "--select",
+                     "host-sync-in-jit", "--write-baseline"]) == 2
+
+
+def test_scoped_write_baseline_keeps_other_files(tmp_path):
+    """--write-baseline over one file must not erase another file's
+    baselined legacy findings."""
+    bad, _ = FIXTURES["jit-donated-reuse"]
+    a = tmp_path / "legacy_a.py"
+    b = tmp_path / "legacy_b.py"
+    a.write_text(textwrap.dedent(bad))
+    b.write_text(textwrap.dedent(bad))
+    # baseline both, then re-write scoped to b only
+    assert cli.main([str(a), str(b), "--root", str(tmp_path),
+                     "--write-baseline"]) == 0
+    assert cli.main([str(b), "--root", str(tmp_path),
+                     "--write-baseline"]) == 0
+    # a's legacy entry survived the scoped write
+    assert cli.main([str(a), str(b), "--root", str(tmp_path)]) == 0
