@@ -23,7 +23,8 @@ from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 from apex_tpu.analysis.walker import (Finding, FunctionInfo, ModuleIndex,
                                       call_name, const_int_tuple,
-                                      const_str_tuple, dotted_name, kwarg,
+                                      const_str_tuple, dotted_name,
+                                      host_callback_exempt_ids, kwarg,
                                       name_tail, walk_shallow)
 
 
@@ -64,13 +65,20 @@ def _positional_params(info: FunctionInfo) -> Set[str]:
 
 @rule("host-sync-in-jit", "error",
       "device->host sync (.item()/np.asarray/device_get/float(traced)) "
-      "reachable from a jitted function or scan/while body")
+      "reachable from a jitted function or scan/while body "
+      "(jax.debug.callback / metrics.record payloads are exempt: the "
+      "callback runs host-side after the step, without blocking it)")
 def check_host_sync(mi: ModuleIndex) -> Iterator[Finding]:
     r = RULES["host-sync-in-jit"]
     for info, chain in mi.jit_reachable():
         params = _positional_params(info)
+        # the callable handed to jax.debug.callback executes on the host
+        # with delivered (not traced) values — host ops inside it are the
+        # POINT, not a sync. Only the callable argument is exempt: traced
+        # operands of the callback keep full scrutiny.
+        exempt = host_callback_exempt_ids(info.node)
         for node in walk_shallow(info.node):
-            if not isinstance(node, ast.Call):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
                 continue
             why = None
             if isinstance(node.func, ast.Attribute):

@@ -41,6 +41,59 @@ _TRACED_CALLEE_ARGS = {
 _JIT_TAILS = {"jit"}
 _PARTIAL_TAILS = {"partial"}
 
+#: NON-BLOCKING host-callback entry points (the metrics channel:
+#: ``apex_tpu.utils.metrics.record`` rides ``jax.debug.callback``). Their
+#: callable argument runs on the HOST with already-materialized values
+#: when the step executes — it never forces a device sync, so it is
+#: neither a traced body nor a jit-reachable callee. Deliberately narrow:
+#: only the dotted ``debug.callback`` form qualifies (``pure_callback`` /
+#: ``io_callback`` results feed back into the trace and keep their
+#: ordinary treatment).
+_HOST_CALLBACK_FNS = {"jax.debug.callback", "debug.callback"}
+
+
+def _callable_exempt_ids(node: ast.AST) -> "Set[int]":
+    """Exempt-node ids for ONE host-callback callable expression: only
+    the parts that execute at DELIVERY time (on the host, with
+    materialized values) are exempt — a bare name/attribute reference, a
+    lambda's BODY, or a ``functools.partial``'s callable. Everything
+    evaluated at TRACE time keeps full scrutiny: partial operands,
+    lambda default-arg expressions, and arbitrary factory calls
+    (``jax.debug.callback(make_cb(x), y)`` runs ``make_cb(x)`` while
+    tracing — exempting nothing there, not even the call node itself)."""
+    out: Set[int] = set()
+    while True:
+        if isinstance(node, ast.Lambda):
+            out.add(id(node))
+            out.update(id(sub) for sub in ast.walk(node.body))
+            return out
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            out.add(id(node))
+            out.update(id(sub) for sub in ast.walk(node))
+            return out
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn and cn.split(".")[-1] in _PARTIAL_TAILS and node.args:
+                out.add(id(node))
+                out.add(id(node.func))
+                node = node.args[0]      # the partial's callable
+                continue
+        return set()                     # factory call / other expr:
+    #                                      wholly trace-time, no exemption
+
+
+def host_callback_exempt_ids(root: ast.AST) -> "Set[int]":
+    """ids of the delivery-time parts of every non-blocking host
+    callback's CALLABLE argument under ``root`` — the nodes the host-sync
+    rule and the call-graph builder both skip (see
+    :func:`_callable_exempt_ids` for what stays scrutinized)."""
+    out: Set[int] = set()
+    for node in walk_shallow(root):
+        if isinstance(node, ast.Call) \
+                and call_name(node) in _HOST_CALLBACK_FNS and node.args:
+            out.update(_callable_exempt_ids(node.args[0]))
+    return out
+
 
 @dataclasses.dataclass
 class Finding:
@@ -226,8 +279,11 @@ class ModuleIndex:
 
         for qn, info in self.functions.items():
             called: Set[str] = set()
+            # the payload of jax.debug.callback is host-side and
+            # non-blocking — it is NOT an edge into jitted execution
+            exempt = host_callback_exempt_ids(info.node)
             for node in walk_shallow(info.node):
-                if isinstance(node, ast.Call):
+                if isinstance(node, ast.Call) and id(node) not in exempt:
                     tail = name_tail(unwrap_partial(node.func)) \
                         if isinstance(node.func, ast.Call) \
                         else name_tail(node.func)
@@ -236,6 +292,8 @@ class ModuleIndex:
                     # callables passed onward (e.g. a local fn handed to
                     # jnp.where/vmap) keep the graph connected enough
                     for arg in node.args:
+                        if id(arg) in exempt:
+                            continue
                         t = name_tail(unwrap_partial(arg))
                         if t and t in self.by_name:
                             called.add(t)

@@ -1,0 +1,25 @@
+"""apex_tpu.obs — serving/training observability (docs/observability.md).
+
+Three host-side layers over the ``apex_tpu.utils.metrics`` instrument
+registry, built for operating the continuous-batching serving engine the
+way production paged-KV systems are operated (Orca, Yu et al. 2022;
+vLLM, Kwon et al. 2023) — per-request lifecycle traces in the spirit of
+Dapper (Sigelman et al. 2010):
+
+- ``spans``  — :class:`SpanTracer`: per-request lifecycle spans
+  (enqueue → admit → prefill → first_token → decode → retire) with
+  derived queue-wait / TTFT / TPOT, nested under ``jax.profiler`` trace
+  annotations so they also land in xprof captures.
+- ``events`` — :class:`EventLog`: bounded ring-buffer event log with a
+  JSONL postmortem ``dump()``.
+- ``export`` — Prometheus text exposition + JSON snapshots of the
+  metric registry, file-based or via a stdlib HTTP endpoint.
+"""
+
+from apex_tpu.obs.events import EventLog
+from apex_tpu.obs.export import (json_snapshot, prometheus_text, serve,
+                                 write_snapshot)
+from apex_tpu.obs.spans import PHASES, Span, SpanTracer
+
+__all__ = ["EventLog", "PHASES", "Span", "SpanTracer", "json_snapshot",
+           "prometheus_text", "serve", "write_snapshot"]

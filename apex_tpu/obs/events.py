@@ -1,0 +1,96 @@
+"""Bounded ring-buffer event log with a JSONL postmortem dump.
+
+Structured counterpart to a log file: the serving engine (and anything
+else) ``emit()``\\ s small dict events — admissions, retirements,
+evictions, defrags, deferrals — into a fixed-capacity ring. Memory is
+bounded no matter how long the process serves; when something goes wrong
+the operator calls :meth:`EventLog.dump` and reads the last N events as
+JSON lines, newest state included, oldest silently dropped (the
+``dropped`` counter says how many).
+
+Events carry a monotonically increasing ``seq`` (gap-free — a reader can
+detect drops between two dumps) and a wall-clock ``t`` (``time.time``)
+for correlation with external logs; the injectable ``clock`` makes tests
+deterministic.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import json
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["EventLog"]
+
+
+class EventLog:
+    """Thread-safe fixed-capacity event ring."""
+
+    def __init__(self, capacity: int = 1024, clock=time.time):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._clock = clock
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def emit(self, kind: str, **fields) -> dict:
+        """Append one event; returns a COPY of the stored record (same
+        contract as :meth:`tail` — mutating it cannot corrupt the
+        ring)."""
+        with self._lock:
+            rec = {"seq": self._seq, "t": self._clock(), "kind": kind,
+                   **fields}
+            self._seq += 1
+            self._buf.append(rec)
+        return dict(rec)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def total(self) -> int:
+        """Events ever emitted (retained + dropped)."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Events pushed out of the ring by newer ones."""
+        with self._lock:
+            return self._seq - len(self._buf)
+
+    def tail(self, n: Optional[int] = None) -> List[dict]:
+        """The last ``n`` retained events (all of them when ``n`` is
+        None), oldest first. Returns copies — mutating them does not
+        corrupt the ring."""
+        with self._lock:
+            events = list(self._buf)
+        if n is not None:
+            events = events[-n:]
+        return [dict(e) for e in events]
+
+    def dump(self, path: Optional[str] = None) -> str:
+        """Serialize the retained events as JSONL (one event per line,
+        oldest first), preceded by a header line with total/dropped
+        counts. Writes to ``path`` when given; always returns the text —
+        the postmortem artifact docs/observability.md walks through."""
+        with self._lock:
+            events = list(self._buf)
+            header = {"kind": "event_log_header", "capacity": self.capacity,
+                      "total": self._seq,
+                      "dropped": self._seq - len(events)}
+        out = io.StringIO()
+        out.write(json.dumps(header) + "\n")
+        for e in events:
+            out.write(json.dumps(e) + "\n")
+        text = out.getvalue()
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(text)
+        return text

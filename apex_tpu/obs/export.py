@@ -1,0 +1,194 @@
+"""Metric export: Prometheus text exposition + JSON snapshots.
+
+Readers of the ``apex_tpu.utils.metrics`` registry — nothing here ever
+touches a device. Three transports:
+
+- :func:`prometheus_text` — the text exposition format (v0.0.4) any
+  Prometheus-compatible scraper ingests: counters and gauges as single
+  samples, histograms as the canonical ``_bucket``/``_sum``/``_count``
+  triplet with cumulative ``le`` buckets, and the raw ``record()``
+  series as ``_count``/``_mean``/``_last`` gauges. Output is sorted and
+  deterministic for a given registry state (the golden-file test pins
+  it).
+- :func:`json_snapshot` / :func:`write_snapshot` — the full registry as
+  one JSON document (CI artifacts: ``run_tpu_round.sh`` banks one per
+  round next to the bench JSON).
+- :func:`serve` — optional stdlib ``http.server`` endpoint exposing
+  ``/metrics`` (Prometheus) and ``/metrics.json`` on a daemon thread;
+  returns the server (``.server_address`` for the bound port,
+  ``.shutdown()`` to stop). No third-party client library, per the
+  no-new-deps rule.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from apex_tpu.utils import metrics
+
+__all__ = ["prometheus_text", "json_snapshot", "write_snapshot", "serve"]
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a registry name (``serving.ttft_ms``) into a Prometheus
+    metric name (``serving_ttft_ms``)."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    esc = {k: str(v).replace("\\", r"\\").replace('"', r'\"')
+           .replace("\n", r"\n") for k, v in labels.items()}
+    inner = ",".join(f'{_prom_name(k)}="{esc[k]}"'
+                     for k in sorted(esc))
+    return "{" + inner + "}"
+
+
+def _merge_labels(labels: Dict[str, str], **extra) -> str:
+    merged = dict(labels)
+    merged.update(extra)
+    return _prom_labels(merged)
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"            # valid exposition literal — a NaN
+        #                             metric must not kill the exporter
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a ``metrics.snapshot()`` (current registry if omitted) in
+    the Prometheus text exposition format, trailing newline included."""
+    if snap is None:
+        snap = metrics.snapshot()
+    lines = []
+
+    def sample(name, labels_str, value):
+        lines.append(f"{name}{labels_str} {_fmt(value)}")
+
+    # ONE `# TYPE` line per metric family: all label sets of a name are
+    # samples of the same family (a second TYPE line for a name is
+    # invalid text exposition — two engine-labeled counters hit this)
+    for kind, prom_type in (("counters", "counter"), ("gauges", "gauge")):
+        seen = set()
+        for entry in sorted(snap.get(kind, ()),
+                            key=lambda e: (e["name"], sorted(
+                                e["labels"].items()))):
+            name = _prom_name(entry["name"])
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} {prom_type}")
+            sample(name, _prom_labels(entry["labels"]), entry["value"])
+
+    seen = set()
+    for entry in sorted(snap.get("histograms", ()),
+                        key=lambda e: (e["name"], sorted(
+                            e["labels"].items()))):
+        name = _prom_name(entry["name"])
+        if name not in seen:
+            seen.add(name)
+            lines.append(f"# TYPE {name} histogram")
+        for le, cum in entry["buckets"]:
+            le_str = "+Inf" if le is None else format(le, ".6g")
+            sample(name + "_bucket",
+                   _merge_labels(entry["labels"], le=le_str), cum)
+        sample(name + "_sum", _prom_labels(entry["labels"]), entry["sum"])
+        sample(name + "_count", _prom_labels(entry["labels"]),
+               entry["count"])
+
+    # a name that is BOTH an instrument and a raw series (StepTimer
+    # writes its histogram and its record() series under one name) must
+    # export once: the typed instrument wins, else `x_count` would appear
+    # twice with conflicting TYPE metadata and the scrape is rejected
+    instrumented = {_prom_name(e["name"])
+                    for kind in ("counters", "gauges", "histograms")
+                    for e in snap.get(kind, ())}
+    for raw_name in sorted(snap.get("series", ())):
+        s = snap["series"][raw_name]
+        name = _prom_name(raw_name)
+        if name in instrumented:
+            continue
+        for suffix, value in (("_count", s["count"]), ("_mean", s["mean"]),
+                              ("_last", s["last"])):
+            lines.append(f"# TYPE {name}{suffix} gauge")
+            sample(name + suffix, "", value)
+
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def json_snapshot(extra: Optional[dict] = None) -> dict:
+    """The registry snapshot as a JSON-ready document with a timestamp
+    (and optional caller context, e.g. the bench tag)."""
+    doc = {"time_unix": time.time(), **metrics.snapshot()}
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def write_snapshot(path: str, fmt: Optional[str] = None,
+                   extra: Optional[dict] = None) -> str:
+    """Write the current registry to ``path`` — Prometheus text when
+    ``fmt='prom'`` (or the path ends in ``.prom``/``.txt``), JSON
+    otherwise. Returns the path."""
+    if fmt is None:
+        fmt = "prom" if path.endswith((".prom", ".txt")) else "json"
+    if fmt not in ("prom", "json"):
+        raise ValueError(f"unknown snapshot format {fmt!r}")
+    with open(path, "w") as f:
+        if fmt == "prom":
+            f.write(prometheus_text())
+        else:
+            json.dump(json_snapshot(extra), f, indent=1, sort_keys=True)
+            f.write("\n")
+    return path
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (stdlib handler contract)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            body = (json.dumps(json_snapshot(), sort_keys=True)
+                    + "\n").encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):     # silence per-request stderr lines
+        pass
+
+
+def serve(port: int = 0, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Start the metrics endpoint on a daemon thread. ``port=0`` binds an
+    ephemeral port (read it from ``server.server_address[1]``)."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="apex-tpu-metrics", daemon=True)
+    thread.start()
+    return server

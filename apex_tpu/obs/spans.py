@@ -1,0 +1,197 @@
+"""Per-request lifecycle spans: the "why was request 17 slow?" layer.
+
+A :class:`SpanTracer` records named intervals (spans) and instants
+(events — zero-duration spans) per request id, entirely host-side: no
+device syncs, a few dict operations per request phase. The serving
+scheduler drives the canonical lifecycle
+
+    enqueue -> admit -> prefill -> first_token -> decode -> retire
+
+from which :meth:`SpanTracer.lifecycle` derives the operator metrics:
+
+- ``queue_wait_ms`` — enqueue to admit (slot + page availability),
+- ``ttft_ms``       — enqueue to first token (queue wait + prefill),
+- ``tpot_ms``       — decode span / (new_tokens - 1): steady-state
+  time-per-output-token,
+- ``prefill`` attrs — ``cached_tokens`` vs ``computed_tokens`` (the
+  prefix-cache split).
+
+Intervals additionally enter/exit ``jax.profiler.TraceAnnotation`` so an
+xprof capture of a serving run shows the same request phases as labeled
+host spans next to the device timeline — one trace model for both the
+postmortem dump and the profiler UI.
+
+Timestamps come from an injectable monotonic ``clock`` (tests pass a
+fake); they are durations-on-one-host, not wall time — the
+:class:`~apex_tpu.obs.events.EventLog` records wall-clock for
+correlation with external logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+__all__ = ["PHASES", "Span", "SpanTracer"]
+
+#: canonical request lifecycle, in order
+PHASES = ("enqueue", "admit", "prefill", "first_token", "decode", "retire")
+
+
+@dataclasses.dataclass
+class Span:
+    """One named interval (or instant, when ``t_end == t_start``) in a
+    request's lifecycle. ``attrs`` carries phase payloads (token counts,
+    slot ids); :meth:`duration_ms` is None while the span is open."""
+
+    request_id: object
+    name: str
+    t_start: float
+    t_end: Optional[float] = None
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.t_end is None:
+            return None
+        return (self.t_end - self.t_start) * 1e3
+
+    def to_dict(self) -> dict:
+        return {"request_id": self.request_id, "name": self.name,
+                "t_start": self.t_start, "t_end": self.t_end,
+                "duration_ms": self.duration_ms, "attrs": dict(self.attrs)}
+
+
+class SpanTracer:
+    """Collects spans per request id and assembles lifecycle summaries.
+
+    Thread-safe; begin/end of one span must pair on one thread (the
+    profiler annotation is thread-scoped). The scheduler creates a fresh
+    tracer per ``run()`` so lifecycles describe exactly one run.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._spans: Dict[object, List[Span]] = {}
+        self._open: Dict[Tuple[object, str], Tuple[Span, object]] = {}
+
+    # -- recording ------------------------------------------------------
+
+    def event(self, request_id, name: str, **attrs) -> Span:
+        """Record an instant (zero-duration span)."""
+        t = self._clock()
+        span = Span(request_id, name, t, t, attrs)
+        with self._lock:
+            self._spans.setdefault(request_id, []).append(span)
+        return span
+
+    def begin(self, request_id, name: str, annotate: bool = False,
+              **attrs) -> Span:
+        """Open a span. ``annotate=True`` additionally enters a
+        ``jax.profiler.TraceAnnotation`` — only safe when the matching
+        ``end`` nests LIFO on this thread (use :meth:`span` for that);
+        free-form overlapping spans (concurrent requests' decode
+        intervals) must leave it False: TraceMe demands properly nested
+        begin/end pairs per thread."""
+        span = Span(request_id, name, self._clock(), None, attrs)
+        with self._lock:
+            key = (request_id, name)
+            if key in self._open:
+                # check BEFORE entering the annotation: raising with an
+                # entered TraceMe would leave it open on this thread and
+                # mis-nest every later annotation
+                raise RuntimeError(f"span {name!r} already open for "
+                                   f"request {request_id!r}")
+            ann = None
+            if annotate:
+                ann = jax.profiler.TraceAnnotation(
+                    f"req{request_id}:{name}")
+                ann.__enter__()
+            self._open[key] = (span, ann)
+            self._spans.setdefault(request_id, []).append(span)
+        return span
+
+    def end(self, request_id, name: str, **attrs) -> Span:
+        with self._lock:
+            try:
+                span, ann = self._open.pop((request_id, name))
+            except KeyError:
+                raise RuntimeError(f"end({name!r}) for request "
+                                   f"{request_id!r} without begin()")
+            # mutate under the lock: a concurrent reader (lifecycles /
+            # to_dicts from an export thread) must never see t_end set
+            # while the closing attrs are still missing
+            span.t_end = self._clock()
+            span.attrs.update(attrs)
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        return span
+
+    @contextlib.contextmanager
+    def span(self, request_id, name: str, **attrs):
+        """Properly-nested interval: rides a profiler annotation, so it
+        shows up as a labeled host span in xprof captures."""
+        s = self.begin(request_id, name, annotate=True, **attrs)
+        try:
+            yield s
+        finally:
+            self.end(request_id, name)
+
+    # -- reading --------------------------------------------------------
+
+    def requests(self) -> List[object]:
+        with self._lock:
+            return list(self._spans)
+
+    def spans(self, request_id) -> List[Span]:
+        with self._lock:
+            return list(self._spans.get(request_id, ()))
+
+    def lifecycle(self, request_id) -> Dict[str, object]:
+        """Derived per-request metrics from the canonical phases. Keys
+        appear only when their source spans exist (a partial lifecycle —
+        a still-running request — yields what is known so far)."""
+        by_name: Dict[str, Span] = {}
+        for s in self.spans(request_id):
+            by_name[s.name] = s           # latest occurrence wins
+        out: Dict[str, object] = {"request_id": request_id}
+        enq = by_name.get("enqueue")
+        admit = by_name.get("admit")
+        first = by_name.get("first_token")
+        if enq is not None and admit is not None:
+            out["queue_wait_ms"] = (admit.t_start - enq.t_start) * 1e3
+        if enq is not None and first is not None:
+            out["ttft_ms"] = (first.t_start - enq.t_start) * 1e3
+        prefill = by_name.get("prefill")
+        if prefill is not None and prefill.duration_ms is not None:
+            out["prefill_ms"] = prefill.duration_ms
+            for k in ("cached_tokens", "computed_tokens"):
+                if k in prefill.attrs:
+                    out[k] = prefill.attrs[k]
+        decode = by_name.get("decode")
+        if decode is not None and decode.duration_ms is not None:
+            out["decode_ms"] = decode.duration_ms
+            n_new = decode.attrs.get("new_tokens")
+            if n_new is not None:
+                out["new_tokens"] = n_new
+                # token 0 samples at admit; decode produces the rest
+                out["tpot_ms"] = decode.duration_ms / max(int(n_new) - 1, 1)
+        retire = by_name.get("retire")
+        if enq is not None and retire is not None:
+            out["total_ms"] = (retire.t_start - enq.t_start) * 1e3
+        return out
+
+    def lifecycles(self) -> Dict[object, Dict[str, object]]:
+        return {rid: self.lifecycle(rid) for rid in self.requests()}
+
+    def to_dicts(self) -> List[dict]:
+        """Every span, flattened — the postmortem-dump payload."""
+        with self._lock:
+            return [s.to_dict() for spans in self._spans.values()
+                    for s in spans]

@@ -54,10 +54,12 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.ops._dispatch import cdiv
 from apex_tpu.transformer.utils import divide
+from apex_tpu.utils import metrics
 
 
 def page_size_of(cache) -> int:
@@ -114,6 +116,29 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
 
 def free_page_count(cache):
     return cache["free_top"]
+
+
+def observe_pool(cache, labels: Optional[dict] = None) -> dict:
+    """Publish the pool's health gauges (docs/observability.md catalog):
+    ``kv_pool.free_pages``, ``kv_pool.pages_total`` (usable, i.e. minus
+    the null page), ``kv_pool.shared_pages_active`` (pages with
+    ``page_ref > 0`` — currently shared by live readers), and
+    ``kv_pool.page_refs_total`` (sum of active refcounts). ``labels``
+    distinguishes pools (the engine passes its ``engine`` label — two
+    engines' pools must not clobber one gauge). HOST-side only: reads
+    two small device arrays (a scalar and the per-page refcounts) — the
+    scheduler calls it at its sync boundaries, never from jitted code.
+    Returns the gauge values as a dict."""
+    refs = np.asarray(cache["page_ref"])
+    vals = {
+        "kv_pool.free_pages": int(np.asarray(cache["free_top"])),
+        "kv_pool.pages_total": num_pages_of(cache) - 1,
+        "kv_pool.shared_pages_active": int((refs > 0).sum()),
+        "kv_pool.page_refs_total": int(refs.sum()),
+    }
+    for name, v in vals.items():
+        metrics.gauge(name, labels=labels).set(v)
+    return vals
 
 
 def alloc_slot(cache, slot, n_pages):

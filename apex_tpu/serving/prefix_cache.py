@@ -53,6 +53,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.utils import metrics
+
 __all__ = ["PrefixCache"]
 
 
@@ -80,19 +82,30 @@ class PrefixCache:
     block-table rows) goes through the ``kv_pool`` ops the scheduler
     jits; this class decides WHICH pages to share, keep, and evict."""
 
-    def __init__(self, page_size: int):
+    def __init__(self, page_size: int,
+                 metrics_labels: Optional[dict] = None):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.page_size = page_size
         self.root = _Node(key=None, page=-1, parent=None)
         self._nodes: set = set()
         self._tick = 0
+        # label set for the cache's gauges/counters (the engine passes
+        # its ``engine`` label so two caches never clobber one family)
+        self._metrics_labels = (dict(metrics_labels)
+                                if metrics_labels else None)
 
     # --- introspection ------------------------------------------------------
 
     def __len__(self) -> int:
         """Number of cached pages (= tree nodes, root excluded)."""
         return len(self._nodes)
+
+    def _observe(self) -> None:
+        """Refresh the residency gauge (``prefix_cache.pages``); called on
+        every tree mutation — host-side dict math, no device traffic."""
+        metrics.gauge("prefix_cache.pages",
+                      labels=self._metrics_labels).set(len(self._nodes))
 
     def pages(self) -> List[int]:
         """Physical page ids the cache currently holds (order arbitrary)."""
@@ -181,6 +194,13 @@ class PrefixCache:
             # under the canonical node so deeper pages chain correctly
             node = child
         self.release(matched)
+        inserted = int(keep[m:n_cache].sum())
+        metrics.counter("prefix_cache.inserted_pages",
+                        labels=self._metrics_labels).inc(inserted)
+        metrics.counter("prefix_cache.duplicate_pages",
+                        labels=self._metrics_labels).inc(
+            (n_cache - m) - inserted)
+        self._observe()
         return keep
 
     # --- eviction -----------------------------------------------------------
@@ -209,6 +229,9 @@ class PrefixCache:
             if (parent is not self.root and not parent.children
                     and parent.refs == 0):
                 heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        metrics.counter("prefix_cache.evicted_pages",
+                        labels=self._metrics_labels).inc(len(out))
+        self._observe()
         return out
 
     # --- maintenance --------------------------------------------------------

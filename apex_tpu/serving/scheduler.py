@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
+import time
 from collections import deque
 from typing import Any, List, Optional, Sequence
 
@@ -54,10 +56,26 @@ from jax import lax
 from apex_tpu.mesh import MODEL_AXIS
 from apex_tpu.models.generation import (_greedy_token, _sample_token,
                                         init_cache, validate_sampling)
+from apex_tpu.obs.events import EventLog
+from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.serving import kv_pool
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.utils import metrics
+
+#: run() counters in the instrument registry (``serving.<name>``); the
+#: per-run stats dict is the DELTA of these across the run — the registry
+#: is the state of record, the dict a derived view
+_RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
+                 "prefix_hits", "prefill_tokens_total",
+                 "prefill_tokens_computed", "evicted_pages",
+                 "deferred_admissions", "defrag_runs")
+
+#: per-request latency histograms (``serving.<name>``, log-bucketed ms)
+_RUN_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "decode_step_ms")
+
+#: per-process engine ids, the ``engine`` label on run counters
+_ENGINE_IDS = itertools.count()
 
 
 @dataclasses.dataclass
@@ -197,8 +215,19 @@ class PagedDecodeEngine:
         self.cache = kv_pool.init_paged_cache(
             cfg, num_slots, num_pages=num_pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq)
+        # observability (docs/observability.md): a bounded postmortem
+        # event ring for the engine's lifetime, and the last run's span
+        # tracer (fresh per run; run(tracer=...) injects one). Every
+        # serving/pool/prefix instrument carries this ``engine`` label
+        # so concurrent engines in one process never mix each other's
+        # increments, distributions, or pool-health levels
+        self.events = EventLog(capacity=4096)
+        self.tracer: Optional[SpanTracer] = None
+        self.obs_labels = {"engine": str(next(_ENGINE_IDS))}
         # cross-request KV reuse: the host radix tree naming cached pages
-        self.prefix = PrefixCache(page_size) if prefix_cache else None
+        self.prefix = (PrefixCache(page_size,
+                                   metrics_labels=self.obs_labels)
+                       if prefix_cache else None)
         self._admit_jit = {}             # prompt bucket -> compiled admit
         self._shared_admit_jit = {}      # (t_start, tail_bucket) -> admit
         self._step_jit = None
@@ -334,20 +363,31 @@ class PagedDecodeEngine:
 
     # --- the host scheduling loop -------------------------------------------
 
-    def run(self, requests: Sequence[Request]):
+    def run(self, requests: Sequence[Request], *,
+            tracer: Optional[SpanTracer] = None):
         """Drain the request queue; returns ``(outputs, stats)``.
 
         ``outputs[i]``: np.int32 generated tokens for request ``i`` —
         length ``max_new_tokens``, or shorter when the request hit EOS
         (the EOS token is included). ``stats``: engine counters for this
-        run — ``decode_steps`` / ``admitted`` / ``retired`` /
+        run, DERIVED from the ``serving.*`` instrument registry
+        (``apex_tpu.utils.metrics``) as the delta of each counter across
+        the run — ``decode_steps`` / ``admitted`` / ``retired`` /
         ``peak_slots_in_use`` / ``slot_occupancy``, the prefix-cache
         counters (``prefix_hits``, ``prefix_hit_rate``,
         ``prefill_tokens_{total,computed,skipped}``, ``evicted_pages``,
-        ``prefix_cached_pages``), and the maintenance counters
-        (``deferred_admissions``, ``defrag_runs``). Every numeric counter
-        is also recorded as ``serving.<name>`` through
-        ``apex_tpu.utils.metrics``.
+        ``prefix_cached_pages``), the maintenance counters
+        (``deferred_admissions``, ``defrag_runs``), and this run's
+        latency percentiles (``ttft_ms_p50/p95``, ``tpot_ms_p50/p95``,
+        ``queue_wait_ms_p50/p95``, ``decode_step_ms_p50/p95``). Every
+        numeric stat is also recorded as a ``serving.<name>`` raw series.
+
+        Per-request lifecycle spans (enqueue → admit → prefill →
+        first_token → decode → retire) land in a fresh
+        :class:`~apex_tpu.obs.spans.SpanTracer` kept as ``self.tracer``
+        (pass ``tracer=`` to supply your own); scheduling events append
+        to the engine-lifetime ``self.events`` ring
+        (docs/observability.md).
         """
         cfg, ps = self.cfg, self.page_size
         max_pages = self.cache["block_tables"].shape[1]
@@ -365,7 +405,23 @@ class PagedDecodeEngine:
                     f"request needs more than max_pages_per_seq="
                     f"{max_pages} pages")
 
+        tr = tracer if tracer is not None else SpanTracer()
+        self.tracer = tr
+        C = {n: metrics.counter(f"serving.{n}", labels=self.obs_labels)
+             for n in _RUN_COUNTERS}
+        c0 = {n: C[n].value for n in C}   # run-start snapshot -> deltas
+        H = {n: metrics.histogram(f"serving.{n}", labels=self.obs_labels)
+             for n in _RUN_HISTOGRAMS}
+        occ_gauge = metrics.gauge("serving.slots_in_use",
+                                  labels=self.obs_labels)
+        per_run = {n: [] for n in _RUN_HISTOGRAMS}
+
         queue = deque(enumerate(requests))
+        for idx, req in queue:
+            # np.shape reads the length without a device->host transfer
+            tr.event(idx, "enqueue",
+                     prompt_tokens=int(np.shape(req.prompt)[0]),
+                     max_new_tokens=req.max_new_tokens)
         outputs: List[Optional[np.ndarray]] = [None] * len(requests)
         active = {}                       # slot -> mutable request record
         tok = jnp.zeros((self.num_slots,), jnp.int32)
@@ -374,16 +430,25 @@ class PagedDecodeEngine:
         samp_i = jnp.zeros((self.num_slots,), jnp.int32)
         req_keys = jnp.broadcast_to(self.rng, (self.num_slots,)
                                     + self.rng.shape)
-        steps = 0
         peak = 0
-        c = {"retired": 0, "hits": 0, "prefill_total": 0,
-             "prefill_computed": 0, "evicted_pages": 0, "deferred": 0,
-             "defrag_runs": 0, "busy_slot_steps": 0}
+
+        def observe_lifecycle(idx):
+            life = tr.lifecycle(idx)
+            for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
+                if name in life:
+                    H[name].observe(life[name])
+                    per_run[name].append(life[name])
 
         def retire(slot):
             rec = active.pop(slot)
             outputs[rec["idx"]] = np.asarray(rec["tokens"], np.int32)
-            c["retired"] += 1
+            C["retired"].inc()
+            n_new = len(rec["tokens"])
+            tr.end(rec["idx"], "decode", new_tokens=n_new)
+            tr.event(rec["idx"], "retire", slot=slot, new_tokens=n_new)
+            self.events.emit("retire", request=rec["idx"], slot=slot,
+                             new_tokens=n_new)
+            observe_lifecycle(rec["idx"])
             if self.prefix is None:
                 self.cache = self._free_jit(self.cache, jnp.int32(slot))
                 return
@@ -437,47 +502,64 @@ class PagedDecodeEngine:
                         self.cache = self._evict_jit(
                             self.cache, jnp.asarray(row),
                             jnp.int32(len(pages)))
-                        c["evicted_pages"] += len(pages)
+                        C["evicted_pages"].inc(len(pages))
+                        self.events.emit("evict", request=idx,
+                                         pages=len(pages))
                         free += len(pages)
                 if free < need and self._leak_suspected(free, active):
                     # liveness says more pages exist than the stack shows:
                     # compact + rebuild the stack, remap the radix tree
                     self._defrag_now()
-                    c["defrag_runs"] += 1
+                    C["defrag_runs"].inc()
+                    self.events.emit("defrag", request=idx)
                     free = int(kv_pool.free_page_count(self.cache))
                 if free < need:
                     if nodes:
                         self.prefix.release(nodes)
-                    c["deferred"] += 1
+                    C["deferred_admissions"].inc()
+                    self.events.emit("defer", request=idx, need_pages=need,
+                                     free_pages=free)
                     break                 # head-of-line: wait for pages
                 queue.popleft()
+                tr.event(idx, "admit", slot=slot, free_pages=free,
+                         cached_pages=m)
                 req_key = jax.random.fold_in(self.rng, idx)
-                if m == 0:
-                    bucket = min(round_up(max(s0, 1), ps),
-                                 cfg.max_position_embeddings)
-                    ids = np.zeros((1, bucket), np.int32)
-                    ids[0, :s0] = prompt
-                    self.cache, tok0 = self._admit_fn(bucket)(
-                        self.cache, self.variables, jnp.asarray(ids),
-                        jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
-                        req_key)
-                else:
-                    c["hits"] += 1
-                    t_start = m * ps
-                    tail_bucket = min(round_up(s0 - t_start, ps),
-                                      cfg.max_position_embeddings - t_start)
-                    ids = np.zeros((1, tail_bucket), np.int32)
-                    ids[0, :s0 - t_start] = prompt[t_start:]
-                    row = np.zeros((max_pages,), np.int32)
-                    row[:m] = [n.page for n in nodes]
-                    self.cache, tok0 = self._admit_shared_fn(
-                        t_start, tail_bucket)(
-                        self.cache, self.variables, jnp.asarray(ids),
-                        jnp.int32(s0), jnp.int32(slot), jnp.asarray(row),
-                        jnp.int32(need), req_key)
-                c["prefill_total"] += s0
-                c["prefill_computed"] += s0 - m * ps
-                tok0 = int(tok0)
+                # prefill span: covers the admission program AND the
+                # first-token sync — its end IS the first token's arrival
+                with tr.span(idx, "prefill", cached_tokens=m * ps,
+                             computed_tokens=s0 - m * ps):
+                    if m == 0:
+                        bucket = min(round_up(max(s0, 1), ps),
+                                     cfg.max_position_embeddings)
+                        ids = np.zeros((1, bucket), np.int32)
+                        ids[0, :s0] = prompt
+                        self.cache, tok0 = self._admit_fn(bucket)(
+                            self.cache, self.variables, jnp.asarray(ids),
+                            jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                            req_key)
+                    else:
+                        C["prefix_hits"].inc()
+                        t_start = m * ps
+                        tail_bucket = min(round_up(s0 - t_start, ps),
+                                          cfg.max_position_embeddings
+                                          - t_start)
+                        ids = np.zeros((1, tail_bucket), np.int32)
+                        ids[0, :s0 - t_start] = prompt[t_start:]
+                        row = np.zeros((max_pages,), np.int32)
+                        row[:m] = [n.page for n in nodes]
+                        self.cache, tok0 = self._admit_shared_fn(
+                            t_start, tail_bucket)(
+                            self.cache, self.variables, jnp.asarray(ids),
+                            jnp.int32(s0), jnp.int32(slot),
+                            jnp.asarray(row), jnp.int32(need), req_key)
+                    tok0 = int(tok0)
+                tr.event(idx, "first_token", slot=slot)
+                tr.begin(idx, "decode", slot=slot)
+                C["admitted"].inc()
+                C["prefill_tokens_total"].inc(s0)
+                C["prefill_tokens_computed"].inc(s0 - m * ps)
+                self.events.emit("admit", request=idx, slot=slot,
+                                 prompt_tokens=s0, cached_tokens=m * ps)
                 rec = {"idx": idx, "tokens": [tok0],
                        "max_new": req.max_new_tokens, "prompt": prompt,
                        "s0": s0, "nodes": nodes, "n_private": need}
@@ -502,16 +584,25 @@ class PagedDecodeEngine:
                         "for its page demand?)")
                 continue
             peak = max(peak, len(active))
+            occ_gauge.set(len(active))
 
             # --- one jitted multi-step decode chunk ---------------------
-            c["busy_slot_steps"] += len(active) * self.sync_every
+            C["busy_slot_steps"].inc(len(active) * self.sync_every)
+            t_chunk = time.perf_counter()
             self.cache, tok, done, n_left, samp_i, toks = self._step_fn()(
                 self.cache, self.variables, tok, done, n_left, req_keys,
                 samp_i)
-            steps += self.sync_every
+            toks_np = np.asarray(toks)               # (sync_every, slots)
+            # per-step wall time, synced at the harvest (with
+            # sync_every > 1 this is the chunk's per-step mean)
+            step_ms = ((time.perf_counter() - t_chunk) * 1e3
+                       / self.sync_every)
+            H["decode_step_ms"].observe(step_ms)
+            per_run["decode_step_ms"].append(step_ms)
+            C["decode_steps"].inc(self.sync_every)
 
             # --- harvest + retirement at the sync boundary --------------
-            toks_np = np.asarray(toks)               # (sync_every, slots)
+            n_retired_chunk = 0
             for slot in list(active):
                 rec = active[slot]
                 finished = False
@@ -526,25 +617,45 @@ class PagedDecodeEngine:
                 if finished:
                     retire(slot)
                     done = done.at[slot].set(True)
+                    n_retired_chunk += 1
 
+            # pool health gauges (free pages, active sharing refcounts —
+            # docs/observability.md catalog): only at boundaries where
+            # the pool actually changed (admission/retirement), so
+            # steady decode-only chunks pay no extra device->host reads
+            if admitted_any or n_retired_chunk:
+                kv_pool.observe_pool(self.cache, labels=self.obs_labels)
+
+        # final state after the drain
+        kv_pool.observe_pool(self.cache, labels=self.obs_labels)
+        occ_gauge.set(0)
+        d = {n: C[n].value - c0[n] for n in C}   # this run's contribution
         stats = {
-            "decode_steps": steps, "admitted": len(requests),
-            "retired": c["retired"], "peak_slots_in_use": peak,
-            "slot_occupancy": (c["busy_slot_steps"]
-                               / max(steps * self.num_slots, 1)),
-            "deferred_admissions": c["deferred"],
-            "defrag_runs": c["defrag_runs"],
+            "decode_steps": int(d["decode_steps"]),
+            "admitted": int(d["admitted"]),
+            "retired": int(d["retired"]), "peak_slots_in_use": peak,
+            "slot_occupancy": (d["busy_slot_steps"]
+                               / max(d["decode_steps"] * self.num_slots,
+                                     1)),
+            "deferred_admissions": int(d["deferred_admissions"]),
+            "defrag_runs": int(d["defrag_runs"]),
             "prefix_cache_enabled": self.prefix is not None,
-            "prefix_hits": c["hits"],
-            "prefix_hit_rate": c["hits"] / max(len(requests), 1),
+            "prefix_hits": int(d["prefix_hits"]),
+            "prefix_hit_rate": d["prefix_hits"] / max(d["admitted"], 1),
             "prefix_cached_pages": (len(self.prefix)
                                     if self.prefix is not None else 0),
-            "evicted_pages": c["evicted_pages"],
-            "prefill_tokens_total": c["prefill_total"],
-            "prefill_tokens_computed": c["prefill_computed"],
-            "prefill_tokens_skipped": (c["prefill_total"]
-                                       - c["prefill_computed"]),
+            "evicted_pages": int(d["evicted_pages"]),
+            "prefill_tokens_total": int(d["prefill_tokens_total"]),
+            "prefill_tokens_computed": int(d["prefill_tokens_computed"]),
+            "prefill_tokens_skipped": int(d["prefill_tokens_total"]
+                                          - d["prefill_tokens_computed"]),
         }
+        # this run's latency percentiles (the global histograms hold the
+        # engine-lifetime distributions; these are run-local and exact)
+        for name, vals in per_run.items():
+            if vals:
+                stats[f"{name}_p50"] = float(np.percentile(vals, 50))
+                stats[f"{name}_p95"] = float(np.percentile(vals, 95))
         for name, val in stats.items():
             if isinstance(val, bool):
                 continue

@@ -12,8 +12,9 @@ from apex_tpu.utils.checkpoint import (
     save_checkpoint,
 )
 from apex_tpu.utils import metrics
-from apex_tpu.utils.metrics import AverageMeter, StepTimer
+from apex_tpu.utils.metrics import (AverageMeter, Counter, Gauge,
+                                    Histogram, StepTimer)
 
 __all__ = ["annotate", "time_fn", "trace", "save_checkpoint",
            "restore_checkpoint", "CheckpointManager", "metrics",
-           "AverageMeter", "StepTimer"]
+           "AverageMeter", "Counter", "Gauge", "Histogram", "StepTimer"]

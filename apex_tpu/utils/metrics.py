@@ -1,32 +1,59 @@
-"""Scalar metrics registry + meters (SURVEY.md §5 metrics row).
+"""Typed metric instruments + scalar registry (docs/observability.md).
 
 The reference has no metrics subsystem — its only observability is the
 ``AverageMeter`` stdout meter inside examples (examples/imagenet/
-main_amp.py:~420) and amp's ``maybe_print``. This module is the prescribed
-"small metrics.py (host-callback scalars), already beyond reference":
+main_amp.py:~420) and amp's ``maybe_print``. This module grew from the
+prescribed "small metrics.py (host-callback scalars)" into the instrument
+layer the serving/observability tier (``apex_tpu.obs``) exports from:
 
-- ``AverageMeter`` — exact analog of the example's meter (val/avg/sum/count).
+- **Typed instruments** — :class:`Counter` (monotonic), :class:`Gauge`
+  (last-value), and :class:`Histogram` (log-bucketed, p50/p90/p99), each
+  with optional labels, interned in a process-wide registry via
+  :func:`counter` / :func:`gauge` / :func:`histogram` (same
+  ``(name, labels)`` always returns the same object).
 - ``record(name, value)`` — usable INSIDE jitted/sharded code: a
-  ``jax.debug.callback`` ships the scalar to the host registry when the step
-  actually executes (so recording does not force a sync; values arrive in
-  execution order).
-- ``get``/``mean``/``summary``/``clear`` — host-side registry access. Call
-  ``jax.effects_barrier()`` (or block on step outputs) before reading if you
-  need every in-flight step's values.
+  ``jax.debug.callback`` ships the scalar to the host registry when the
+  step actually executes (recording does not force a sync; values arrive
+  in execution order). The callback is a module-level callable cached per
+  name, so repeated traces of the same instrumented program share one
+  callback object instead of baking a fresh closure into every jaxpr.
+- ``get``/``mean``/``summary``/``snapshot``/``clear`` — host-side registry
+  access. Call ``jax.effects_barrier()`` (or block on step outputs) before
+  reading if you need every in-flight step's values. Callbacks can arrive
+  on runtime threads, so every registry mutation takes the module lock.
+- ``AverageMeter`` — exact analog of the example's meter (val/avg/sum/
+  count), kept as a standalone convenience.
+- ``StepTimer`` — wall-clock step meter; ``observe`` feeds a
+  :class:`Histogram` (percentiles) plus the raw ``record()`` series.
+
+Export (Prometheus text exposition, JSON snapshots, an optional HTTP
+endpoint) lives in ``apex_tpu.obs.export`` and reads :func:`snapshot`.
 """
 
 from __future__ import annotations
 
 import collections
+import functools
+import math
+import threading
 import time
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 
-__all__ = ["AverageMeter", "record", "get", "mean", "summary", "clear",
-           "StepTimer"]
+__all__ = ["AverageMeter", "Counter", "Gauge", "Histogram", "StepTimer",
+           "counter", "gauge", "histogram", "instruments", "record", "get",
+           "mean", "summary", "snapshot", "clear"]
 
+# one re-entrant lock guards the raw series, the instrument table, and
+# every instrument's internal state: jax.debug.callback may deliver on
+# XLA runtime threads while the scheduler thread reads a summary
+_LOCK = threading.RLock()
 _REGISTRY: Dict[str, List[float]] = collections.defaultdict(list)
+
+LabelsKey = Tuple[Tuple[str, str], ...]
+_INSTRUMENTS: "Dict[Tuple[str, LabelsKey], Instrument]" = {}
+_JIT_CALLBACKS: Dict[str, Callable] = {}
 
 
 class AverageMeter:
@@ -54,58 +81,377 @@ class AverageMeter:
         return f"{self.name} {self.val:.4f} ({self.avg:.4f})"
 
 
+# --------------------------------------------------------------------------
+# typed instruments
+# --------------------------------------------------------------------------
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> LabelsKey:
+    return tuple(sorted((str(k), str(v))
+                        for k, v in (labels or {}).items()))
+
+
+class Instrument:
+    """Base: a named, optionally-labeled metric. Subclasses define the
+    measurement semantics; construction goes through :func:`counter` /
+    :func:`gauge` / :func:`histogram` so equal ``(name, labels)`` pairs
+    share one instance process-wide."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+
+    def key(self) -> Tuple[str, LabelsKey]:
+        return (self.name, _labels_key(self.labels))
+
+    def config(self) -> Dict[str, object]:
+        """Layout parameters that must agree across every label set of a
+        name (one Prometheus family, one layout)."""
+        return {}
+
+
+class Counter(Instrument):
+    """Monotonically non-decreasing count (requests admitted, pages
+    evicted). Per-interval rates/deltas are the READER's job (the
+    scheduler derives per-run stats from start/end values)."""
+
+    kind = "counter"
+
+    def __init__(self, name, labels=None):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"Counter.inc({n}): counters only go up — "
+                             "use a Gauge for signed deltas")
+        with _LOCK:
+            self._value += float(n)
+
+    @property
+    def value(self) -> float:
+        with _LOCK:
+            return self._value
+
+
+class Gauge(Instrument):
+    """Last-written value (free pages, slots in use)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, labels=None):
+        super().__init__(name, labels)
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        with _LOCK:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with _LOCK:
+            self._value += float(n)
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        with _LOCK:
+            return self._value
+
+
+class Histogram(Instrument):
+    """Log-bucketed histogram with quantile estimation.
+
+    Bucket ``i`` covers ``(base * growth**(i-1), base * growth**i]``
+    (bucket 0 covers ``(0, base]``; the last bucket is open-ended), so a
+    fixed, small bucket array spans microseconds to hours — the standard
+    latency-histogram layout. :meth:`quantile` walks the cumulative
+    counts and interpolates linearly inside the target bucket, clamped to
+    the observed ``[min, max]`` (a single-observation histogram reports
+    that exact value at every quantile; errors are bounded by one bucket's
+    width, i.e. a factor of ``growth``).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, labels=None, *, base: float = 1e-2,
+                 growth: float = 2.0, n_buckets: int = 48):
+        super().__init__(name, labels)
+        if base <= 0 or growth <= 1 or n_buckets < 2:
+            raise ValueError("Histogram needs base > 0, growth > 1, "
+                             "n_buckets >= 2")
+        self.base = float(base)
+        self.growth = float(growth)
+        self.n_buckets = n_buckets
+        self._lg = math.log(growth)
+        self._counts = [0] * n_buckets
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    # -- write ----------------------------------------------------------
+
+    def _bucket_index(self, v: float) -> int:
+        if v <= self.base:
+            return 0
+        # le semantics at boundaries: v == base*growth**i lands in bucket
+        # i (the 1e-9 slack absorbs log() round-off at exact powers)
+        i = int(math.ceil(math.log(v / self.base) / self._lg - 1e-9))
+        return min(max(i, 0), len(self._counts) - 1)
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with _LOCK:
+            self._counts[self._bucket_index(v)] += 1
+            self._count += 1
+            self._sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    # -- read -----------------------------------------------------------
+
+    @property
+    def count(self) -> int:
+        with _LOCK:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with _LOCK:
+            return self._sum
+
+    def bucket_le(self, i: int) -> float:
+        """Upper bound of bucket ``i`` (inf for the last bucket)."""
+        if i >= len(self._counts) - 1:
+            return math.inf
+        return self.base * self.growth ** i
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """``[(le, cumulative_count), ...]`` — the Prometheus layout."""
+        out, cum = [], 0
+        with _LOCK:
+            for i, c in enumerate(self._counts):
+                cum += c
+                out.append((self.bucket_le(i), cum))
+        return out
+
+    def quantile(self, q: float) -> float:
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with _LOCK:
+            if self._count == 0:
+                return 0.0
+            target = q * self._count
+            cum = 0.0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                if cum + c >= target:
+                    lo = 0.0 if i == 0 else self.bucket_le(i - 1)
+                    hi = self.bucket_le(i)
+                    if math.isinf(hi):
+                        hi = self._max
+                    frac = (target - cum) / c
+                    v = lo + frac * (hi - lo)
+                    return min(max(v, self._min), self._max)
+                cum += c
+            return self._max
+
+    def percentiles(self) -> Dict[str, float]:
+        return {"p50": self.quantile(0.50), "p90": self.quantile(0.90),
+                "p99": self.quantile(0.99)}
+
+    def config(self) -> Dict[str, object]:
+        return {"base": self.base, "growth": self.growth,
+                "n_buckets": self.n_buckets}
+
+
+def _instrument(cls, name: str, labels: Optional[Dict[str, str]], **kw):
+    key = (name, _labels_key(labels))
+    with _LOCK:
+        inst = _INSTRUMENTS.get(key)
+        if inst is None:
+            # kind AND layout are properties of the NAME (the Prometheus
+            # data model: one family, one type, one bucket layout) — a
+            # sibling label set must agree on both
+            sibling = next((i for (n, _), i in _INSTRUMENTS.items()
+                            if n == name), None)
+            if sibling is not None and not isinstance(sibling, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{sibling.kind} (labels {sibling.labels}), not "
+                    f"{cls.kind}")
+            inst = cls(name, labels, **kw)
+            if sibling is not None and inst.config() != sibling.config():
+                raise ValueError(
+                    f"metric {name!r} label set {inst.labels} asks for "
+                    f"config {inst.config()} but the family is "
+                    f"registered with {sibling.config()}")
+            _INSTRUMENTS[key] = inst
+        elif not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {inst.kind}, "
+                f"not {cls.kind}")
+        elif kw:
+            # a histogram() call asking for different buckets than the
+            # registered instance must fail loudly — silently returning
+            # the old layout would mis-bucket every observation
+            drift = {k: (v, getattr(inst, k)) for k, v in kw.items()
+                     if getattr(inst, k, v) != v}
+            if drift:
+                raise ValueError(
+                    f"metric {name!r} already registered with different "
+                    f"config: {drift} (requested, registered)")
+        return inst
+
+
+def counter(name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+    return _instrument(Counter, name, labels)
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+    return _instrument(Gauge, name, labels)
+
+
+def histogram(name: str, labels: Optional[Dict[str, str]] = None,
+              **kw) -> Histogram:
+    return _instrument(Histogram, name, labels, **kw)
+
+
+def instruments() -> List[Instrument]:
+    """Every registered instrument, sorted by (name, labels)."""
+    with _LOCK:
+        return [_INSTRUMENTS[k] for k in sorted(_INSTRUMENTS)]
+
+
+# --------------------------------------------------------------------------
+# the raw scalar series (jit-safe channel)
+# --------------------------------------------------------------------------
+
 def _append(name: str, value) -> None:
-    _REGISTRY[name].append(float(value))
+    with _LOCK:
+        _REGISTRY[name].append(float(value))
+
+
+def _callback_for(name: str) -> Callable:
+    """Module-level host callback for ``record(name, ...)``, cached per
+    name: every trace of an instrumented program bakes the SAME callable
+    into its jaxpr (a per-call lambda would defeat jaxpr/dispatch caching
+    and leak one closure per trace)."""
+    with _LOCK:
+        cb = _JIT_CALLBACKS.get(name)
+        if cb is None:
+            cb = functools.partial(_append, name)
+            _JIT_CALLBACKS[name] = cb
+        return cb
 
 
 def record(name: str, value) -> None:
     """Record a scalar from anywhere — including inside jit/shard_map.
 
     ``name`` must be a static Python string; ``value`` may be a traced
-    scalar (a host callback delivers it at execution time) or a plain
+    scalar (a host callback delivers it at execution time — non-blocking,
+    tpu-lint's host-sync rule knows this channel is exempt) or a plain
     number (recorded immediately).
     """
     if isinstance(value, (int, float)):
         _append(name, value)
         return
-    jax.debug.callback(lambda v, _n=name: _append(_n, v), value)
+    jax.debug.callback(_callback_for(name), value)
 
 
 def get(name: str) -> List[float]:
-    return list(_REGISTRY.get(name, []))
+    with _LOCK:
+        return list(_REGISTRY.get(name, []))
 
 
 def mean(name: str) -> float:
-    vals = _REGISTRY.get(name)
-    if not vals:
-        raise KeyError(f"no recorded values for metric {name!r}")
-    return sum(vals) / len(vals)
+    with _LOCK:
+        vals = _REGISTRY.get(name)
+        if not vals:
+            raise KeyError(f"no recorded values for metric {name!r}")
+        return sum(vals) / len(vals)
 
 
 def summary() -> Dict[str, dict]:
-    """{name: {count, mean, last}} for every recorded metric."""
-    return {
-        name: {"count": len(v), "mean": sum(v) / len(v), "last": v[-1]}
-        for name, v in _REGISTRY.items() if v
-    }
+    """{name: {count, mean, last}} for every recorded raw series."""
+    with _LOCK:
+        return {
+            name: {"count": len(v), "mean": sum(v) / len(v), "last": v[-1]}
+            for name, v in _REGISTRY.items() if v
+        }
 
 
-def clear(name: str = None) -> None:
-    if name is None:
-        _REGISTRY.clear()
-    else:
+def snapshot() -> Dict[str, object]:
+    """Full registry state for exporters (``apex_tpu.obs.export``):
+    raw-series summaries plus every typed instrument's current value
+    (histograms include cumulative buckets and p50/p90/p99). Inf bucket
+    bounds are ``None`` so the dict round-trips through strict JSON."""
+    with _LOCK:
+        out = {"series": summary(), "counters": [], "gauges": [],
+               "histograms": []}
+        for inst in instruments():
+            entry = {"name": inst.name, "labels": dict(inst.labels)}
+            if isinstance(inst, Counter):
+                entry["value"] = inst.value
+                out["counters"].append(entry)
+            elif isinstance(inst, Gauge):
+                entry["value"] = inst.value
+                out["gauges"].append(entry)
+            elif isinstance(inst, Histogram):
+                entry.update(count=inst.count, sum=inst.sum,
+                             **inst.percentiles())
+                if inst.count:
+                    entry["min"] = inst._min
+                    entry["max"] = inst._max
+                entry["buckets"] = [
+                    [None if math.isinf(le) else le, cum]
+                    for le, cum in inst.buckets()]
+                out["histograms"].append(entry)
+        return out
+
+
+def clear(name: Optional[str] = None) -> None:
+    """Reset the registry. ``clear()`` drops every raw series AND every
+    typed instrument (full process reset — what tests want between
+    cases); ``clear(name)`` drops just that series and any instruments
+    registered under that name (all label sets)."""
+    with _LOCK:
+        if name is None:
+            _REGISTRY.clear()
+            _INSTRUMENTS.clear()
+            return
         _REGISTRY.pop(name, None)
+        for key in [k for k in _INSTRUMENTS if k[0] == name]:
+            del _INSTRUMENTS[key]
 
 
 class StepTimer:
     """Wall-clock step meter with device-sync discipline (the examples'
-    ``torch.cuda.synchronize()``-before-timing analog): ``observe`` blocks on
-    the step's outputs so the recorded time covers real device work."""
+    ``torch.cuda.synchronize()``-before-timing analog): ``observe`` blocks
+    on the step's outputs so the recorded time covers real device work.
+
+    Each observation lands exactly once in each store: the raw ``record``
+    series under ``name`` (ordered per-step values) and a log-bucketed
+    :class:`Histogram` under the same name (percentiles). The old
+    ``AverageMeter`` member double-wrote the same value; mean/last now
+    come from ``summary()`` or ``hist``."""
 
     def __init__(self, name: str = "step_time_ms"):
         self.name = name
-        self.meter = AverageMeter(name)
+        histogram(name)                  # register up front
         self._t0 = None
+
+    @property
+    def hist(self) -> Histogram:
+        """The timer's histogram, re-interned per access so a
+        ``metrics.clear()`` between observations cannot orphan it (the
+        timer would otherwise keep feeding an instrument no snapshot
+        sees)."""
+        return histogram(self.name)
 
     def start(self):
         self._t0 = time.perf_counter()
@@ -117,7 +463,7 @@ class StepTimer:
         if outputs is not None:
             jax.block_until_ready(outputs)
         dt_ms = (time.perf_counter() - self._t0) * 1e3
-        self.meter.update(dt_ms)
+        self.hist.observe(dt_ms)
         _append(self.name, dt_ms)
         self._t0 = None
         return dt_ms

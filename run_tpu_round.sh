@@ -214,9 +214,15 @@ fi
 # gpt2s_prefix_cached_admit + paged_attention_gpt2s_decode cases)
 if bench_done && [ ! -f "DECODE_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] decode-throughput bench (GPT-2 small KV cache)..."
-  timeout 3600 python tpu_decode_bench.py \
+  # APEX_TPU_METRICS_OUT: the bench dumps the full instrument registry
+  # (serving latency histograms, pool gauges — docs/observability.md) as
+  # a round artifact next to the headline JSON
+  APEX_TPU_METRICS_OUT="METRICS_${TAG}.json" timeout 3600 \
+    python tpu_decode_bench.py \
     > "DECODE_${TAG}.json.tmp" 2> "decode_${TAG}.stderr.log" \
     && mv "DECODE_${TAG}.json.tmp" "DECODE_${TAG}.json" || true
   tail -2 "decode_${TAG}.stderr.log"
+  [ -f "METRICS_${TAG}.json" ] && \
+    echo "[$(date +%H:%M:%S)] metrics snapshot banked: METRICS_${TAG}.json"
 fi
 echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"

@@ -47,20 +47,50 @@ def test_bench_sharded_over_8_cpu_devices():
     assert rec["value"] > 0
 
 
-def test_decode_bench_smoke_emits_json():
-    """tpu_decode_bench.py in smoke mode prints one parseable JSON record
-    with a nonzero steady-state decode throughput."""
+def test_decode_bench_smoke_emits_json(tmp_path):
+    """tpu_decode_bench.py in smoke mode prints three parseable JSON
+    records (lock-step, paged, prefix-cached), the paged record carries
+    the TTFT/decode-step percentile fields (ISSUE 4), and the metrics
+    snapshot artifact lands where APEX_TPU_METRICS_OUT points."""
     env = dict(os.environ)
     env["APEX_TPU_DECODE_SMOKE"] = "1"
+    snap_path = tmp_path / "metrics_snapshot.json"
+    env["APEX_TPU_METRICS_OUT"] = str(snap_path)
     r = subprocess.run([sys.executable,
                         os.path.join(REPO, "tpu_decode_bench.py")],
                        capture_output=True, text=True, timeout=600, env=env,
                        cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
-    rec = json.loads(r.stdout.strip().splitlines()[-1])
-    assert rec["metric"] == "gpt2_decode_tokens_per_sec_per_chip"
+    recs = {}
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            rec = json.loads(line)
+            recs[rec["metric"]] = rec
+
+    rec = recs["gpt2_decode_tokens_per_sec_per_chip"]
     assert rec["value"] > 0
     assert rec["unit"] == "tokens/s/chip"
     # speedup may round toward 0 under extreme CPU scheduler noise —
     # assert presence/sanity, not a ratio
     assert rec["int8_tokens_per_sec"] > 0 and rec["int8_speedup"] >= 0
+
+    paged = recs["gpt2_paged_decode_tokens_per_sec_per_chip"]
+    assert paged["gpt2_paged_decode_ttft_ms_p50"] > 0
+    assert (paged["gpt2_paged_decode_ttft_ms_p95"]
+            >= paged["gpt2_paged_decode_ttft_ms_p50"])
+    assert paged["decode_step_ms_p50"] > 0
+    assert paged["decode_step_ms_p95"] >= paged["decode_step_ms_p50"]
+    assert paged["queue_wait_ms_p50"] >= 0
+    assert paged["tpot_ms_p50"] > 0
+
+    pc = recs["gpt2_prefix_cached_decode_tokens_per_sec_per_chip"]
+    assert pc["ttft_ms_p50"] > 0 and pc["decode_step_ms_p50"] > 0
+
+    # the run_tpu_round.sh metrics artifact: a strict-JSON registry
+    # snapshot holding the serving histograms
+    with open(snap_path) as f:
+        snap = json.load(f)
+    hist_names = {h["name"] for h in snap["histograms"]}
+    assert {"serving.ttft_ms", "serving.decode_step_ms",
+            "serving.queue_wait_ms"} <= hist_names
+    assert snap["source"] == "tpu_decode_bench"

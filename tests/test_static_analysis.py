@@ -480,6 +480,161 @@ def test_cond_operand_is_not_marked_traced(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# host-sync exemption: jax.debug.callback / metrics.record (ISSUE 4)
+# --------------------------------------------------------------------------
+# The metrics channel (``metrics.record`` -> ``jax.debug.callback``) is
+# non-blocking: the payload callable runs on the HOST with delivered
+# values after the step executes. The good/bad pairs below prove the
+# exemption covers exactly the callback's callable argument — the same
+# host ops flagged everywhere else in jit-reachable code stay flagged.
+
+_CB_GOOD = """\
+    import jax
+    import numpy as np
+
+    def _emit(v):
+        return float(np.asarray(v).sum())
+
+    @jax.jit
+    def step(x):
+        jax.debug.callback(_emit, x)
+        return x + 1
+"""
+
+
+def test_debug_callback_payload_is_exempt(tmp_path):
+    """A module-level callback full of host ops, reachable ONLY through
+    jax.debug.callback, is clean — instrumented jit code stays
+    lint-clean."""
+    findings, _ = _run_on(tmp_path, _CB_GOOD)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+def test_debug_callback_inline_lambda_is_exempt(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            jax.debug.callback(lambda v: np.asarray(v).sum(), x)
+            return x + 1
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+def test_debug_callback_exemption_is_narrow_direct_call(tmp_path):
+    """The SAME callback also called directly from the jitted body is
+    genuinely jit-reachable — still flagged."""
+    src = _CB_GOOD.replace("return x + 1", "_emit(x)\n        return x + 1")
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+    assert any("_emit" in f.message for f in findings)
+
+
+def test_debug_callback_exemption_is_narrow_operand(tmp_path):
+    """Only the CALLABLE argument is exempt: a host materialization in
+    the callback's traced-operand position is a real trace-time hazard
+    and stays flagged."""
+    src = """\
+        import jax
+        import numpy as np
+
+        def _emit(v):
+            return v
+
+        @jax.jit
+        def step(x):
+            jax.debug.callback(_emit, np.asarray(x))
+            return x + 1
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_debug_callback_partial_callable_is_exempt(tmp_path):
+    """functools.partial(fn, static) as the callback is the prescribed
+    record() pattern — the partial's CALLABLE is exempt."""
+    src = """\
+        import functools
+        import jax
+        import numpy as np
+
+        def _emit(tag, v):
+            return float(np.asarray(v).sum())
+
+        @jax.jit
+        def step(x):
+            jax.debug.callback(functools.partial(_emit, "loss"), x)
+            return x + 1
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+def test_debug_callback_partial_operand_stays_flagged(tmp_path):
+    """partial OPERANDS evaluate at trace time — `.item()` there is a
+    genuine sync and must not ride the exemption."""
+    src = """\
+        import functools
+        import jax
+
+        def _emit(tag, v):
+            return v
+
+        @jax.jit
+        def step(x):
+            jax.debug.callback(functools.partial(_emit, x.item()), x)
+            return x + 1
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+    assert any("item" in f.message for f in findings)
+
+
+def test_debug_callback_factory_call_is_not_exempt(tmp_path):
+    """A FACTORY call in the callable position runs at trace time —
+    nothing about it is exempt, including the call itself: its callee
+    stays jit-reachable and its internals stay scrutinized."""
+    src = """\
+        import jax
+
+        def make_cb(x):
+            x.item()
+            return print
+
+        @jax.jit
+        def step(x):
+            jax.debug.callback(make_cb(x), x)
+            return x + 1
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+    assert any("make_cb" in f.message for f in findings)
+
+
+def test_metrics_record_in_scan_body_is_clean(tmp_path):
+    """The prescribed instrumentation pattern — metrics.record on a
+    traced scalar inside a scan body — lints clean end to end."""
+    src = """\
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from apex_tpu.utils import metrics
+
+        @jax.jit
+        def run(x):
+            def body(c, t):
+                metrics.record("loss", c)
+                return c + t, c
+            return lax.scan(body, x, jnp.arange(4.0))[0]
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+# --------------------------------------------------------------------------
 # suppression-parsing / baseline-write hardening (code-review repros)
 # --------------------------------------------------------------------------
 

@@ -163,6 +163,15 @@ def main():
         "lockstep_steps": lockstep_steps,
         "step_savings": round(1.0 - stats["decode_steps"]
                               / max(lockstep_steps, 1), 3),
+        # per-request latency percentiles from the engine's span tracer
+        # (docs/observability.md): TTFT = enqueue -> first token,
+        # decode-step = per-step wall time at the sync boundary
+        "gpt2_paged_decode_ttft_ms_p50": round(stats["ttft_ms_p50"], 3),
+        "gpt2_paged_decode_ttft_ms_p95": round(stats["ttft_ms_p95"], 3),
+        "decode_step_ms_p50": round(stats["decode_step_ms_p50"], 3),
+        "decode_step_ms_p95": round(stats["decode_step_ms_p95"], 3),
+        "queue_wait_ms_p50": round(stats["queue_wait_ms_p50"], 3),
+        "tpot_ms_p50": round(stats["tpot_ms_p50"], 3),
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(prec), flush=True)
@@ -231,6 +240,16 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(pc_rec), flush=True)
+
+    # --- metrics snapshot artifact (docs/observability.md) ------------------
+    # run_tpu_round.sh sets APEX_TPU_METRICS_OUT so every round banks the
+    # full instrument registry (serving histograms + pool gauges) next to
+    # the bench JSON — the postmortem counterpart of the headline numbers
+    out_path = os.environ.get("APEX_TPU_METRICS_OUT")
+    if out_path:
+        from apex_tpu.obs import export
+        export.write_snapshot(out_path, extra={"source": "tpu_decode_bench"})
+        print(f"[metrics] snapshot written to {out_path}", flush=True)
 
 
 if __name__ == "__main__":
