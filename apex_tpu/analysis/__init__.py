@@ -17,11 +17,28 @@ convention and reviewer memory alone:
 * AOT case-list drift between ``tpu_aot.py`` and the CI tier's
   ``CASE_NAMES``.
 
-Self-contained: stdlib ``ast`` only, no third-party lint dependencies.
+Two tiers share the CLI, the suppression pragmas and the baseline:
+
+* the **AST tier** (this package's ``rules``/``walker``/``project``)
+  reads source — whole-repo INTERPROCEDURAL since ISSUE 5: imports are
+  linked into one call graph, so ``host-sync-in-jit`` sees through
+  helpers imported from ``utils/`` and ``jit-donated-reuse`` tracks
+  wrappers imported from other modules;
+* the **IR tier** (``apex_tpu.analysis.ir``, ``--ir``) traces the
+  registered entry points (``tpu_aot.kernel_cases()`` + the serving
+  engine programs) with ``jax.make_jaxpr`` on CPU and lints the STAGED
+  programs — dtype promotion drift, dead scan state, ineffective
+  donation, compile-key cardinality — mapping findings back to source
+  via ``eqn.source_info``.
+
+The AST tier is stdlib-``ast`` only (no third-party lint deps, no jax
+import); the IR tier needs jax but no TPU.
 
 Usage::
 
     python -m apex_tpu.analysis [paths ...] [--format text|json]
+    python -m apex_tpu.analysis --ir [--ir-case NAME]
+    python -m apex_tpu.analysis --diff <base-rev>
     apex-tpu-lint --list-rules
 
 Inline suppression (same line, the statement's first line, or a
@@ -35,7 +52,8 @@ findings *above* the baseline fail the run.
 """
 
 from apex_tpu.analysis.baseline import Baseline
-from apex_tpu.analysis.cli import analyze_paths, main
+from apex_tpu.analysis.cli import analyze_paths, analyze_sources, main
+from apex_tpu.analysis.project import ProjectIndex
 from apex_tpu.analysis.walker import Finding, ModuleIndex
 from apex_tpu.analysis.rules import RULES, Rule
 
@@ -43,8 +61,10 @@ __all__ = [
     "Baseline",
     "Finding",
     "ModuleIndex",
+    "ProjectIndex",
     "RULES",
     "Rule",
     "analyze_paths",
+    "analyze_sources",
     "main",
 ]

@@ -25,6 +25,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 from apex_tpu.analysis import report
 from apex_tpu.analysis.baseline import Baseline
+from apex_tpu.analysis.project import ProjectIndex
 from apex_tpu.analysis.rules import RULES, module_rules, project_rules
 from apex_tpu.analysis.suppressions import Suppressions
 from apex_tpu.analysis.walker import Finding, ModuleIndex
@@ -69,6 +70,47 @@ def _rel(root: Path, path: Path) -> str:
         return path.as_posix()
 
 
+def analyze_sources(sources: "dict[str, str]", *,
+                    select: Optional[Iterable[str]] = None,
+                    interprocedural: bool = True,
+                    ) -> Tuple[List[Finding], int]:
+    """Run the MODULE rules over an in-memory ``{rel path: source}``
+    map; returns (surviving findings, #suppressed). This is the engine
+    under both :func:`analyze_paths` (sources read from disk) and
+    ``--diff`` (sources read from a git base rev).
+
+    Phase 1 parses every module; phase 2 (``interprocedural``) links
+    them into one call graph (``project.ProjectIndex``) so jit
+    reachability and imported jit wrappers cross file boundaries; then
+    each module's rules run as before.
+    """
+    chosen = set(select) if select is not None else set(RULES)
+    findings: List[Finding] = []
+    modules: "dict[str, ModuleIndex]" = {}
+    for rel in sorted(sources):
+        try:
+            modules[rel] = ModuleIndex(rel, sources[rel])
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="parse-error", severity="error", path=rel,
+                line=e.lineno or 1, col=(e.offset or 0) + 1,
+                message=f"syntax error: {e.msg}"))
+    if interprocedural:
+        ProjectIndex(modules).link()
+    suppressed = 0
+    for rel, mi in modules.items():
+        supp = Suppressions(mi.source)
+        for r in module_rules():
+            if r.name not in chosen:
+                continue
+            for f in r.check(mi):
+                if supp.covers(f):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    return findings, suppressed
+
+
 def analyze_paths(paths: Sequence[str] = (), *,
                   root: Optional[object] = None,
                   select: Optional[Iterable[str]] = None,
@@ -87,33 +129,17 @@ def analyze_paths(paths: Sequence[str] = (), *,
         raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
 
     findings: List[Finding] = []
-    suppressed = 0
+    sources: "dict[str, str]" = {}
     for path in discover(root, paths):
         rel = _rel(root, path)
         try:
-            source = path.read_text()
+            sources[rel] = path.read_text()
         except OSError as e:
             findings.append(Finding(
                 rule="parse-error", severity="error", path=rel, line=1,
                 col=1, message=f"unreadable: {e}"))
-            continue
-        try:
-            mi = ModuleIndex(rel, source)
-        except SyntaxError as e:
-            findings.append(Finding(
-                rule="parse-error", severity="error", path=rel,
-                line=e.lineno or 1, col=(e.offset or 0) + 1,
-                message=f"syntax error: {e.msg}"))
-            continue
-        supp = Suppressions(source)
-        for r in module_rules():
-            if r.name not in chosen:
-                continue
-            for f in r.check(mi):
-                if supp.covers(f):
-                    suppressed += 1
-                else:
-                    findings.append(f)
+    module_findings, suppressed = analyze_sources(sources, select=chosen)
+    findings.extend(module_findings)
     if with_project_rules:
         for r in project_rules():
             if r.name in chosen:
@@ -124,7 +150,8 @@ def analyze_paths(paths: Sequence[str] = (), *,
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="apex-tpu-lint",
-        description="AST static analysis for jit/Pallas/serving hazards")
+        description="AST + jaxpr-IR static analysis for jit/Pallas/"
+                    "serving hazards")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: apex_tpu/, "
                         "tpu_*.py, bench*.py under --root)")
@@ -141,18 +168,133 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings the baseline absorbs")
     p.add_argument("--select", default=None,
-                   help="comma-separated rule names to run (default all)")
+                   help="comma-separated rule names to run (default all; "
+                        "validated against the active tier)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--ir", action="store_true",
+                   help="run the jaxpr IR tier instead of the AST tier: "
+                        "trace every registered entry point on CPU "
+                        "(no TPU needed) and lint the staged programs")
+    p.add_argument("--ir-case", default=None, metavar="NAME",
+                   help="IR tier for ONE registered case (implies --ir)")
+    p.add_argument("--diff", default=None, metavar="BASE_REV",
+                   help="fail only on findings introduced relative to "
+                        "this git rev (AST tier; module rules) — the "
+                        "base rev's findings act as the baseline")
     return p
+
+
+def _glob_regexes() -> "list":
+    """DEFAULT_GLOBS translated to regexes with Path.glob semantics
+    (``*`` does not cross ``/``; ``**/`` matches zero or more dirs) —
+    fnmatch gets both wrong, and a hand-rolled per-shape matcher would
+    silently drop files if the glob list ever grows a new shape."""
+    import re
+
+    out = []
+    for g in DEFAULT_GLOBS:
+        esc = re.escape(g)
+        esc = esc.replace(r"\*\*/", "(?:.*/)?").replace(r"\*\*", ".*")
+        esc = esc.replace(r"\*", "[^/]*").replace(r"\?", "[^/]")
+        out.append(re.compile("^" + esc + "$"))
+    return out
+
+
+def _base_rev_sources(root: Path, rev: str) -> "dict[str, str]":
+    """The default lint surface as it existed at ``rev`` (one
+    ``git ls-tree`` + one ``git cat-file --batch``); raises ValueError
+    on git errors (exit code 2)."""
+    import subprocess
+
+    def git(*args: str) -> str:
+        proc = subprocess.run(["git", "-C", str(root), *args],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args[:2])} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    regexes = _glob_regexes()
+
+    def on_surface(rel: str) -> bool:
+        return any(rx.match(rel) for rx in regexes)
+
+    listing = git("ls-tree", "-r", "--name-only", rev)
+    wanted = [rel for rel in listing.splitlines()
+              if on_surface(rel)
+              and not any(p in _SKIP_PARTS for p in rel.split("/"))]
+    if not wanted:
+        return {}
+    # ONE `cat-file --batch` round trip for all ~130 files (a `git show`
+    # per file would pay fork+exec each); bytes mode — the size header
+    # counts bytes, not str characters
+    proc = subprocess.run(
+        ["git", "-C", str(root), "cat-file", "--batch"],
+        input="\n".join(f"{rev}:{rel}" for rel in wanted).encode(),
+        capture_output=True)
+    if proc.returncode != 0:
+        raise ValueError(
+            f"git cat-file failed: {proc.stderr.decode().strip()}")
+    sources: "dict[str, str]" = {}
+    buf, pos = proc.stdout, 0
+    for rel in wanted:
+        nl = buf.index(b"\n", pos)
+        header = buf[pos:nl].decode()
+        pos = nl + 1
+        if header.endswith(("missing", "ambiguous")):
+            continue                    # path absent at rev: new file
+        size = int(header.rsplit(" ", 1)[1])
+        sources[rel] = buf[pos:pos + size].decode(errors="replace")
+        pos += size + 1                 # trailing newline after content
+    return sources
+
+
+def _run_diff(args, root: Path, select) -> int:
+    """Diff-aware mode: current module-rule findings, minus whatever the
+    base rev already had (counted with the same line-number-free
+    ``path::rule::scope`` keys the baseline uses). Project rules are
+    skipped on both sides — they need an on-disk tree; the absolute
+    gate still runs them."""
+    from collections import Counter
+
+    try:
+        base_sources = _base_rev_sources(root, args.diff)
+    except ValueError as e:
+        print(f"error: --diff {args.diff}: {e}", file=sys.stderr)
+        return 2
+    base_findings, _ = analyze_sources(base_sources, select=select)
+    base = Baseline(Counter(f.baseline_key() for f in base_findings))
+
+    findings, suppressed = analyze_paths(
+        (), root=root, select=select, with_project_rules=False)
+    new, absorbed = base.split(findings)
+    if args.format == "json":
+        print(report.render_json(new, absorbed, suppressed))
+    else:
+        print(report.render_text(new, absorbed, suppressed,
+                                 show_baselined=args.show_baselined))
+        if new:
+            print(f"tpu-lint: the findings above are NEW relative to "
+                  f"{args.diff} ({len(absorbed)} pre-existing "
+                  "absorbed)")
+    return 1 if new else 0
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
+    if args.ir_case:
+        args.ir = True
     if args.list_rules:
-        width = max(len(n) for n in RULES)
+        from apex_tpu.analysis.ir.ir_rules import IR_RULES
+
+        width = max(len(n) for n in list(RULES) + list(IR_RULES))
         for name, r in sorted(RULES.items()):
             kind = "project" if r.project else "module"
-            print(f"{name:<{width}}  {r.severity:<7} {kind:<7} "
+            print(f"{name:<{width}}  {r.severity:<7} ast:{kind:<7} "
+                  f"{r.summary}")
+        for name, r in sorted(IR_RULES.items()):
+            print(f"{name:<{width}}  {r.severity:<7} ir:jaxpr    "
                   f"{r.summary}")
         return 0
 
@@ -162,9 +304,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if args.diff is not None:
+        if args.ir:
+            print("error: --diff is AST-tier only (the base rev's "
+                  "programs cannot be traced from git history); run "
+                  "--ir separately", file=sys.stderr)
+            return 2
+        if args.write_baseline or args.baseline:
+            print("error: --diff uses the base rev's findings AS the "
+                  "baseline; it neither reads nor writes the baseline "
+                  "file (drop --baseline/--write-baseline)",
+                  file=sys.stderr)
+            return 2
+        if args.paths:
+            # the base side always lints the default surface; scoping
+            # only the current side would misreport an off-surface
+            # file's pre-existing findings as new
+            print("error: --diff compares the default surface; drop "
+                  "the explicit paths", file=sys.stderr)
+            return 2
+        try:
+            if select:
+                unknown = set(select) - set(RULES)
+                if unknown:
+                    raise ValueError("unknown rule(s): "
+                                     + ", ".join(sorted(unknown)))
+            return _run_diff(args, root, select)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     try:
-        findings, suppressed = analyze_paths(
-            args.paths, root=root, select=select)
+        if args.ir:
+            if args.paths:
+                print("error: --ir lints registered entry points, not "
+                      "paths (use --ir-case NAME to narrow)",
+                      file=sys.stderr)
+                return 2
+            from apex_tpu.analysis.ir import analyze_ir
+
+            findings, suppressed, _ = analyze_ir(
+                root, select=select, case=args.ir_case)
+        else:
+            findings, suppressed = analyze_paths(
+                args.paths, root=root, select=select)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -177,18 +359,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "partial view and erase other rules' baselined findings; "
                   "run it unfiltered", file=sys.stderr)
             return 2
-        keep = {}
-        if args.paths:
-            # scoped run: replace entries for the scanned files only,
-            # keep the rest of the baseline untouched
-            scanned = {_rel(root, p) for p in discover(root, args.paths)}
-            try:
-                existing = Baseline.load(baseline_path)
-            except ValueError as e:
-                print(f"error: {e}", file=sys.stderr)
-                return 2
+        try:
+            existing = Baseline.load(baseline_path)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+        def rule_of(key: str) -> str:
+            parts = key.split("::")
+            return parts[1] if len(parts) > 2 else ""
+
+        # the two tiers share one baseline file but never clobber each
+        # other: an AST write keeps ir-* entries and vice versa
+        if args.ir:
             keep = {k: v for k, v in existing.counts.items()
-                    if k.split("::", 1)[0] not in scanned}
+                    if not rule_of(k).startswith("ir-")}
+            if args.ir_case:
+                # case-scoped run: replace only THIS case's entries (IR
+                # scopes are case names — the last key component)
+                keep.update(
+                    {k: v for k, v in existing.counts.items()
+                     if rule_of(k).startswith("ir-")
+                     and k.split("::")[-1] != args.ir_case})
+        else:
+            keep = {k: v for k, v in existing.counts.items()
+                    if rule_of(k).startswith("ir-")}
+            if args.paths:
+                # scoped run: replace entries for the scanned files
+                # only, keep the rest of the baseline untouched
+                scanned = {_rel(root, p)
+                           for p in discover(root, args.paths)}
+                keep.update(
+                    {k: v for k, v in existing.counts.items()
+                     if not rule_of(k).startswith("ir-")
+                     and k.split("::", 1)[0] not in scanned})
         Baseline.write(baseline_path, findings, keep=keep)
         print(f"tpu-lint: wrote {len(findings)} finding(s) to "
               f"{baseline_path}"

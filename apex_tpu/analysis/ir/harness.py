@@ -1,0 +1,326 @@
+"""Entry-point discovery + jaxpr construction for the tpu-lint IR tier.
+
+The AST tier reads what the code *says*; this tier reads what JAX
+actually *stages*. :func:`analysis_cases` is the declarative registry of
+traceable entry points — every ``tpu_aot.kernel_cases()`` program
+(kernels, fused optimizers, the lock-step decode programs, the
+prefix-cached admission) plus serving programs the AOT sweep does not
+carry: the engine's jitted multi-step decode chunk (the
+``generate(paged=True)`` hot loop) and the bucketed admission program
+with its compile-count contract. :func:`build_case_ir` turns one case
+into a :class:`CaseIR` via ``jax.make_jaxpr`` over
+``jax.ShapeDtypeStruct`` arguments — pure tracing, no TPU, no compile;
+it runs in tier-1 on CPU in seconds.
+
+Tracing forces ``APEX_TPU_FORCE_MOSAIC=1`` so ``ops/_dispatch`` stages
+the real Pallas programs (the TPU path), not the CPU interpret fallback
+— the jaxpr the rules see is the jaxpr the chip would get.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import sys
+from pathlib import Path
+from typing import Callable, List, Optional, Sequence, Tuple
+
+#: byte size guards shared with ir_rules (import cycle-free home)
+MIB = 1024 * 1024
+
+
+@dataclasses.dataclass
+class CaseProgram:
+    """One traceable program: ``fn(*args)`` with abstract args."""
+
+    fn: Callable
+    args: tuple
+    donate: Tuple[int, ...] = ()
+    #: additional argument tuples that MUST trace to at most
+    #: ``max_traces`` distinct jaxprs together with ``args`` — the
+    #: compile-key-cardinality contract (bucketed shapes collapse)
+    variants: Sequence[tuple] = ()
+    max_traces: int = 1
+    x64: bool = False
+
+
+@dataclasses.dataclass
+class AnalysisCase:
+    name: str
+    domain: str                      # serving | models | ops | optimizers
+    build: Callable[[], CaseProgram]
+
+
+@dataclasses.dataclass
+class CaseIR:
+    """A traced case: the jaxpr bundle the IR rules consume."""
+
+    case: AnalysisCase
+    prog: CaseProgram
+    closed: object                   # jax ClosedJaxpr
+    variant_closed: List[object]
+    donated_avals: List[object]      # flattened avals of donated args
+    origin: Tuple[str, int]          # (abs file, line) of the case fn
+
+    @property
+    def name(self) -> str:
+        return self.case.name
+
+    @property
+    def domain(self) -> str:
+        return self.case.domain
+
+
+def _origin_of(fn) -> Tuple[str, int]:
+    """Best-effort def site of the case's program (partials and jit
+    wrappers unwrapped) — the anchor for findings that have no single
+    equation (donation, consts, cardinality)."""
+    seen = 0
+    while seen < 8:
+        seen += 1
+        if isinstance(fn, functools.partial):
+            fn = fn.func
+            continue
+        inner = getattr(fn, "__wrapped__", None)
+        if inner is not None and inner is not fn:
+            fn = inner
+            continue
+        break
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        return (code.co_filename, code.co_firstlineno)
+    return (__file__, 1)
+
+
+# --------------------------------------------------------------------------
+# case registry
+# --------------------------------------------------------------------------
+
+#: kernel_cases() name -> domain (prefix match, first hit wins); the AOT
+#: registry spans ops, optimizers, models and serving already — the IR
+#: tier reuses it verbatim rather than maintaining a parallel list
+_DOMAIN_PREFIXES = (
+    ("optim_", "optimizers"),
+    ("gpt2_small_decode", "models"),
+    ("gpt2s_prefix_cached", "serving"),
+    ("paged_attention", "serving"),
+)
+
+
+def _domain_for(name: str) -> str:
+    for prefix, domain in _DOMAIN_PREFIXES:
+        if name.startswith(prefix):
+            return domain
+    return "ops"
+
+
+def _aot_cases(root: Path) -> List[AnalysisCase]:
+    if str(root) not in sys.path:
+        sys.path.insert(0, str(root))
+    # tpu_aot flips the PROCESS into Mosaic dispatch at import (its own
+    # runs are all-AOT); an in-process lint consumer (the tier-1 suite)
+    # must get its env back — only _force_mosaic's tracing window may
+    # keep the flag
+    with _force_mosaic():
+        import tpu_aot
+
+        cases = list(tpu_aot.kernel_cases())
+
+    out: List[AnalysisCase] = []
+    for case in cases:
+        name, fn, args = case[0], case[1], tuple(case[2])
+        donate = tuple(case[3]) if len(case) > 3 else ()
+
+        def build(fn=fn, args=args, donate=donate) -> CaseProgram:
+            return CaseProgram(fn=fn, args=args, donate=donate)
+
+        out.append(AnalysisCase(name=name, domain=_domain_for(name),
+                                build=build))
+    return out
+
+
+def _build_engine_chunk() -> CaseProgram:
+    """The serving hot loop ``generate(paged=True)`` actually runs: the
+    engine's jitted ``sync_every``-step ``lax.scan`` decode chunk, at a
+    small GPT-2-small pool (tracing cost, not fidelity, scales with the
+    pool)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, sync_every=4)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    args = (cache_abs, dvars,
+            jax.ShapeDtypeStruct((4,), i32),        # tok
+            jax.ShapeDtypeStruct((4,), jnp.bool_),  # done
+            jax.ShapeDtypeStruct((4,), i32),        # n_left
+            jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
+            jax.ShapeDtypeStruct((4,), i32))        # samp_i
+    return CaseProgram(fn=engine._step_fn(), args=args)
+
+
+def _build_admit_bucketed() -> CaseProgram:
+    """The engine's prompt-admission program, traced at two prompt
+    lengths that land in the SAME bucket under the ENGINE'S OWN
+    ``scheduler.prompt_bucket`` (the function ``run()`` pads with before
+    its jit boundary — shared, not mirrored, so the contract is binding:
+    if admission's bucketing ever stops collapsing raw lengths, the two
+    variants stage distinct programs and ir-compile-key-cardinality
+    fires)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import (PagedDecodeEngine,
+                                            prompt_bucket)
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+
+    def args_for(s0: int) -> tuple:
+        bucket = prompt_bucket(s0, engine.page_size,
+                               cfg.max_position_embeddings)
+        return (cache_abs, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32))   # req_key
+
+    bucket = prompt_bucket(90, engine.page_size,
+                           cfg.max_position_embeddings)
+    return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(90),
+                       variants=[args_for(93)], max_traces=1)
+
+
+def _build_optimizer_update(kind: str) -> CaseProgram:
+    """sgd/novograd fused-update steps over the flat-buffer layout
+    (adam/lamb already arrive via ``kernel_cases``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.ops import flat_buffer, optim_kernels
+
+    f32 = jnp.float32
+    tree = {"emb": (8192, 64), "w1": (768, 768), "b": (768,)}
+    spec = flat_buffer.build_spec(
+        {k: jax.ShapeDtypeStruct(s, f32) for k, s in tree.items()})
+    seg = np.asarray(spec.segment_rows())
+    buf = jax.ShapeDtypeStruct((spec.total_rows, flat_buffer.LANE), f32)
+    if kind == "sgd":
+        fn = functools.partial(optim_kernels.sgd_update, lr=1e-3,
+                               momentum=0.9, weight_decay=1e-4)
+        return CaseProgram(fn=fn, args=(buf, buf, buf), donate=(1, 2))
+    assert kind == "novograd"
+
+    def nv(g, p, m, v):
+        return optim_kernels.novograd_update(
+            g, p, m, v, jnp.asarray(seg), spec.num_tensors, beta1=0.95,
+            beta2=0.98, eps=1e-8, weight_decay=1e-3, lr=1e-3, step=1)
+
+    vbuf = jax.ShapeDtypeStruct((spec.num_tensors,), f32)
+    return CaseProgram(fn=nv, args=(buf, buf, buf, vbuf),
+                       donate=(1, 2, 3))
+
+
+def analysis_cases(root) -> List[AnalysisCase]:
+    """The IR tier's registry: every AOT kernel case + the serving-engine
+    programs + the remaining fused-optimizer steps. Spans serving,
+    models, ops and optimizers (asserted by the tier-1 suite)."""
+    root = Path(root).resolve()
+    cases = _aot_cases(root)
+    cases.append(AnalysisCase("gpt2s_engine_decode_chunk", "serving",
+                              _build_engine_chunk))
+    cases.append(AnalysisCase("gpt2s_engine_admit_bucketed", "serving",
+                              _build_admit_bucketed))
+    cases.append(AnalysisCase(
+        "optim_sgd_momentum_buffer", "optimizers",
+        lambda: _build_optimizer_update("sgd")))
+    cases.append(AnalysisCase(
+        "optim_novograd_buffer", "optimizers",
+        lambda: _build_optimizer_update("novograd")))
+    return cases
+
+
+# --------------------------------------------------------------------------
+# tracing
+# --------------------------------------------------------------------------
+
+class _force_mosaic:
+    """Stage the TPU kernel path during tracing regardless of the host
+    backend (see module docstring); restores the env on exit.
+
+    Exit also clears jax's trace caches: tracing through module-level
+    jit wrappers bakes ``interpret=False`` pallas params into their
+    cached jaxprs, and an in-process consumer (the tier-1 suite)
+    EXECUTING the same op at the same shapes afterwards would reuse the
+    poisoned trace and fail on CPU. Dropping the caches costs a
+    re-trace, never correctness."""
+
+    _KEYS = ("APEX_TPU_FORCE_MOSAIC", "APEX_TPU_FORCE_INTERPRET")
+
+    def __enter__(self):
+        self._old = {k: os.environ.get(k) for k in self._KEYS}
+        os.environ["APEX_TPU_FORCE_MOSAIC"] = "1"
+        os.environ.pop("APEX_TPU_FORCE_INTERPRET", None)
+
+    def __exit__(self, *exc):
+        for k, v in self._old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        import jax
+
+        jax.clear_caches()
+        return False
+
+
+def _trace(prog: CaseProgram, args: tuple):
+    import contextlib
+
+    import jax
+
+    ctx = jax.experimental.enable_x64() if prog.x64 \
+        else contextlib.nullcontext()
+    with _force_mosaic(), ctx:
+        return jax.make_jaxpr(prog.fn)(*args)
+
+
+def build_case_ir(case: AnalysisCase) -> CaseIR:
+    """Trace one case (plus its cardinality variants) into a CaseIR."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")   # before jax wakes up
+    import jax
+
+    prog = case.build()
+    closed = _trace(prog, prog.args)
+    variant_closed = [_trace(prog, v) for v in prog.variants]
+    donated = []
+    for i in prog.donate:
+        if 0 <= i < len(prog.args):
+            # leaves are ShapeDtypeStructs/arrays: shape+dtype is all the
+            # aliasing check needs
+            donated.extend(jax.tree.leaves(prog.args[i]))
+    return CaseIR(case=case, prog=prog, closed=closed,
+                  variant_closed=variant_closed, donated_avals=donated,
+                  origin=_origin_of(prog.fn))

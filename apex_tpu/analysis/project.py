@@ -1,0 +1,221 @@
+"""Whole-repo interprocedural linking for tpu-lint.
+
+:class:`ProjectIndex` upgrades the per-module analysis to one graph over
+the entire scanned surface, in the classic two-phase shape:
+
+1. **index** — every file is parsed into a
+   :class:`~apex_tpu.analysis.walker.ModuleIndex` (the caller does this;
+   each module records its import table, its dotted call references, and
+   the jit/scan/pallas callee marks it could not resolve locally);
+2. **link** — imports are resolved to their defining modules
+   (``from apex_tpu.serving import kv_pool`` / ``apex_tpu.utils.metrics``
+   attribute chains / ``__init__`` re-export hops), unresolved jit-entry
+   marks land on their real targets, and jit reachability is recomputed
+   over the GLOBAL call graph and written back into each module.
+
+The payoff is that module rules see through helpers imported from other
+files with no per-rule changes: ``host-sync-in-jit`` flags an
+``np.asarray`` inside a ``utils/`` helper the serving scheduler's jitted
+scan body calls, and ``jit-donated-reuse`` tracks buffers donated to a
+jit wrapper *imported* from another module (the home module's
+``donate_argnums`` travel with the name, via ``extra_wrappers``).
+
+Like the walker, linking is purely syntactic — nothing is imported or
+executed; an unresolvable reference simply contributes no edge.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.analysis.walker import FunctionInfo, ModuleIndex
+
+#: import-following depth bound: re-export chains in this repo are 1-2
+#: hops (``serving/__init__`` -> ``scheduler``); 8 is generous and keeps
+#: accidental cycles (``a`` re-exporting from ``b`` and vice versa) finite
+_MAX_HOPS = 8
+
+
+def module_name_of(rel_path: str) -> Optional[str]:
+    """``apex_tpu/serving/kv_pool.py`` -> ``apex_tpu.serving.kv_pool``;
+    package ``__init__.py`` files name the package itself; repo-root
+    drivers (``tpu_aot.py``) are top-level modules."""
+    if not rel_path.endswith(".py"):
+        return None
+    parts = rel_path[:-3].replace("\\", "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if not parts or not all(p.isidentifier() for p in parts):
+        return None
+    return ".".join(parts)
+
+
+class ProjectIndex:
+    """Cross-module linker over one scan's ModuleIndexes (phase 2)."""
+
+    def __init__(self, modules: Dict[str, ModuleIndex]):
+        #: rel posix path -> module index (phase-1 output)
+        self.modules = modules
+        self.by_module: Dict[str, ModuleIndex] = {}
+        for rel, mi in modules.items():
+            mn = module_name_of(rel)
+            if mn:
+                self.by_module[mn] = mi
+        #: id(mi) -> local name -> absolute dotted target
+        self._abs: Dict[int, Dict[str, str]] = {}
+
+    # ------------------------------------------------------------- phase 2
+
+    def link(self) -> None:
+        """Resolve imports, apply cross-module jit-entry marks, recompute
+        global reachability (written back into each ``mi.reachable``),
+        and share jit wrappers with their importers."""
+        for mi in self.modules.values():
+            self._abs[id(mi)] = self._absolute_imports(mi)
+        self._apply_unresolved_marks()
+        self._propagate_reachability()
+        self._share_wrappers()
+
+    def _absolute_imports(self, mi: ModuleIndex) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        mn = module_name_of(mi.path)
+        pkg_parts: List[str] = []
+        if mn:
+            parts = mn.split(".")
+            # the package CONTEXT relative imports resolve against:
+            # a package's __init__ is the package itself
+            pkg_parts = parts if mi.path.endswith("__init__.py") \
+                else parts[:-1]
+        for ent in mi.imports:
+            if ent.level:
+                if ent.level - 1 > len(pkg_parts):
+                    continue                    # escapes the scanned tree
+                base = pkg_parts[:len(pkg_parts) - (ent.level - 1)]
+                target = ".".join(base + ([ent.module] if ent.module
+                                          else []))
+            else:
+                target = ent.module
+            if ent.attr:
+                target = f"{target}.{ent.attr}" if target else ent.attr
+            if target:
+                out[ent.local] = target
+        return out
+
+    def _resolve_chain(self, mi: ModuleIndex, ref: str, hops: int = 0
+                       ) -> Optional[Tuple[ModuleIndex, str]]:
+        """Follow ``ref`` (a dotted name as written in ``mi``) through
+        import bindings to its defining module: returns ``(module,
+        attr-path within it)`` or None. Re-exports (``__init__`` modules
+        importing a name from the implementation module) are followed up
+        to ``_MAX_HOPS``."""
+        if hops > _MAX_HOPS or not ref:
+            return None
+        parts = ref.split(".")
+        amap = self._abs.get(id(mi), {})
+        if parts[0] in amap:
+            rest = parts[1:]
+            abs_ref = amap[parts[0]] + ("." + ".".join(rest) if rest
+                                        else "")
+        else:
+            abs_ref = ref
+        aparts = abs_ref.split(".")
+        for cut in range(len(aparts) - 1, 0, -1):
+            m2 = self.by_module.get(".".join(aparts[:cut]))
+            if m2 is None:
+                continue
+            attr = ".".join(aparts[cut:])
+            head = aparts[cut]
+            amap2 = self._abs.get(id(m2), {})
+            if head in amap2 and head not in m2.functions:
+                # re-exported: keep following in the binding module
+                return self._resolve_chain(m2, attr, hops + 1)
+            return (m2, attr)
+        return None
+
+    def resolve_function(self, mi: ModuleIndex, ref: str
+                         ) -> Optional[Tuple[ModuleIndex, FunctionInfo]]:
+        chain = self._resolve_chain(mi, ref)
+        if chain is None:
+            return None
+        m2, attr = chain
+        # ``attr`` is a qualname within m2: a top-level function, or an
+        # exact ``Class.method`` path — anything else contributes no edge
+        info = m2.functions.get(attr)
+        return (m2, info) if info is not None else None
+
+    # ----------------------------------------------------- reachability
+
+    def _apply_unresolved_marks(self) -> None:
+        for mi in self.modules.values():
+            for ref, reason in mi.unresolved_marks:
+                hit = self.resolve_function(mi, ref)
+                if hit is None:
+                    continue
+                _, info = hit
+                tagged = f"{reason} (from {mi.path})"
+                if tagged not in info.jit_reasons:
+                    info.jit_reasons.append(tagged)
+
+    def _propagate_reachability(self) -> None:
+        """Global BFS from every jit entry; REPLACES each module's
+        ``reachable`` with the interprocedural result (a superset of the
+        module-local one: local edges are a subset of global edges)."""
+        reach: Dict[Tuple[int, str], List[str]] = {}
+        work: List[Tuple[ModuleIndex, str, List[str]]] = []
+        for mi in self.modules.values():
+            for qn, info in mi.functions.items():
+                if info.jit_reasons:
+                    reach[(id(mi), qn)] = list(info.jit_reasons)
+                    work.append((mi, qn, reach[(id(mi), qn)]))
+        while work:
+            mi, qn, chain = work.pop()
+            nxt: List[Tuple[ModuleIndex, str]] = []
+            for tail in mi._calls.get(qn, ()):
+                for info in mi.by_name.get(tail, ()):
+                    nxt.append((mi, info.qualname))
+            for sub, info in mi.functions.items():
+                if info.parent == qn:
+                    nxt.append((mi, sub))
+            for ref in mi.calls_dotted.get(qn, ()):
+                hit = self.resolve_function(mi, ref)
+                if hit is not None:
+                    nxt.append((hit[0], hit[1].qualname))
+            for m2, qn2 in nxt:
+                if m2.functions[qn2].host_boundary:
+                    continue     # declared never-traced: edge stops here
+                key = (id(m2), qn2)
+                if key not in reach:
+                    hop = qn if m2 is mi else f"{mi.path}::{qn}"
+                    reach[key] = chain + [f"called from {hop}"]
+                    work.append((m2, qn2, reach[key]))
+        by_id = {id(mi): mi for mi in self.modules.values()}
+        fresh: Dict[int, Dict[str, List[str]]] = {id(mi): {}
+                                                  for mi in by_id.values()}
+        for (mid, qn), chain in reach.items():
+            fresh[mid][qn] = chain
+        for mid, mi in by_id.items():
+            mi.reachable = fresh[mid]
+
+    # --------------------------------------------------------- wrappers
+
+    def _share_wrappers(self) -> None:
+        """Give every importer of a jit wrapper (``w = jax.jit(f,
+        donate_argnums=...)`` in another module) the home module's
+        wrapper info under the IMPORTING name, so ``jit-donated-reuse``
+        and ``jit-unhashable-static`` judge call sites through the
+        import."""
+        from apex_tpu.analysis.rules import _jit_wrappers
+
+        home: Dict[int, dict] = {id(mi): _jit_wrappers(mi, local_only=True)
+                                 for mi in self.modules.values()}
+        for mi in self.modules.values():
+            for local in self._abs.get(id(mi), {}):
+                if local in mi.by_name:
+                    continue                     # locally shadowed
+                chain = self._resolve_chain(mi, local)
+                if chain is None:
+                    continue
+                m2, attr = chain
+                info = home[id(m2)].get(attr)
+                if info is not None:
+                    mi.extra_wrappers[local] = info

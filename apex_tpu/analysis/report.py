@@ -10,7 +10,9 @@ from apex_tpu.analysis.walker import Finding
 
 
 def _sorted(findings: List[Finding]) -> List[Finding]:
-    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    # (path, line, rule) first: CI logs stay stable and greppable when a
+    # rule's column anchor shifts (col only breaks same-line ties)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.col))
 
 
 def render_text(new: List[Finding], baselined: List[Finding],
@@ -21,8 +23,8 @@ def render_text(new: List[Finding], baselined: List[Finding],
                    f"{f.message}")
     if show_baselined:
         for f in _sorted(baselined):
-            out.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] baselined: "
-                       f"{f.message}")
+            out.append(f"{f.path}:{f.line}:{f.col}: [{f.rule}] "
+                       f"{f.severity} (baselined): {f.message}")
     errors = sum(1 for f in new if f.severity == "error")
     warnings = len(new) - errors
     out.append(

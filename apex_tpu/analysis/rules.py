@@ -56,6 +56,14 @@ _DEVICE_GET = {"jax.device_get", "device_get"}
 _NP_HOST = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
             "onp.asarray", "onp.array"}
 _PY_CASTS = {"float", "int", "bool"}
+#: numpy scalar-constructor coercions: ``np.float32(traced)`` /
+#: ``np.int32(traced)`` concretize exactly like ``float(traced)`` but
+#: ride a dotted name, so the bare-cast check above misses them
+_NP_MODS = {"np", "numpy", "onp"}
+_NP_SCALAR_CASTS = {"float16", "float32", "float64", "bfloat16", "half",
+                    "single", "double", "longdouble", "int8", "int16",
+                    "int32", "int64", "uint8", "uint16", "uint32",
+                    "uint64", "intp", "bool_"}
 
 
 def _positional_params(info: FunctionInfo) -> Set[str]:
@@ -96,6 +104,13 @@ def check_host_sync(mi: ModuleIndex) -> Iterator[Finding]:
                     and node.args[0].id in params:
                 why = (f"`{cn}({node.args[0].id})` on a traced argument "
                        "concretizes it on the host")
+            elif cn and "." in cn and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in params:
+                mod, tail = cn.rsplit(".", 1)
+                if mod in _NP_MODS and tail in _NP_SCALAR_CASTS:
+                    why = (f"`{cn}({node.args[0].id})` on a traced "
+                           "argument materializes it as a host scalar")
             if why:
                 yield mi.finding(
                     r, node,
@@ -308,10 +323,15 @@ def check_traced_branch(mi: ModuleIndex) -> Iterator[Finding]:
 # 5-6. recompile hazards
 # --------------------------------------------------------------------------
 
-def _jit_wrappers(mi: ModuleIndex) -> Dict[str, dict]:
-    """Module-local callables known to be jit-wrapped, with their static
-    and donated argument positions (literal kwargs only)."""
-    wrappers: Dict[str, dict] = {}
+def _jit_wrappers(mi: ModuleIndex, local_only: bool = False
+                  ) -> Dict[str, dict]:
+    """Callables known to be jit-wrapped, with their static and donated
+    argument positions (literal kwargs only): module-local assignments /
+    decorators, plus — unless ``local_only`` — wrappers IMPORTED from
+    other scanned modules (injected by project.ProjectIndex, keyed by the
+    importing name; local definitions shadow them)."""
+    wrappers: Dict[str, dict] = {} if local_only \
+        else dict(getattr(mi, "extra_wrappers", {}))
 
     def record(tail: Optional[str], jit_call: ast.Call):
         if not tail:

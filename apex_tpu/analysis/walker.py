@@ -10,16 +10,34 @@ host-sync rule: ``np.asarray`` in the host scheduling loop is fine, the
 same call three frames below a jitted ``lax.scan`` body is a device
 sync every step.
 
-Resolution is deliberately name-based and module-local (no imports are
-followed): precise enough for this codebase's layout, with zero import
-side effects — the analyzer never executes the code it reads.
+Resolution is name-based and module-local here; the module additionally
+RECORDS what it cannot resolve locally — its import table
+(:class:`ImportEntry`), the dotted names each function calls
+(``calls_dotted``), and jit/scan/pallas callee references whose target is
+not a module-local function (``unresolved_marks``) — so
+:mod:`apex_tpu.analysis.project` can link the whole scanned surface into
+one interprocedural graph in a second phase. Either way the analyzer has
+zero import side effects — it never executes the code it reads.
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import io
+import re
+import tokenize
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: ``# tpu-lint: host-boundary -- why`` on (or directly above) a ``def``
+#: declares that the function is BY CONTRACT never executed under a
+#: trace — it drives jitted programs from the host (the serving engine's
+#: scheduling loop, ``generate_paged``). The reachability walk does not
+#: follow call edges into a host boundary, so host ops inside it are
+#: judged as host code. The declaration is load-bearing: if the function
+#: is in fact traced, the lint is blind below it — hence the mandatory
+#: placement on the def itself, where review sees it.
+_HOST_BOUNDARY = re.compile(r"#\s*tpu-lint:\s*host-boundary\b")
 
 #: call-position table for tracing-context entry points: dotted-name tail
 #: -> indices of positional args that are traced callables. Positions past
@@ -219,6 +237,18 @@ def walk_shallow(root: ast.AST) -> Iterator[ast.AST]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+@dataclasses.dataclass(frozen=True)
+class ImportEntry:
+    """One imported binding: ``from <module> import <attr> as <local>``
+    (``attr=None`` for plain ``import <module> [as <local>]``);
+    ``level`` counts leading dots of a relative import."""
+
+    local: str
+    module: str
+    attr: Optional[str]
+    level: int = 0
+
+
 @dataclasses.dataclass
 class FunctionInfo:
     node: ast.AST                 # FunctionDef / AsyncFunctionDef
@@ -226,6 +256,7 @@ class FunctionInfo:
     params: Tuple[str, ...]
     parent: Optional[str]         # enclosing function qualname, if any
     jit_reasons: List[str] = dataclasses.field(default_factory=list)
+    host_boundary: bool = False   # declared never-traced (see pragma)
 
     @property
     def name(self) -> str:
@@ -244,9 +275,72 @@ class ModuleIndex:
         self.by_name: Dict[str, List[FunctionInfo]] = {}
         self._enclosing: Dict[int, str] = {}   # id(node) -> qualname
         self._calls: Dict[str, Set[str]] = {}  # qualname -> callee tails
+        #: qualname -> DOTTED callee refs for the cross-module linker
+        #: (``kv_pool.free_slot``, or a bare imported name)
+        self.calls_dotted: Dict[str, Set[str]] = {}
+        #: jit/scan/pallas callee refs with no module-local target:
+        #: (dotted ref, reason) — resolved by project.ProjectIndex
+        self.unresolved_marks: List[Tuple[str, str]] = []
+        #: imported bindings, for the cross-module linker
+        self.imports: List[ImportEntry] = []
+        #: jit wrappers imported from other modules, injected by
+        #: project.ProjectIndex (local name -> wrapper info dict)
+        self.extra_wrappers: Dict[str, dict] = {}
+        self._host_boundary_lines = self._find_host_boundary_lines()
+        self._index_imports()
         self._index_functions()
         self._mark_jit_entries()
         self.reachable: Dict[str, List[str]] = self._compute_reachable()
+
+    def _find_host_boundary_lines(self) -> Set[int]:
+        """Lines carrying a ``host-boundary`` pragma (real comment tokens
+        only, like Suppressions); a comment-only line also covers the
+        following line, so the pragma can sit above a long ``def``."""
+        lines: Set[int] = set()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return lines
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT \
+                    or not _HOST_BOUNDARY.search(tok.string):
+                continue
+            lines.add(tok.start[0])
+            if not tok.line[:tok.start[1]].strip():
+                # comment-only line: the pragma decorates the next CODE
+                # line — skip the rest of its comment block, so the
+                # declaration may sit anywhere in the block above a def
+                nxt = tok.start[0] + 1
+                while nxt <= len(self.lines) \
+                        and self.lines[nxt - 1].lstrip()[:1] in ("#", ""):
+                    nxt += 1
+                lines.add(nxt)
+        return lines
+
+    def _index_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        # ``import a.b.c as x``: x names the LEAF module
+                        self.imports.append(ImportEntry(
+                            local=alias.asname, module=alias.name,
+                            attr=None))
+                    else:
+                        # ``import a.b.c`` binds only ``a`` (the top
+                        # package); dotted refs keep their own full path
+                        top = alias.name.split(".")[0]
+                        self.imports.append(ImportEntry(
+                            local=top, module=top, attr=None))
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.imports.append(ImportEntry(
+                        local=alias.asname or alias.name,
+                        module=node.module or "", attr=alias.name,
+                        level=node.level))
 
     # ---------------------------------------------------------------- index
 
@@ -261,8 +355,18 @@ class ModuleIndex:
                     params = tuple(
                         p.arg for p in
                         (a.posonlyargs + a.args + a.kwonlyargs))
+                    # header span starts at the FIRST decorator (a
+                    # pragma above a decorated def attaches there), ends
+                    # before the body
+                    hdr_start = min(
+                        [child.lineno]
+                        + [d.lineno for d in child.decorator_list])
+                    hdr_end = max(child.body[0].lineno, hdr_start + 1)
+                    hb = bool(self._host_boundary_lines
+                              & set(range(hdr_start, hdr_end)))
                     info = FunctionInfo(node=child, qualname=qn,
-                                        params=params, parent=enclosing)
+                                        params=params, parent=enclosing,
+                                        host_boundary=hb)
                     self.functions[qn] = info
                     self.by_name.setdefault(child.name, []).append(info)
                     for sub in walk_shallow(child):
@@ -279,16 +383,22 @@ class ModuleIndex:
 
         for qn, info in self.functions.items():
             called: Set[str] = set()
+            dotted: Set[str] = set()
             # the payload of jax.debug.callback is host-side and
             # non-blocking — it is NOT an edge into jitted execution
             exempt = host_callback_exempt_ids(info.node)
             for node in walk_shallow(info.node):
                 if isinstance(node, ast.Call) and id(node) not in exempt:
-                    tail = name_tail(unwrap_partial(node.func)) \
-                        if isinstance(node.func, ast.Call) \
-                        else name_tail(node.func)
+                    callee = unwrap_partial(node.func) \
+                        if isinstance(node.func, ast.Call) else node.func
+                    tail = name_tail(callee)
                     if tail:
                         called.add(tail)
+                    dn = dotted_name(callee)
+                    # dotted refs, plus bare names with no local target:
+                    # both may resolve through this module's imports
+                    if dn and ("." in dn or dn not in self.by_name):
+                        dotted.add(dn)
                     # callables passed onward (e.g. a local fn handed to
                     # jnp.where/vmap) keep the graph connected enough
                     for arg in node.args:
@@ -298,6 +408,7 @@ class ModuleIndex:
                         if t and t in self.by_name:
                             called.add(t)
             self._calls[qn] = called
+            self.calls_dotted[qn] = dotted
 
     def enclosing_function(self, node: ast.AST) -> Optional[FunctionInfo]:
         qn = self._enclosing.get(id(node))
@@ -317,8 +428,16 @@ class ModuleIndex:
             for elt in ref.elts:
                 self._mark(elt, reason)
             return
-        tail = name_tail(unwrap_partial(ref))
+        target = unwrap_partial(ref)
+        tail = name_tail(target)
         if not tail:
+            return
+        if tail not in self.by_name:
+            # e.g. ``jax.jit(kv_pool.free_slot)``: the callee lives in
+            # another module — record for the interprocedural linker
+            dn = dotted_name(target)
+            if dn:
+                self.unresolved_marks.append((dn, reason))
             return
         for info in self.by_name.get(tail, ()):
             if reason not in info.jit_reasons:
@@ -377,6 +496,8 @@ class ModuleIndex:
                 if info.parent == qn:
                     nxt.add(sub)
             for sub in nxt:
+                if self.functions[sub].host_boundary:
+                    continue     # declared never-traced: edge stops here
                 if sub not in reach:
                     reach[sub] = chain + [f"called from {qn}"]
                     work.append((sub, reach[sub]))

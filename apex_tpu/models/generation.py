@@ -392,6 +392,9 @@ def decode_loop(step_apply, prefill_logits, cache, max_new_tokens: int, *,
         return (cache, nxt, done), nxt
 
     if max_new_tokens > 1:
+        # without an eos the `done` carry is vestigial (never read) —
+        # kept so the scan signature is identical across eos modes
+        # tpu-lint: disable=ir-dead-scan-carry -- one (b,) bool per step
         _, rest = lax.scan(step, (cache, tok0, done0),
                            jnp.arange(1, max_new_tokens))
         return jnp.concatenate([tok0[:, None], rest.T], axis=1)
@@ -466,6 +469,9 @@ def validate_decode_bounds(s0: int, max_new_tokens: int,
                            max_len=None) -> int:
     """Shared prompt/cap/buffer validation for the decode entry points;
     returns the effective cache length."""
+    # decode bounds are Python ints by contract; under
+    # jit(partial(generate, ...)) they concretize at trace time (static),
+    # tpu-lint: disable=host-sync-in-jit -- never against a device value
     total = s0 + int(max_new_tokens)
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -473,7 +479,7 @@ def validate_decode_bounds(s0: int, max_new_tokens: int,
         raise ValueError(
             f"prompt ({s0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"max_position_embeddings={max_position_embeddings}")
-    t_max = total if max_len is None else int(max_len)
+    t_max = total if max_len is None else int(max_len)  # tpu-lint: disable=host-sync-in-jit -- static bound, see above
     if t_max < total:
         raise ValueError(f"max_len={t_max} < prompt + max_new_tokens={total}")
     return t_max

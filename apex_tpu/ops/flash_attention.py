@@ -937,6 +937,10 @@ def flash_attention_with_lse(q, k, v, *, scale: Optional[float] = None,
             jnp.asarray(dropout_row0, jnp.int32).reshape(()),
             jnp.asarray(dropout_col0, jnp.int32).reshape(()),
         ]).reshape(1, 3)
+    # under an lse cotangent the staged bwd re-runs the fwd kernel for
+    # residuals and drops one twin; tpu_custom_call is side-effect-free
+    # so XLA DCEs it — training-only path, not worth a custom_vjp split
+    # tpu-lint: disable=ir-dead-output -- dead twin is DCE'd by XLA
     return _flash_with_lse(
         q, k, v, dyn, meta, float(scale), causal, block_q, block_k,
         None if window is None else int(window), static_off,

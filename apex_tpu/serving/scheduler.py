@@ -92,6 +92,17 @@ def _donate_cache():
     return (0,) if jax.default_backend() == "tpu" else ()
 
 
+def prompt_bucket(s0: int, page_size: int, max_positions: int) -> int:
+    """The admission compile-key bucket for a raw prompt length: pad up
+    to a whole page (capped at the position table) so one program serves
+    every length in the page — the compile-count contract the IR tier's
+    ``gpt2s_engine_admit_bucketed`` case traces at two same-bucket
+    lengths (``ir-compile-key-cardinality``). Admission and the lint
+    harness MUST share this function: the contract is only binding on
+    the engine if the engine's own bucketing is what gets traced."""
+    return min(round_up(max(s0, 1), page_size), max_positions)
+
+
 def _bucket_match_pages(m: int) -> int:
     """Round a radix match depth DOWN to a power of two pages. Retirement
     inserts prompts AND generated tokens, so raw match depths take many
@@ -352,6 +363,9 @@ class PagedDecodeEngine:
             return (cache, nxt, done, n_left, req_keys, samp_i), nxt
 
         def step(cache, variables, tok, done, n_left, req_keys, samp_i):
+            # greedy mode never reads req_keys; the carry layout stays
+            # identical across greedy/sampled so both share one step
+            # tpu-lint: disable=ir-dead-scan-carry -- (slots, 2) u32/step
             (cache, tok, done, n_left, _, samp_i), toks = lax.scan(
                 functools.partial(one_step, variables),
                 (cache, tok, done, n_left, req_keys, samp_i),
@@ -529,8 +543,8 @@ class PagedDecodeEngine:
                 with tr.span(idx, "prefill", cached_tokens=m * ps,
                              computed_tokens=s0 - m * ps):
                     if m == 0:
-                        bucket = min(round_up(max(s0, 1), ps),
-                                     cfg.max_position_embeddings)
+                        bucket = prompt_bucket(
+                            s0, ps, cfg.max_position_embeddings)
                         ids = np.zeros((1, bucket), np.int32)
                         ids[0, :s0] = prompt
                         self.cache, tok0 = self._admit_fn(bucket)(
@@ -663,6 +677,9 @@ class PagedDecodeEngine:
         return outputs, stats
 
 
+# the host scheduling loop driving the jitted admit/step programs;
+# tpu-lint: host-boundary -- never traced (jit of paged generate is
+# unsupported by contract: the engine syncs at every chunk boundary)
 def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
                    temperature: float = 0.0, top_k: Optional[int] = None,
                    top_p: Optional[float] = None, rng=None,

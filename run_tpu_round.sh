@@ -14,11 +14,31 @@ bench_done() { python bench_ok.py "BENCH_${TAG}.json.local"; }
 # FAIL-FAST static-analysis gate (docs/static_analysis.md): a host sync in
 # the decode scan or a Pallas contract violation should die here, on the
 # CI box, not after burning a tunnel window on chip
-echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate..."
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate (AST tier)..."
 if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis; then
   echo "[$(date +%H:%M:%S)] tpu-lint found new hazards; fix, suppress with"
   echo "  justification, or baseline them (docs/static_analysis.md) first"
   exit 1
+fi
+# IR tier: trace every registered entry point (tpu_aot kernel cases + the
+# serving engine programs) on CPU and lint the STAGED jaxprs — dtype
+# promotion drift, dead scan state, ineffective donation, compile-key
+# cardinality. Same no-TPU-needed contract as the AST tier.
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate (IR tier)..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --ir; then
+  echo "[$(date +%H:%M:%S)] tpu-lint --ir found new jaxpr-level hazards;"
+  echo "  fix or suppress with justification (docs/static_analysis.md)"
+  exit 1
+fi
+# diff-aware gate: when CI exports LINT_DIFF_BASE (e.g. the PR merge
+# base), ALSO fail on AST findings introduced relative to it — catches
+# regressions even if someone grows the baseline file in the same PR
+if [ -n "${LINT_DIFF_BASE:-}" ]; then
+  echo "[$(date +%H:%M:%S)] tpu-lint diff gate vs ${LINT_DIFF_BASE}..."
+  if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --diff "$LINT_DIFF_BASE"; then
+    echo "[$(date +%H:%M:%S)] tpu-lint: findings introduced since ${LINT_DIFF_BASE}"
+    exit 1
+  fi
 fi
 
 # persistent XLA compilation cache: a window that dies after the 15-min

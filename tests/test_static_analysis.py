@@ -680,3 +680,411 @@ def test_scoped_write_baseline_keeps_other_files(tmp_path):
                      "--write-baseline"]) == 0
     # a's legacy entry survived the scoped write
     assert cli.main([str(a), str(b), "--root", str(tmp_path)]) == 0
+
+
+# --------------------------------------------------------------------------
+# numpy scalar-constructor coercions (ISSUE 5 satellite)
+# --------------------------------------------------------------------------
+
+def test_np_scalar_cast_on_traced_param_is_flagged(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.float32(x) + np.int32(x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert [f.rule for f in findings] == ["host-sync-in-jit"] * 2
+    assert any("np.float32(x)" in f.message for f in findings)
+
+
+def test_np_array_of_traced_param_is_flagged(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.array(x).sum()
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_np_scalar_cast_of_literal_is_clean(tmp_path):
+    """Precision: np.float32(0.5) on a CONSTANT in jitted code is a
+    plain host scalar, not a sync."""
+    src = """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x * np.float32(0.5)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# report ordering (ISSUE 5 satellite)
+# --------------------------------------------------------------------------
+
+def test_text_report_sorted_by_path_line_rule_with_severity():
+    from apex_tpu.analysis import report
+    from apex_tpu.analysis.walker import Finding
+
+    def f(path, line, rule, severity="error", col=1):
+        return Finding(rule=rule, severity=severity, path=path,
+                       line=line, col=col, message="m")
+
+    out = report.render_text(
+        [f("b.py", 3, "zz-rule"), f("a.py", 9, "b-rule"),
+         f("a.py", 9, "a-rule", col=30), f("a.py", 2, "z-rule",
+                                           severity="warning")],
+        [f("a.py", 5, "old-rule", severity="warning")], 0,
+        show_baselined=True)
+    lines = out.splitlines()
+    assert lines[0].startswith("a.py:2:")       # line beats rule name
+    assert lines[1].startswith("a.py:9:30: [a-rule]")  # rule beats col
+    assert lines[2].startswith("a.py:9:1: [b-rule]")
+    assert lines[3].startswith("b.py:3:")
+    assert "warning (baselined):" in lines[4]   # severity on baselined
+    assert "error:" in lines[1]
+
+
+# --------------------------------------------------------------------------
+# interprocedural call graph (ISSUE 5 tentpole, part B)
+# --------------------------------------------------------------------------
+
+def _pkg(tmp_path, files):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    findings, suppressed = cli.analyze_paths(
+        [str(pkg)], root=tmp_path, with_project_rules=False)
+    return findings, suppressed
+
+
+_XMOD_UTILS = """\
+    import numpy as np
+
+    def norm(x):
+        return np.asarray(x).sum()
+
+    def host_only(x):
+        return np.asarray(x)
+"""
+
+
+def test_host_sync_seen_through_imported_helper(tmp_path):
+    """A utils/ helper full of host ops, called from a jitted scan body
+    in ANOTHER module, is flagged — with the cross-module chain in the
+    message. Its host-only sibling stays clean."""
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "from pkg.helpers import norm\n",
+        "helpers.py": _XMOD_UTILS,
+        "main.py": """\
+            import jax
+            from jax import lax
+            from pkg import norm
+            from pkg.helpers import host_only
+
+            @jax.jit
+            def step(x):
+                def body(c, _):
+                    return c + norm(c), None
+                return lax.scan(body, x, None, length=4)
+
+            def host_drive(x):
+                return host_only(x)
+        """,
+    })
+    assert [f.rule for f in findings] == ["host-sync-in-jit"]
+    assert findings[0].path.endswith("helpers.py")
+    assert findings[0].scope == "norm"
+    assert "main.py" in findings[0].message
+
+
+def test_jit_of_imported_function_marks_it(tmp_path):
+    """jax.jit(mod.fn) marks fn in its HOME module (the scheduler's
+    ``jax.jit(kv_pool.free_slot)`` pattern)."""
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "",
+        "pool.py": """\
+            import numpy as np
+
+            def free_slot(cache, slot):
+                return np.asarray(cache)
+        """,
+        "engine.py": """\
+            import jax
+            from pkg import pool
+
+            _free = jax.jit(pool.free_slot)
+        """,
+    })
+    assert [f.rule for f in findings] == ["host-sync-in-jit"]
+    assert findings[0].path.endswith("pool.py")
+
+
+def test_unreached_import_is_clean(tmp_path):
+    """Importing a host-op-heavy module does NOT taint it: only real
+    call edges from jit entries do."""
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "",
+        "helpers.py": _XMOD_UTILS,
+        "main.py": """\
+            import jax
+            from pkg.helpers import norm
+
+            @jax.jit
+            def step(x):
+                return x + 1
+
+            def host_drive(x):
+                return norm(x)
+        """,
+    })
+    assert not findings, [(f.rule, f.path, f.message) for f in findings]
+
+
+def test_reexport_chain_is_followed(tmp_path):
+    """__init__ re-exports resolve one more hop (the serving package's
+    ``from pkg import helper`` style)."""
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "from pkg.impl import helper\n",
+        "impl.py": """\
+            import numpy as np
+
+            def helper(x):
+                return float(np.asarray(x).sum())
+        """,
+        "main.py": """\
+            import jax
+            from pkg import helper
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """,
+    })
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+    assert {f.path.split("/")[-1] for f in findings} == {"impl.py"}
+
+
+def test_imported_donated_wrapper_tracked(tmp_path):
+    """jit-donated-reuse sees a wrapper IMPORTED from another module:
+    the home module's donate_argnums travel with the name."""
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "",
+        "kernels.py": """\
+            import jax
+
+            def _upd(buf):
+                return buf + 1
+
+            fused_update = jax.jit(_upd, donate_argnums=(0,))
+        """,
+        "train.py": """\
+            from pkg.kernels import fused_update
+
+            def run(buf):
+                out = fused_update(buf)
+                return out + buf.sum()
+        """,
+    })
+    assert [f.rule for f in findings] == ["jit-donated-reuse"]
+    assert findings[0].path.endswith("train.py")
+
+
+def test_imported_wrapper_rebind_is_clean(tmp_path):
+    findings, _ = _pkg(tmp_path, {
+        "__init__.py": "",
+        "kernels.py": """\
+            import jax
+
+            def _upd(buf):
+                return buf + 1
+
+            fused_update = jax.jit(_upd, donate_argnums=(0,))
+        """,
+        "train.py": """\
+            from pkg.kernels import fused_update
+
+            def run(buf):
+                buf = fused_update(buf)
+                return buf + buf.sum()
+        """,
+    })
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# host-boundary pragma
+# --------------------------------------------------------------------------
+
+def test_host_boundary_cuts_reachability(tmp_path):
+    """A declared host boundary (the engine's generate_paged pattern):
+    host ops below it are host code, not jit-reachable."""
+    src = """\
+        import jax
+        import numpy as np
+
+        # tpu-lint: host-boundary -- drives jitted programs from the host
+        def drive(x):
+            return np.asarray(x).sum()
+
+        @jax.jit
+        def step(x):
+            return drive(x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+def test_without_host_boundary_same_code_is_flagged(tmp_path):
+    src = """\
+        import jax
+        import numpy as np
+
+        def drive(x):
+            return np.asarray(x).sum()
+
+        @jax.jit
+        def step(x):
+            return drive(x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert {f.rule for f in findings} == {"host-sync-in-jit"}
+
+
+def test_host_boundary_pragma_in_comment_block(tmp_path):
+    """The pragma may sit anywhere in the comment block directly above
+    the def (real-world blocks wrap justifications over lines)."""
+    src = """\
+        import jax
+        import numpy as np
+
+        # this is the serving engine's host loop, and the pragma below
+        # tpu-lint: host-boundary -- declared never-traced
+        # (more prose after it is fine too)
+        def drive(x):
+            return np.asarray(x).sum()
+
+        @jax.jit
+        def step(x):
+            return drive(x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# --diff mode (ISSUE 5 satellite)
+# --------------------------------------------------------------------------
+
+import subprocess  # noqa: E402
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-C", str(cwd), *args], check=True,
+                   capture_output=True)
+
+
+_DIFF_LEGACY = """\
+import jax
+import numpy as np
+
+@jax.jit
+def old_step(x):
+    return np.asarray(x).sum()
+"""
+
+
+def _diff_repo(tmp_path):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "apex_tpu").mkdir()
+    (tmp_path / "apex_tpu" / "legacy.py").write_text(_DIFF_LEGACY)
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "base")
+
+
+def test_diff_mode_ignores_preexisting_findings(tmp_path, capsys):
+    _diff_repo(tmp_path)
+    assert cli.main(["--root", str(tmp_path)]) == 1       # absolute: dirty
+    assert cli.main(["--root", str(tmp_path),
+                     "--diff", "HEAD"]) == 0              # diff: clean
+
+
+def test_diff_mode_fails_on_introduced_finding(tmp_path, capsys):
+    _diff_repo(tmp_path)
+    (tmp_path / "apex_tpu" / "fresh.py").write_text(_DIFF_LEGACY)
+    capsys.readouterr()
+    assert cli.main(["--root", str(tmp_path), "--diff", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "fresh.py" in out
+    assert "legacy.py" not in out.split("NEW relative")[0]
+
+
+def test_diff_mode_new_finding_in_old_scope_fails(tmp_path, capsys):
+    """A SECOND finding of the same rule in the same function exceeds
+    the base count and fails, mirroring baseline semantics."""
+    _diff_repo(tmp_path)
+    (tmp_path / "apex_tpu" / "legacy.py").write_text(
+        _DIFF_LEGACY.replace(
+            "return np.asarray(x).sum()",
+            "return np.asarray(x).sum() + float(x)"))
+    assert cli.main(["--root", str(tmp_path), "--diff", "HEAD"]) == 1
+
+
+def test_diff_mode_bad_rev_is_usage_error(tmp_path, capsys):
+    _diff_repo(tmp_path)
+    assert cli.main(["--root", str(tmp_path),
+                     "--diff", "no-such-rev"]) == 2
+
+
+def test_host_boundary_on_decorated_def(tmp_path):
+    """The pragma must attach through a decorator stack (the header
+    span starts at the first decorator, not the def line)."""
+    src = """\
+        import functools
+        import jax
+        import numpy as np
+
+        def deco(f):
+            return f
+
+        # tpu-lint: host-boundary -- host driver, wrapped for logging
+        @deco
+        @functools.wraps(print)
+        def drive(x):
+            return np.asarray(x).sum()
+
+        @jax.jit
+        def step(x):
+            return drive(x)
+    """
+    findings, _ = _run_on(tmp_path, src)
+    assert not findings, [(f.rule, f.message) for f in findings]
+
+
+def test_diff_refuses_baseline_flags(tmp_path, capsys):
+    _diff_repo(tmp_path)
+    assert cli.main(["--root", str(tmp_path), "--diff", "HEAD",
+                     "--write-baseline"]) == 2
+    assert cli.main(["--root", str(tmp_path), "--diff", "HEAD",
+                     "--baseline", "x.json"]) == 2
+
+
+def test_diff_refuses_explicit_paths(tmp_path, capsys):
+    """The base side always lints the default surface; explicit paths
+    would misreport off-surface pre-existing findings as new."""
+    _diff_repo(tmp_path)
+    assert cli.main([str(tmp_path / "apex_tpu" / "legacy.py"),
+                     "--root", str(tmp_path), "--diff", "HEAD"]) == 2
