@@ -204,12 +204,61 @@ def _build_admit_bucketed() -> CaseProgram:
                 jax.ShapeDtypeStruct((), i32),            # s0
                 jax.ShapeDtypeStruct((), i32),            # slot
                 jax.ShapeDtypeStruct((), i32),            # n_pages
-                jax.ShapeDtypeStruct((2,), jnp.uint32))   # req_key
-
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
     bucket = prompt_bucket(90, engine.page_size,
                            cfg.max_position_embeddings)
     return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(90),
                        variants=[args_for(93)], max_traces=1)
+
+
+def _build_frontend_program(kind: str) -> CaseProgram:
+    """The serving FRONT-END's programs, bound through its own accessors
+    (``ServingFrontend.admission_program`` / ``decode_program``) rather
+    than the engine internals they delegate to — if the frontend's pump
+    ever grows its own bucketing or decode wrapper, these cases trace
+    what it actually dispatches, and ``ir-compile-key-cardinality``
+    keeps binding the served compile-key contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.frontend import ServingFrontend
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, sync_every=4)
+    frontend = ServingFrontend(engine)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    if kind == "decode":
+        args = (cache_abs, dvars,
+                jax.ShapeDtypeStruct((4,), i32),           # tok
+                jax.ShapeDtypeStruct((4,), jnp.bool_),     # done
+                jax.ShapeDtypeStruct((4,), i32),           # n_left
+                jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
+                jax.ShapeDtypeStruct((4,), i32))           # samp_i
+        return CaseProgram(fn=frontend.decode_program(), args=args)
+    assert kind == "admit"
+
+    def args_for(s0: int) -> tuple:
+        _, bucket = frontend.admission_program(s0)
+        return (cache_abs, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
+    fn, _ = frontend.admission_program(90)
+    return CaseProgram(fn=fn, args=args_for(90), variants=[args_for(93)],
+                       max_traces=1)
 
 
 def _build_optimizer_update(kind: str) -> CaseProgram:
@@ -253,6 +302,12 @@ def analysis_cases(root) -> List[AnalysisCase]:
                               _build_engine_chunk))
     cases.append(AnalysisCase("gpt2s_engine_admit_bucketed", "serving",
                               _build_admit_bucketed))
+    cases.append(AnalysisCase(
+        "gpt2s_frontend_decode_chunk", "serving",
+        lambda: _build_frontend_program("decode")))
+    cases.append(AnalysisCase(
+        "gpt2s_frontend_admit_bucketed", "serving",
+        lambda: _build_frontend_program("admit")))
     cases.append(AnalysisCase(
         "optim_sgd_momentum_buffer", "optimizers",
         lambda: _build_optimizer_update("sgd")))

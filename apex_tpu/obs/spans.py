@@ -39,8 +39,14 @@ import jax
 
 __all__ = ["PHASES", "Span", "SpanTracer"]
 
-#: canonical request lifecycle, in order
-PHASES = ("enqueue", "admit", "prefill", "first_token", "decode", "retire")
+#: canonical request lifecycle, in order. The bracketed middle may repeat:
+#: the serving front-end preempts a running request (``preempt`` instant,
+#: then a ``preempted`` interval open until its ``resume`` instant, whose
+#: re-admission opens a fresh ``prefill``/``decode`` pair), so a request
+#: can carry several decode segments — :meth:`SpanTracer.lifecycle` sums
+#: them and reports the total time-in-preempted as ``preempted_ms``.
+PHASES = ("enqueue", "admit", "prefill", "first_token", "decode",
+          "preempt", "preempted", "resume", "retire")
 
 
 @dataclasses.dataclass
@@ -156,35 +162,61 @@ class SpanTracer:
     def lifecycle(self, request_id) -> Dict[str, object]:
         """Derived per-request metrics from the canonical phases. Keys
         appear only when their source spans exist (a partial lifecycle —
-        a still-running request — yields what is known so far)."""
-        by_name: Dict[str, Span] = {}
+        a still-running request — yields what is known so far).
+
+        Preemption-aware: a preempted-and-resumed request carries one
+        ``prefill``/``decode`` span pair per segment, so segment spans
+        are SUMMED (``decode_ms``, ``prefill_ms``, ``new_tokens``,
+        ``cached_tokens``/``computed_tokens`` are totals across
+        segments), the boundary instants anchor on the FIRST occurrence
+        (``queue_wait_ms``/``ttft_ms`` measure the original arrival, not
+        a resume), and closed ``preempted`` intervals report their total
+        as ``preempted_ms`` with the count as ``preemptions``. ``tpot``
+        is decode time per generated token — preempted/queued time
+        excluded by construction."""
+        by_name: Dict[str, List[Span]] = {}
         for s in self.spans(request_id):
-            by_name[s.name] = s           # latest occurrence wins
+            by_name.setdefault(s.name, []).append(s)
+
+        def first(name):
+            spans = by_name.get(name)
+            return spans[0] if spans else None
+
         out: Dict[str, object] = {"request_id": request_id}
-        enq = by_name.get("enqueue")
-        admit = by_name.get("admit")
-        first = by_name.get("first_token")
+        enq = first("enqueue")
+        admit = first("admit")
+        ftok = first("first_token")
         if enq is not None and admit is not None:
             out["queue_wait_ms"] = (admit.t_start - enq.t_start) * 1e3
-        if enq is not None and first is not None:
-            out["ttft_ms"] = (first.t_start - enq.t_start) * 1e3
-        prefill = by_name.get("prefill")
-        if prefill is not None and prefill.duration_ms is not None:
-            out["prefill_ms"] = prefill.duration_ms
+        if enq is not None and ftok is not None:
+            out["ttft_ms"] = (ftok.t_start - enq.t_start) * 1e3
+        prefills = [s for s in by_name.get("prefill", ())
+                    if s.duration_ms is not None]
+        if prefills:
+            out["prefill_ms"] = sum(s.duration_ms for s in prefills)
             for k in ("cached_tokens", "computed_tokens"):
-                if k in prefill.attrs:
-                    out[k] = prefill.attrs[k]
-        decode = by_name.get("decode")
-        if decode is not None and decode.duration_ms is not None:
-            out["decode_ms"] = decode.duration_ms
-            n_new = decode.attrs.get("new_tokens")
-            if n_new is not None:
-                out["new_tokens"] = n_new
+                vals = [s.attrs[k] for s in prefills if k in s.attrs]
+                if vals:
+                    out[k] = sum(vals)
+        decodes = [s for s in by_name.get("decode", ())
+                   if s.duration_ms is not None]
+        if decodes:
+            out["decode_ms"] = sum(s.duration_ms for s in decodes)
+            n_new = [s.attrs["new_tokens"] for s in decodes
+                     if "new_tokens" in s.attrs]
+            if n_new:
+                total_new = int(sum(n_new))
+                out["new_tokens"] = total_new
                 # token 0 samples at admit; decode produces the rest
-                out["tpot_ms"] = decode.duration_ms / max(int(n_new) - 1, 1)
-        retire = by_name.get("retire")
-        if enq is not None and retire is not None:
-            out["total_ms"] = (retire.t_start - enq.t_start) * 1e3
+                out["tpot_ms"] = out["decode_ms"] / max(total_new - 1, 1)
+        preempted = [s for s in by_name.get("preempted", ())
+                     if s.duration_ms is not None]
+        if by_name.get("preempted"):
+            out["preemptions"] = len(by_name["preempted"])
+            out["preempted_ms"] = sum(s.duration_ms for s in preempted)
+        retires = by_name.get("retire")
+        if enq is not None and retires:
+            out["total_ms"] = (retires[-1].t_start - enq.t_start) * 1e3
         return out
 
     def lifecycles(self) -> Dict[object, Dict[str, object]]:

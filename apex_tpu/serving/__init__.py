@@ -26,10 +26,22 @@ This package replaces that with the two serving-stack staples:
   al. 2023). Opt-in via ``PagedDecodeEngine(..., prefix_cache=True)`` /
   ``generate(..., paged=True, prefix_cache=True)``.
 
+- **Async front-end** (``frontend`` + ``policy``): streaming ingest
+  (``submit()`` returns a per-token :class:`StreamHandle`), a
+  priority/deadline admission policy, preemption that spills a victim's
+  full pages back through the prefix cache (resumption is a cache hit),
+  and a pump that overlaps host-side retirement/admission work with the
+  next jitted decode chunk. ``PagedDecodeEngine.run`` is a thin
+  closed-loop wrapper over it (docs/frontend.md).
+
 The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
 that gathers pages via the block table with scalar-prefetch index maps.
 """
 
+from apex_tpu.serving.frontend import (  # noqa: F401
+    ServingFrontend,
+    StreamHandle,
+)
 from apex_tpu.serving.kv_pool import (  # noqa: F401
     alloc_slot,
     alloc_slot_shared,
@@ -43,6 +55,7 @@ from apex_tpu.serving.kv_pool import (  # noqa: F401
     prefill_into_pages,
     release_slot,
 )
+from apex_tpu.serving.policy import PriorityDeadlinePolicy  # noqa: F401
 from apex_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     PagedDecodeEngine,

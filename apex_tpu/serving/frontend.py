@@ -1,0 +1,816 @@
+"""Async serving front-end over the paged decode engine: streaming
+ingest, priorities/deadlines, and page-spilling preemption.
+
+``PagedDecodeEngine.run()`` drains a *fixed* request list; production
+traffic is an open stream. :class:`ServingFrontend` is the layer that
+turns the engine into a server:
+
+- **Ingest** — ``submit(request)`` is thread-safe and returns a
+  :class:`StreamHandle` immediately; per-token results are pushed to the
+  handle as decode chunks retire, and ``result()`` blocks for the full
+  output. The pump (below) may run on a background thread (``start()``)
+  or be driven synchronously (``drain()`` — what ``run()`` does).
+- **Priorities/deadlines** — the pending queue is ordered by the
+  injected :class:`~apex_tpu.serving.policy.PriorityDeadlinePolicy`
+  (priority desc, then earliest deadline, then arrival). ``deadline_ms``
+  is a TTFT SLO; misses are counted (``serving.deadline_misses``), never
+  dropped.
+- **Preemption** — when a higher-priority request is blocked (no slot or
+  pages) and the policy says it cannot wait, the lowest-priority active
+  slot is stopped at a sync boundary and its FULL pages are released
+  through the prefix-cache insert path (``release_slot`` with the tree's
+  keep mask) — the victim's computed prefix survives as cached pages
+  instead of being discarded. The victim re-enters the queue with its
+  generated-so-far tokens folded into its prompt; its resume admission
+  walks the radix tree, points its block table at the spilled pages, and
+  re-prefills only the (≤ one page) tail — preemption-by-spill, cheaper
+  than vLLM's discard-and-recompute whenever the cache survives. With
+  ``prefix_cache=False`` preemption degrades to exactly that
+  discard-and-recompute. Greedy outputs are token-identical with
+  preemption on or off (the resume re-derives nothing: cached pages
+  replay bitwise-stored K/V; the recompute path re-runs the same
+  prefill).
+- **The pump** — the engine's jitted ``sync_every``-step decode chunk is
+  dispatched FIRST each iteration; the host then harvests the *previous*
+  chunk's tokens, retires finished slots, streams results, and admits
+  new work while the device executes — double-buffered host work. All
+  cache mutations are async dispatches on one device stream, so program
+  order keeps them correct: a retiring slot is done-frozen (EOS/budget
+  masks flip on device) during the in-flight chunk, its writes land only
+  at its frozen garbage position (never inside a cacheable full page),
+  and its release/realloc are queued after the chunk. The price is that
+  a slot freed by chunk N's harvest starts its next request at chunk
+  N+2, not N+1 — one chunk of pipeline bubble per handoff, paid back by
+  the device never idling through host bookkeeping.
+
+The frontend owns no compiled programs and no pool state — it drives the
+engine's (``_admit_fn`` / ``_admit_shared_fn`` / ``_step_fn``), so
+``run()`` reimplemented over the frontend exercises the same compile-key
+contracts the lint harness binds (``analysis_cases()`` traces
+:meth:`ServingFrontend.admission_program` /
+:meth:`ServingFrontend.decode_program` — shared accessors, not mirrors).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.obs.spans import SpanTracer
+from apex_tpu.ops._dispatch import round_up
+from apex_tpu.serving import kv_pool
+from apex_tpu.serving.policy import PriorityDeadlinePolicy
+from apex_tpu.serving.scheduler import (_RUN_COUNTERS, _RUN_HISTOGRAMS,
+                                        Request, _bucket_match_pages,
+                                        prompt_bucket)
+from apex_tpu.utils import metrics
+
+__all__ = ["ServingFrontend", "StreamHandle"]
+
+#: sentinel closing a handle's token stream
+_END = object()
+
+
+class StreamHandle:
+    """One submitted request's streaming view: tokens arrive in order as
+    the pump harvests decode chunks; iteration ends when the request
+    retires (EOS / token budget) or is cancelled. ``result()`` blocks
+    for the complete generated-token array. All methods are thread-safe
+    (the pump pushes from its thread, callers consume from theirs)."""
+
+    def __init__(self, request_id):
+        self.request_id = request_id
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._tokens: List[int] = []
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._cancelled = threading.Event()
+        self._output: Optional[np.ndarray] = None
+        self._error: Optional[BaseException] = None
+
+    # -- pump side -----------------------------------------------------------
+
+    def _push(self, tok: int) -> None:
+        with self._lock:
+            self._tokens.append(tok)
+        self._q.put(tok)
+
+    def _finish(self, output: np.ndarray) -> None:
+        self._output = output
+        self._done.set()
+        self._q.put(_END)
+
+    def _fail(self, exc: BaseException) -> None:
+        self._error = exc
+        self._done.set()
+        self._q.put(_END)
+
+    # -- caller side ---------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self) -> None:
+        """Request cancellation: a pending request is dropped, an active
+        one retires at the next sync boundary (its stream terminates and
+        its pages free/spill normally). Idempotent; the already-streamed
+        tokens remain the handle's output."""
+        self._cancelled.set()
+
+    def tokens_so_far(self) -> List[int]:
+        with self._lock:
+            return list(self._tokens)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Next token, or None once the stream has terminated. Raises
+        ``queue.Empty`` on timeout."""
+        tok = self._q.get(timeout=timeout)
+        if tok is _END:
+            self._q.put(_END)            # keep the stream terminated
+            return None
+        return tok
+
+    def __iter__(self):
+        while True:
+            tok = self.get()
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; the generated tokens (up to
+        and including EOS), truncated at the cancellation point for a
+        cancelled request. Re-raises a pump failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id!r} still running")
+        if self._error is not None:
+            raise self._error
+        return self._output
+
+
+class _Entry:
+    """Pump-internal request state, reused across preempt/resume cycles:
+    ``prompt`` is the CURRENT segment's prompt (the original plus every
+    previously generated token after a preemption), ``prev`` the tokens
+    generated by earlier segments, ``seg_tokens`` the current segment's.
+    ``joined`` is the first decode-chunk index whose harvested tokens
+    belong to this segment (pipelining: a chunk dispatched before the
+    admission carries the PREVIOUS occupant's frozen fill tokens)."""
+
+    __slots__ = ("idx", "handle", "prompt", "total_new", "priority",
+                 "deadline_at", "arrival", "seq", "resume", "prev",
+                 "seg_tokens", "nodes", "n_private", "joined",
+                 "first_token_seen")
+
+    def __init__(self, idx, handle, prompt, total_new, priority,
+                 deadline_at, arrival, seq):
+        self.idx = idx
+        self.handle = handle
+        self.prompt = prompt
+        self.total_new = total_new
+        self.priority = priority
+        self.deadline_at = deadline_at
+        self.arrival = arrival
+        self.seq = seq
+        self.resume = False
+        self.prev: List[int] = []
+        self.seg_tokens: List[int] = []
+        self.nodes: list = []
+        self.n_private = 0
+        self.joined = 0
+        self.first_token_seen = False
+
+    @property
+    def s0(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def seg_new(self) -> int:
+        """This segment's token budget (total minus earlier segments)."""
+        return self.total_new - len(self.prev)
+
+    @property
+    def generated(self) -> int:
+        return len(self.prev) + len(self.seg_tokens)
+
+
+class _Chunk:
+    """An in-flight (dispatched, unharvested) decode chunk.
+    ``toks_np``/``t_done`` cache the materialized tokens and the moment
+    the host first observed completion — stamped as early as possible
+    (an admission syncing on the pool materializes the chunk first) so
+    ``decode_step_ms`` measures the chunk, not later host work."""
+
+    __slots__ = ("toks", "idx", "t0", "toks_np", "t_done")
+
+    def __init__(self, toks, idx, t0):
+        self.toks = toks
+        self.idx = idx
+        self.t0 = t0
+        self.toks_np = None
+        self.t_done = None
+
+
+class ServingFrontend:
+    """Streaming ingest + priority/deadline scheduling + preemption over
+    one :class:`~apex_tpu.serving.scheduler.PagedDecodeEngine`.
+
+    One frontend drives one engine; ``engine.run()`` constructs a fresh
+    frontend per call (so its stats and tracer stay run-scoped), while a
+    server holds a long-lived one with a background pump thread. The
+    pump itself is single-threaded — only ``submit``/``cancel`` cross
+    threads, through the ingest lock and the handles.
+    """
+
+    def __init__(self, engine, *, policy: Optional[PriorityDeadlinePolicy]
+                 = None, tracer: Optional[SpanTracer] = None,
+                 clock=time.perf_counter):
+        self.engine = engine
+        self.policy = policy if policy is not None \
+            else PriorityDeadlinePolicy()
+        self.clock = clock
+        self.tracer = tracer if tracer is not None else SpanTracer()
+        engine.tracer = self.tracer      # the engine's "last run" tracer
+        n = engine.num_slots
+        self._tok = jnp.zeros((n,), jnp.int32)
+        self._done = jnp.ones((n,), bool)
+        self._n_left = jnp.zeros((n,), jnp.int32)
+        self._samp_i = jnp.zeros((n,), jnp.int32)
+        self._req_keys = jnp.broadcast_to(engine.rng,
+                                          (n,) + engine.rng.shape)
+        self._ingest_lock = threading.Lock()
+        self._ingest: deque = deque()
+        self._pending: List[_Entry] = []
+        self._active: Dict[int, _Entry] = {}
+        self._inflight: Optional[_Chunk] = None
+        self._chunk = 0
+        self._submit_seq = itertools.count()
+        self._pool_dirty = False
+        self.peak_slots = 0
+        self.peak_queue_depth = 0
+        labels = engine.obs_labels
+        self._C = {name: metrics.counter(f"serving.{name}", labels=labels)
+                   for name in _RUN_COUNTERS}
+        self._c0 = {name: c.value for name, c in self._C.items()}
+        self._H = {name: metrics.histogram(f"serving.{name}", labels=labels)
+                   for name in _RUN_HISTOGRAMS}
+        self._per_run = {name: [] for name in _RUN_HISTOGRAMS}
+        self._occ = metrics.gauge("serving.slots_in_use", labels=labels)
+        self._qdepth = metrics.gauge("serving.queue_depth", labels=labels)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._work_evt = threading.Event()
+        self._failure: Optional[BaseException] = None
+
+    # --- ingest -------------------------------------------------------------
+
+    def submit(self, request: Request, *,
+               request_id: Optional[int] = None) -> StreamHandle:
+        """Enqueue one request; returns its streaming handle immediately.
+
+        Thread-safe. Validates the request's position/page budget up
+        front (``ValueError`` surfaces to the submitter, never to the
+        pump). ``request_id`` defaults to a per-frontend sequence number;
+        it keys the tracer's lifecycle AND the request's sampling stream
+        (``fold_in(rng, request_id)``), so two frontends given the same
+        ids and rng draw identical streams."""
+        if self._failure is not None:
+            raise RuntimeError("frontend pump has failed") \
+                from self._failure
+        self.engine._validate_request(request)
+        seq = next(self._submit_seq)
+        idx = request_id if request_id is not None else seq
+        now = self.clock()
+        arrival = request.arrival_time if request.arrival_time is not None \
+            else now
+        deadline_at = (arrival + request.deadline_ms * 1e-3
+                       if request.deadline_ms is not None else None)
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        handle = StreamHandle(idx)
+        entry = _Entry(idx, handle, prompt, request.max_new_tokens,
+                       request.priority, deadline_at, arrival, seq)
+        self.tracer.event(idx, "enqueue",
+                          prompt_tokens=int(prompt.shape[0]),
+                          max_new_tokens=request.max_new_tokens,
+                          priority=request.priority,
+                          deadline_ms=request.deadline_ms)
+        with self._ingest_lock:
+            # re-check under the lock: a pump failure drains the ingest
+            # queue under this lock, so an entry either lands before the
+            # drain (and is failed with the rest) or raises here — a
+            # handle can never be left dangling un-finished
+            if self._failure is not None:
+                raise RuntimeError("frontend pump has failed") \
+                    from self._failure
+            self._ingest.append(entry)
+            depth = len(self._ingest) + len(self._pending)
+        self._qdepth.set(depth)
+        self.peak_queue_depth = max(self.peak_queue_depth, depth)
+        self._work_evt.set()
+        return handle
+
+    @property
+    def queue_depth(self) -> int:
+        with self._ingest_lock:
+            return len(self._ingest) + len(self._pending)
+
+    def _drain_ingest(self) -> None:
+        with self._ingest_lock:
+            while self._ingest:
+                self._pending.append(self._ingest.popleft())
+
+    # --- lint-harness accessors (shared with analysis/ir/harness.py) --------
+
+    def admission_program(self, s0: int):
+        """The compiled cold-admission program + compile-key bucket the
+        pump uses for a raw prompt length. The IR lint harness traces
+        THIS accessor at two same-bucket lengths
+        (``ir-compile-key-cardinality``), so the contract binds the
+        frontend's real bucketing — shared with ``scheduler.run()``'s
+        path, never mirrored."""
+        eng = self.engine
+        bucket = prompt_bucket(s0, eng.page_size,
+                               eng.cfg.max_position_embeddings)
+        return eng._admit_fn(bucket), bucket
+
+    def decode_program(self):
+        """The jitted ``sync_every``-step decode chunk the pump
+        dispatches (the engine's ``_step_fn`` — one program, shared)."""
+        return self.engine._step_fn()
+
+    # --- the pump -----------------------------------------------------------
+
+    # tpu-lint: host-boundary -- the pump is the host scheduling loop
+    # driving the jitted admit/step programs; it syncs at every chunk
+    # harvest by contract and is never traced
+    def pump(self) -> bool:
+        """One scheduler iteration: dispatch the next decode chunk, then
+        (overlapping its device execution) harvest the previous chunk —
+        retire/stream/spill — and run admission/preemption. Returns True
+        while work remains. Raises ``RuntimeError`` on scheduler
+        deadlock (a queued request that cannot be admitted even with
+        every slot vacant and every evictable page evicted)."""
+        eng = self.engine
+        self._drain_ingest()
+        prev, self._inflight = self._inflight, None
+        if self._active:
+            self._dispatch()
+        if prev is not None:
+            self._harvest(prev)
+        admitted = self._admission()
+        if (self._pending and not self._active and self._inflight is None
+                and not admitted):
+            raise RuntimeError(
+                "scheduler deadlock: queued request cannot be admitted "
+                "even with every slot vacant and every evictable cached "
+                "page evicted (pool too small for its page demand?)")
+        if self._pool_dirty:
+            kv_pool.observe_pool(eng.cache, labels=eng.obs_labels)
+            self._pool_dirty = False
+        self._qdepth.set(len(self._pending))
+        return bool(self._pending or self._active or self._inflight)
+
+    # tpu-lint: host-boundary -- synchronous drive of the pump loop
+    def drain(self) -> None:
+        """Pump until every submitted request has retired (what
+        ``engine.run()`` does); leaves the pool gauges fresh."""
+        while self.pump():
+            pass
+        self._occ.set(0)
+        kv_pool.observe_pool(self.engine.cache, labels=self.engine.obs_labels)
+
+    def start(self) -> None:
+        """Run the pump on a background thread until ``stop()``; a pump
+        failure (e.g. deadlock) marks every live handle failed and is
+        re-raised by later ``submit`` calls."""
+        if self._thread is not None:
+            raise RuntimeError("frontend already started")
+        self._stop_evt.clear()
+
+        def loop():
+            try:
+                while not self._stop_evt.is_set():
+                    if not self.pump():
+                        self._work_evt.clear()
+                        self._occ.set(0)
+                        self._work_evt.wait(timeout=0.01)
+            except BaseException as exc:          # noqa: BLE001
+                with self._ingest_lock:
+                    # publish the failure and claim the ingest queue
+                    # atomically — submit() re-checks under this lock
+                    self._failure = exc
+                    victims = list(self._ingest)
+                    self._ingest.clear()
+                victims += list(self._pending) + list(self._active.values())
+                for entry in victims:
+                    entry.handle._fail(exc)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-frontend-pump")
+        self._thread.start()
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop the background pump thread (in-flight device work is
+        left to complete; pending requests stay queued)."""
+        if self._thread is None:
+            return
+        self._stop_evt.set()
+        self._work_evt.set()
+        self._thread.join(timeout)
+        self._thread = None
+
+    # --- device chunk dispatch/harvest --------------------------------------
+
+    def _dispatch(self) -> None:
+        eng = self.engine
+        self._chunk += 1
+        busy = sum(1 for e in self._active.values()
+                   if e.joined <= self._chunk)
+        self._C["busy_slot_steps"].inc(busy * eng.sync_every)
+        self._C["decode_steps"].inc(eng.sync_every)
+        t0 = self.clock()
+        (eng.cache, self._tok, self._done, self._n_left, self._samp_i,
+         toks) = eng._step_fn()(eng.cache, eng.variables, self._tok,
+                                self._done, self._n_left, self._req_keys,
+                                self._samp_i)
+        self._inflight = _Chunk(toks, self._chunk, t0)
+        self.peak_slots = max(self.peak_slots, len(self._active))
+        self._occ.set(len(self._active))
+
+    def _materialize(self, chunk: _Chunk) -> np.ndarray:
+        """Block for the chunk's tokens (overlapping whatever device
+        work was queued after it) and stamp its completion time, once —
+        idempotent, so the earliest host sync that implies the chunk is
+        done (harvest, or an admission's pool read) fixes the
+        measurement before unrelated host work can inflate it."""
+        if chunk.toks_np is None:
+            chunk.toks_np = np.asarray(chunk.toks)
+            chunk.t_done = self.clock()
+        return chunk.toks_np
+
+    def _harvest(self, chunk: _Chunk) -> None:
+        eng = self.engine
+        toks_np = self._materialize(chunk)
+        step_ms = (chunk.t_done - chunk.t0) * 1e3 / eng.sync_every
+        self._H["decode_step_ms"].observe(step_ms)
+        self._per_run["decode_step_ms"].append(step_ms)
+        eos = eng.eos_token_id
+        for slot in list(self._active):
+            entry = self._active[slot]
+            if entry.handle.cancelled:
+                self._retire(slot, cancelled=True)
+                self._done = self._done.at[slot].set(True)
+                continue
+            if entry.joined > chunk.idx:
+                continue                 # admitted after this chunk ran
+            finished = False
+            for t in toks_np[:, slot]:
+                t = int(t)
+                entry.seg_tokens.append(t)
+                entry.handle._push(t)
+                if ((eos is not None and t == eos)
+                        or entry.generated >= entry.total_new):
+                    finished = True
+                    break
+            if finished:
+                self._retire(slot)
+                self._done = self._done.at[slot].set(True)
+
+    def _flush(self) -> None:
+        """Synchronize the pipeline: harvest the in-flight chunk (if
+        any) so every active record's token state is current — the
+        precondition for a correct preemption spill."""
+        prev, self._inflight = self._inflight, None
+        if prev is not None:
+            self._harvest(prev)
+
+    # --- retirement / preemption --------------------------------------------
+
+    def _release_pages(self, slot: int, entry: _Entry) -> None:
+        """Return slot ``slot``'s pages with the prefix-cache disposition:
+        full written pages (prompt + fed tokens) move into the radix tree
+        (so a later match — including this request's own resume — hits),
+        the partial tail frees; without a prefix cache everything
+        frees."""
+        eng = self.engine
+        if eng.prefix is None:
+            eng.cache = eng._free_jit(eng.cache, jnp.int32(slot))
+            return
+        # written K/V = prompt + every token fed while alive (all but the
+        # final sampled token); only full pages of that are shareable
+        written = entry.s0 + len(entry.seg_tokens) - 1
+        seq = np.concatenate(
+            [entry.prompt, np.asarray(entry.seg_tokens[:-1], np.int32)])
+        row = np.asarray(eng.cache["block_tables"][slot])
+        keep = eng.prefix.release_and_insert(seq, written, entry.nodes, row)
+        eng.cache = eng._release_jit(eng.cache, jnp.int32(slot),
+                                     jnp.asarray(keep))
+
+    def _observe_lifecycle(self, idx) -> None:
+        life = self.tracer.lifecycle(idx)
+        for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
+            if name in life:
+                self._H[name].observe(life[name])
+                self._per_run[name].append(life[name])
+
+    def _retire(self, slot: int, *, cancelled: bool = False) -> None:
+        eng = self.engine
+        entry = self._active.pop(slot)
+        output = np.asarray(entry.prev + entry.seg_tokens, np.int32)
+        self._C["retired"].inc()
+        n_seg = len(entry.seg_tokens)
+        self.tracer.end(entry.idx, "decode", new_tokens=n_seg)
+        self.tracer.event(entry.idx, "retire", slot=slot,
+                          new_tokens=int(output.shape[0]),
+                          cancelled=cancelled)
+        eng.events.emit("cancel" if cancelled else "retire",
+                        request=entry.idx, slot=slot,
+                        new_tokens=int(output.shape[0]))
+        self._observe_lifecycle(entry.idx)
+        self._release_pages(slot, entry)
+        self._pool_dirty = True
+        entry.handle._finish(output)
+
+    def _preempt(self, slot: int) -> None:
+        """Stop the victim at this (flushed) sync boundary, spill its
+        full pages into the prefix cache, and requeue it for resumption
+        with its generated tokens folded into the prompt — the resume
+        admission re-prefills only the uncached tail."""
+        eng = self.engine
+        entry = self._active.pop(slot)
+        self.tracer.end(entry.idx, "decode",
+                        new_tokens=len(entry.seg_tokens))
+        self.tracer.begin(entry.idx, "preempted")
+        self.tracer.event(entry.idx, "preempt", slot=slot,
+                          generated=entry.generated)
+        self._C["preemptions"].inc()
+        eng.events.emit("preempt", request=entry.idx, slot=slot,
+                        generated=entry.generated)
+        self._release_pages(slot, entry)
+        self._pool_dirty = True
+        self._done = self._done.at[slot].set(True)
+        # fold the segment into the entry: the resume prompt carries
+        # every generated token (incl. the never-written last one — its
+        # K/V re-prefills), the budget shrinks by what was delivered
+        entry.prompt = np.concatenate(
+            [entry.prompt, np.asarray(entry.seg_tokens, np.int32)])
+        entry.prev = entry.prev + entry.seg_tokens
+        entry.seg_tokens = []
+        entry.nodes = []
+        entry.resume = True
+        self._pending.append(entry)
+
+    def _maybe_preempt(self, candidate: _Entry, now: float) -> bool:
+        """Try to free a slot (and spill pages) for a blocked
+        ``candidate``. True when the boundary state changed (a victim
+        was preempted, or the flush itself retired slots) — the caller
+        retries the candidate's admission."""
+        eng = self.engine
+        if not self.policy.wants_preempt(candidate, now):
+            return False
+        # a candidate the whole pool cannot hold is a deadlock, not a
+        # preemption target — don't kill running work for it
+        need_total = kv_pool.pages_for(candidate.s0 + candidate.seg_new,
+                                       eng.page_size)
+        if need_total > kv_pool.num_pages_of(eng.cache) - 1:
+            return False
+        victim_slot = self.policy.select_victim(candidate, self._active,
+                                                now)
+        if victim_slot is None:
+            return False
+        n_active = len(self._active)
+        self._flush()                    # victim state must be current
+        if victim_slot not in self._active:
+            return True                  # the flush retired it — retry
+        if len(self._active) < n_active and any(
+                s not in self._active for s in range(eng.num_slots)):
+            return True                  # flush freed another slot
+        self._preempt(victim_slot)
+        return True
+
+    # --- admission ----------------------------------------------------------
+
+    def _try_admit(self, entry: _Entry, slot: int, now: float) -> bool:
+        """Admit ``entry`` into vacant ``slot`` if the pool can hold it
+        (evicting/defragging as needed); False defers it (head-of-line:
+        the caller stops the admission pass). Mirrors the engine's
+        original admission exactly, plus the resume path: a resume's
+        prefix match is NOT floored to a power of two pages — its depth
+        is its own written length (already page-quantized), so the full
+        spilled prefix is reused and only the ≤ one-page tail
+        re-prefills."""
+        eng = self.engine
+        tr = self.tracer
+        cfg, ps = eng.cfg, eng.page_size
+        max_pages = eng.cache["block_tables"].shape[1]
+        prompt, s0, idx = entry.prompt, entry.s0, entry.idx
+        need_total = kv_pool.pages_for(s0 + entry.seg_new, ps)
+        # prefix match BEFORE the page check: matched pages are shared,
+        # not allocated, so they shrink the demand. Acquire immediately —
+        # eviction below must see them pinned, not as LRU victims
+        nodes = eng.prefix.match(prompt) if eng.prefix is not None else []
+        if not entry.resume:
+            nodes = nodes[:_bucket_match_pages(len(nodes))]
+        if nodes:
+            eng.prefix.acquire(nodes)
+        m = len(nodes)
+        need = need_total - m
+        # the pool read below waits for everything queued on the stream —
+        # including the in-flight chunk; stamp its completion FIRST so
+        # decode_step_ms never charges admission work to the chunk
+        if self._inflight is not None:
+            self._materialize(self._inflight)
+        free = int(kv_pool.free_page_count(eng.cache))
+        if free < need and eng.prefix is not None:
+            pages = eng.prefix.evict(need - free)
+            if pages:
+                row = np.zeros((max_pages,), np.int32)
+                row[:len(pages)] = pages
+                eng.cache = eng._evict_jit(eng.cache, jnp.asarray(row),
+                                           jnp.int32(len(pages)))
+                self._C["evicted_pages"].inc(len(pages))
+                eng.events.emit("evict", request=idx, pages=len(pages))
+                free += len(pages)
+        if free < need and eng._leak_suspected(free, self._active):
+            eng._defrag_now()
+            self._C["defrag_runs"].inc()
+            eng.events.emit("defrag", request=idx)
+            free = int(kv_pool.free_page_count(eng.cache))
+        if free < need:
+            if nodes:
+                eng.prefix.release(nodes)
+            self._C["deferred_admissions"].inc()
+            eng.events.emit("defer", request=idx, need_pages=need,
+                            free_pages=free)
+            return False
+        if entry.resume:
+            tr.end(idx, "preempted")
+            tr.event(idx, "resume", slot=slot, cached_pages=m,
+                     resumed_at=entry.generated)
+            self._C["resumes"].inc()
+            eng.events.emit("resume", request=idx, slot=slot,
+                            cached_pages=m)
+        tr.event(idx, "admit", slot=slot, free_pages=free, cached_pages=m)
+        req_key = jax.random.fold_in(eng.rng, idx)
+        samp0 = len(entry.prev)          # resume continues the key stream
+        # prefill span: covers the admission program AND the first-token
+        # sync — its end IS the first token's arrival
+        with tr.span(idx, "prefill", cached_tokens=m * ps,
+                     computed_tokens=s0 - m * ps):
+            if m == 0:
+                admit_fn, bucket = self.admission_program(s0)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :s0] = prompt
+                eng.cache, tok0 = admit_fn(
+                    eng.cache, eng.variables, jnp.asarray(ids),
+                    jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                    req_key, jnp.int32(samp0))
+            else:
+                self._C["prefix_hits"].inc()
+                t_start = m * ps
+                tail_bucket = min(round_up(s0 - t_start, ps),
+                                  cfg.max_position_embeddings - t_start)
+                ids = np.zeros((1, tail_bucket), np.int32)
+                ids[0, :s0 - t_start] = prompt[t_start:]
+                row = np.zeros((max_pages,), np.int32)
+                row[:m] = [n.page for n in nodes]
+                eng.cache, tok0 = eng._admit_shared_fn(
+                    t_start, tail_bucket)(
+                    eng.cache, eng.variables, jnp.asarray(ids),
+                    jnp.int32(s0), jnp.int32(slot), jnp.asarray(row),
+                    jnp.int32(need), req_key, jnp.int32(samp0))
+            tok0 = int(tok0)
+        if not entry.first_token_seen:
+            entry.first_token_seen = True
+            tr.event(idx, "first_token", slot=slot)
+            # the TTFT SLO check, exactly once per request — a resume's
+            # re-admission never re-counts
+            if (entry.deadline_at is not None
+                    and self.clock() > entry.deadline_at):
+                self._C["deadline_misses"].inc()
+                tr.event(idx, "deadline_miss")
+                eng.events.emit("deadline_miss", request=idx)
+        tr.begin(idx, "decode", slot=slot)
+        self._C["admitted"].inc()
+        self._C["prefill_tokens_total"].inc(s0)
+        self._C["prefill_tokens_computed"].inc(s0 - m * ps)
+        eng.events.emit("admit", request=idx, slot=slot, prompt_tokens=s0,
+                        cached_tokens=m * ps, priority=entry.priority)
+        entry.nodes = nodes
+        entry.n_private = need
+        entry.seg_tokens = [tok0]
+        entry.joined = self._chunk + 1
+        self._active[slot] = entry
+        entry.handle._push(tok0)
+        self._pool_dirty = True
+        if ((eng.eos_token_id is not None and tok0 == eng.eos_token_id)
+                or entry.seg_new == 1):
+            self._retire(slot)
+            return True
+        self._tok = self._tok.at[slot].set(tok0)
+        self._done = self._done.at[slot].set(False)
+        self._n_left = self._n_left.at[slot].set(entry.seg_new - 1)
+        self._samp_i = self._samp_i.at[slot].set(samp0 + 1)
+        self._req_keys = self._req_keys.at[slot].set(req_key)
+        return True
+
+    def _admission(self) -> int:
+        """Fill vacant slots from the policy-ordered pending queue;
+        preempt for the head when the policy demands it. Head-of-line
+        blocking is preserved inside the order: if the most urgent
+        pending request cannot get pages, nothing behind it jumps the
+        queue (the engine's original FIFO fairness, generalized to the
+        policy order)."""
+        eng = self.engine
+        now = self.clock()
+        self._pending.sort(key=lambda e: self.policy.sort_key(e, now))
+        admitted = 0
+        preempts_left = eng.num_slots    # bound the preempt-retry loop
+        while self._pending:
+            entry = self._pending[0]
+            if entry.handle.cancelled:
+                self._pending.pop(0)
+                eng.events.emit("cancel", request=entry.idx, queued=True)
+                entry.handle._finish(
+                    np.asarray(entry.prev, np.int32))
+                continue
+            free_slots = [s for s in range(eng.num_slots)
+                          if s not in self._active]
+            if not free_slots:
+                if preempts_left > 0 and self._maybe_preempt(entry, now):
+                    preempts_left -= 1
+                    continue
+                break
+            if self._try_admit(entry, free_slots[0], now):
+                self._pending.pop(0)
+                admitted += 1
+                continue
+            # page-short: preemption can spill a lower-priority slot's
+            # pages (they become evictable cached pages) — retry once
+            # per victim, then defer head-of-line
+            if preempts_left > 0 and self._maybe_preempt(entry, now):
+                preempts_left -= 1
+                continue
+            break
+        return admitted
+
+    # --- run-scoped stats ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """The engine-stats dict for this frontend's lifetime so far —
+        counter deltas since construction plus run-local latency
+        percentiles (the same shape ``engine.run()`` has always
+        returned, grown by the frontend counters). Records every numeric
+        stat as a ``serving.<name>`` raw series — call once per run."""
+        eng = self.engine
+        d = {name: c.value - self._c0[name] for name, c in self._C.items()}
+        stats = {
+            "decode_steps": int(d["decode_steps"]),
+            "admitted": int(d["admitted"]),
+            "retired": int(d["retired"]),
+            "peak_slots_in_use": self.peak_slots,
+            "slot_occupancy": (d["busy_slot_steps"]
+                               / max(d["decode_steps"] * eng.num_slots,
+                                     1)),
+            "deferred_admissions": int(d["deferred_admissions"]),
+            "defrag_runs": int(d["defrag_runs"]),
+            "preemptions": int(d["preemptions"]),
+            "resumes": int(d["resumes"]),
+            "deadline_misses": int(d["deadline_misses"]),
+            "peak_queue_depth": self.peak_queue_depth,
+            "prefix_cache_enabled": eng.prefix is not None,
+            "prefix_hits": int(d["prefix_hits"]),
+            "prefix_hit_rate": d["prefix_hits"] / max(d["admitted"], 1),
+            "prefix_cached_pages": (len(eng.prefix)
+                                    if eng.prefix is not None else 0),
+            "evicted_pages": int(d["evicted_pages"]),
+            "prefill_tokens_total": int(d["prefill_tokens_total"]),
+            "prefill_tokens_computed": int(d["prefill_tokens_computed"]),
+            "prefill_tokens_skipped": int(d["prefill_tokens_total"]
+                                          - d["prefill_tokens_computed"]),
+        }
+        # run-local latency percentiles (the global histograms hold the
+        # engine-lifetime distributions; these are exact per run)
+        for name, vals in self._per_run.items():
+            if vals:
+                stats[f"{name}_p50"] = float(np.percentile(vals, 50))
+                stats[f"{name}_p95"] = float(np.percentile(vals, 95))
+        for name, val in stats.items():
+            if isinstance(val, bool):
+                continue
+            metrics.record(f"serving.{name}", val)
+        return stats

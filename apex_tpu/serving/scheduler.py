@@ -24,6 +24,15 @@ outputs are SCHEDULING-INVARIANT (they depend on the request and the key,
 not on which slot or step the request landed in — stronger than lock-step,
 whose draws change with batch composition).
 
+The host scheduling loop itself lives in ``serving/frontend.py``
+(:class:`~apex_tpu.serving.frontend.ServingFrontend`): streaming ingest,
+priority/deadline admission (``serving/policy.py``), page-spilling
+preemption, and a pump that overlaps host-side retirement/admission work
+with the next jitted decode chunk. :meth:`PagedDecodeEngine.run` is a
+thin closed-loop wrapper over that frontend — this module owns the
+engine STATE (pool, prefix cache, compiled admit/step programs,
+observability identity) the frontend drives.
+
 ``prefix_cache=True`` adds cross-request KV reuse (RadixAttention, Zheng
 et al. 2023; ``serving/prefix_cache.py``): admission walks a radix tree
 of cached full pages, points the slot's block table at the matched pages
@@ -44,9 +53,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import itertools
-import time
-from collections import deque
-from typing import Any, List, Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +68,6 @@ from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.serving import kv_pool
 from apex_tpu.serving.prefix_cache import PrefixCache
-from apex_tpu.utils import metrics
 
 #: run() counters in the instrument registry (``serving.<name>``); the
 #: per-run stats dict is the DELTA of these across the run — the registry
@@ -69,7 +75,8 @@ from apex_tpu.utils import metrics
 _RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
                  "prefix_hits", "prefill_tokens_total",
                  "prefill_tokens_computed", "evicted_pages",
-                 "deferred_admissions", "defrag_runs")
+                 "deferred_admissions", "defrag_runs",
+                 "preemptions", "resumes", "deadline_misses")
 
 #: per-request latency histograms (``serving.<name>``, log-bucketed ms)
 _RUN_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "decode_step_ms")
@@ -80,10 +87,32 @@ _ENGINE_IDS = itertools.count()
 
 @dataclasses.dataclass
 class Request:
-    """One decode request: a 1-D int32 prompt and its token budget."""
+    """One decode request: a 1-D int32 prompt and its token budget, plus
+    the serving front-end's optional scheduling fields (defaults keep
+    every pre-frontend call site constructing unchanged).
+
+    - ``priority``: scheduling class, larger int = more important. The
+      front-end serves the pending queue highest-priority first and may
+      preempt a strictly-lower-priority RUNNING request for a blocked
+      higher-priority one (``serving/policy.py``). 0 (the default) is
+      plain FIFO traffic.
+    - ``deadline_ms``: a TTFT service-level objective — the request
+      should see its first token within ``deadline_ms`` of its arrival.
+      Breaks ties inside a priority class (earliest deadline first) and
+      arms preemption when the request would otherwise sit blocked past
+      it. Missing the deadline never drops the request; misses are
+      counted (``serving.deadline_misses``). None = no SLO.
+    - ``arrival_time``: when the request entered the system, in the
+      monotonic ``time.perf_counter`` timebase (NOT wall clock) so
+      deadlines survive clock steps. None = stamped at ``submit()``;
+      trace replays pass explicit values.
+    """
 
     prompt: Any                      # (s0,) int array
     max_new_tokens: int
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    arrival_time: Optional[float] = None
 
 
 def _donate_cache():
@@ -133,20 +162,23 @@ def make_shared_admit(model, *, t_start: int, tail_bucket: int,
     prompt-final logits.
 
     Returns ``admit(cache, variables, tail_ids, s0, slot, shared_row,
-    n_private, req_key) -> (cache, tok0)`` where ``shared_row`` is a
-    ``(max_pages,)`` int32 row whose first ``t_start/page_size`` entries
-    are the matched physical pages."""
+    n_private, req_key, samp0=0) -> (cache, tok0)`` where ``shared_row``
+    is a ``(max_pages,)`` int32 row whose first ``t_start/page_size``
+    entries are the matched physical pages and ``samp0`` is the sampled
+    first token's index in the request's key stream (nonzero only for a
+    preemption resume, which continues the stream where the preempted
+    segment stopped — scheduling invariance holds across preemption)."""
     cfg = model.config
     if t_start < 1 or tail_bucket < 1:
         raise ValueError("shared admission needs t_start >= 1 matched "
                          "tokens and tail_bucket >= 1 tail tokens")
     if first_token is None:
-        def first_token(last, _key):
+        def first_token(last, _key, _samp0=0):
             return _greedy_token(last, axis_name)
     bucket = t_start + tail_bucket
 
     def admit(cache, variables, tail_ids, s0, slot, shared_row, n_private,
-              req_key):
+              req_key, samp0=0):
         ps = kv_pool.page_size_of(cache)
         if t_start % ps:
             raise ValueError(f"t_start={t_start} must be a page multiple "
@@ -177,7 +209,7 @@ def make_shared_admit(model, *, t_start: int, tail_bucket: int,
                                           n_private)
         cache = kv_pool.prefill_into_pages(cache, slot, contig["layers"],
                                            s0, start=t_start)
-        tok0 = first_token(last, req_key)[0]
+        tok0 = first_token(last, req_key, samp0)[0]
         return cache, tok0
 
     return admit
@@ -253,10 +285,15 @@ class PagedDecodeEngine:
 
     # --- request-key sampling (scheduling-invariant streams) ----------------
 
-    def _first_token(self, last_logits, req_key):
+    def _first_token(self, last_logits, req_key, samp0=0):
+        # ``samp0``: the token's index in the request's fold_in key
+        # stream — 0 at a cold admission, the resume point after a
+        # preemption (so preempted/resumed sampled decode draws the SAME
+        # stream as an uninterrupted run: scheduling invariance)
         if not self.temperature:
             return _greedy_token(last_logits, self.axis_name)
-        return _sample_token(last_logits, jax.random.fold_in(req_key, 0),
+        return _sample_token(last_logits,
+                             jax.random.fold_in(req_key, samp0),
                              temperature=self.temperature, top_k=self.top_k,
                              top_p=self.top_p, axis_name=self.axis_name)
 
@@ -269,14 +306,15 @@ class PagedDecodeEngine:
             return self._admit_jit[bucket]
         model = self.model                       # static via closure
 
-        def admit(cache, variables, ids, s0, slot, n_pages, req_key):
+        def admit(cache, variables, ids, s0, slot, n_pages, req_key,
+                  samp0=0):
             contig = init_cache(self.cfg, 1, bucket)
             logits, contig = model.apply(variables, ids, cache=contig)
             last = lax.dynamic_slice_in_dim(logits, s0 - 1, 1, axis=1)[:, 0]
             cache = kv_pool.alloc_slot(cache, slot, n_pages)
             cache = kv_pool.prefill_into_pages(cache, slot,
                                                contig["layers"], s0)
-            tok0 = self._first_token(last, req_key)[0]
+            tok0 = self._first_token(last, req_key, samp0)[0]
             return cache, tok0
 
         fn = jax.jit(admit, donate_argnums=_donate_cache())
@@ -302,8 +340,10 @@ class PagedDecodeEngine:
     def _leak_suspected(self, free: int, active) -> bool:
         """True when host liveness accounting says more pages should be
         free than the stack shows — a free miscount somewhere; ``defrag``
-        rebuilds the stack from actual liveness and recovers them."""
-        owned = sum(rec["n_private"] for rec in active.values())
+        rebuilds the stack from actual liveness and recovers them.
+        ``active``: the frontend's slot -> entry map (entries expose
+        ``n_private``, the pages the slot owns)."""
+        owned = sum(rec.n_private for rec in active.values())
         cached = len(self.prefix) if self.prefix is not None else 0
         usable = kv_pool.num_pages_of(self.cache) - 1    # null page
         return usable - owned - cached > free
@@ -377,9 +417,41 @@ class PagedDecodeEngine:
 
     # --- the host scheduling loop -------------------------------------------
 
+    def _validate_request(self, r: Request) -> None:
+        """Reject a request the engine could never serve (position-table
+        overflow, block-table overflow, empty budget) — raised at
+        ``submit()``/``run()`` time, before any device work."""
+        cfg, ps = self.cfg, self.page_size
+        max_pages = self.cache["block_tables"].shape[1]
+        s0 = int(np.asarray(r.prompt).shape[0])
+        if r.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if s0 + r.max_new_tokens > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({s0}) + max_new_tokens ({r.max_new_tokens}) "
+                f"exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        if kv_pool.pages_for(s0 + r.max_new_tokens, ps) > max_pages:
+            raise ValueError(
+                f"request needs more than max_pages_per_seq="
+                f"{max_pages} pages")
+
     def run(self, requests: Sequence[Request], *,
-            tracer: Optional[SpanTracer] = None):
+            tracer: Optional[SpanTracer] = None, policy=None):
         """Drain the request queue; returns ``(outputs, stats)``.
+
+        A thin closed-loop wrapper over the serving front-end
+        (``serving/frontend.py``): every request is submitted to a fresh
+        :class:`~apex_tpu.serving.frontend.ServingFrontend` (so its
+        tracer and stats are run-scoped) and the pump is driven
+        synchronously until the queue drains — ``run()`` therefore
+        exercises exactly the code path a streaming server does,
+        including the pipelined decode-chunk pump and, when ``policy``
+        enables it and requests carry priorities/deadlines, preemption.
+        ``policy`` defaults to
+        :class:`~apex_tpu.serving.policy.PriorityDeadlinePolicy`, which
+        on plain requests (priority 0, no deadlines) reduces to the
+        engine's original FIFO order and never preempts.
 
         ``outputs[i]``: np.int32 generated tokens for request ``i`` —
         length ``max_new_tokens``, or shorter when the request hit EOS
@@ -391,290 +463,35 @@ class PagedDecodeEngine:
         counters (``prefix_hits``, ``prefix_hit_rate``,
         ``prefill_tokens_{total,computed,skipped}``, ``evicted_pages``,
         ``prefix_cached_pages``), the maintenance counters
-        (``deferred_admissions``, ``defrag_runs``), and this run's
-        latency percentiles (``ttft_ms_p50/p95``, ``tpot_ms_p50/p95``,
+        (``deferred_admissions``, ``defrag_runs``), the frontend
+        counters (``preemptions``, ``resumes``, ``deadline_misses``,
+        ``peak_queue_depth``), and this run's latency percentiles
+        (``ttft_ms_p50/p95``, ``tpot_ms_p50/p95``,
         ``queue_wait_ms_p50/p95``, ``decode_step_ms_p50/p95``). Every
         numeric stat is also recorded as a ``serving.<name>`` raw series.
 
         Per-request lifecycle spans (enqueue → admit → prefill →
-        first_token → decode → retire) land in a fresh
-        :class:`~apex_tpu.obs.spans.SpanTracer` kept as ``self.tracer``
-        (pass ``tracer=`` to supply your own); scheduling events append
-        to the engine-lifetime ``self.events`` ring
-        (docs/observability.md).
+        first_token → decode → [preempt → preempted → resume →] retire)
+        land in a fresh :class:`~apex_tpu.obs.spans.SpanTracer` kept as
+        ``self.tracer`` (pass ``tracer=`` to supply your own);
+        scheduling events append to the engine-lifetime ``self.events``
+        ring (docs/observability.md).
         """
-        cfg, ps = self.cfg, self.page_size
-        max_pages = self.cache["block_tables"].shape[1]
+        # the frontend lives below the engine module (it drives the
+        # engine's compiled programs); import here to avoid the cycle
+        from apex_tpu.serving.frontend import ServingFrontend
+
+        # validate the whole batch up front: a bad request raises before
+        # any of its siblings start (the pre-frontend contract)
         for r in requests:
-            s0 = int(np.asarray(r.prompt).shape[0])
-            if r.max_new_tokens < 1:
-                raise ValueError("max_new_tokens must be >= 1")
-            if s0 + r.max_new_tokens > cfg.max_position_embeddings:
-                raise ValueError(
-                    f"prompt ({s0}) + max_new_tokens ({r.max_new_tokens}) "
-                    f"exceeds max_position_embeddings="
-                    f"{cfg.max_position_embeddings}")
-            if kv_pool.pages_for(s0 + r.max_new_tokens, ps) > max_pages:
-                raise ValueError(
-                    f"request needs more than max_pages_per_seq="
-                    f"{max_pages} pages")
-
-        tr = tracer if tracer is not None else SpanTracer()
-        self.tracer = tr
-        C = {n: metrics.counter(f"serving.{n}", labels=self.obs_labels)
-             for n in _RUN_COUNTERS}
-        c0 = {n: C[n].value for n in C}   # run-start snapshot -> deltas
-        H = {n: metrics.histogram(f"serving.{n}", labels=self.obs_labels)
-             for n in _RUN_HISTOGRAMS}
-        occ_gauge = metrics.gauge("serving.slots_in_use",
-                                  labels=self.obs_labels)
-        per_run = {n: [] for n in _RUN_HISTOGRAMS}
-
-        queue = deque(enumerate(requests))
-        for idx, req in queue:
-            # np.shape reads the length without a device->host transfer
-            tr.event(idx, "enqueue",
-                     prompt_tokens=int(np.shape(req.prompt)[0]),
-                     max_new_tokens=req.max_new_tokens)
-        outputs: List[Optional[np.ndarray]] = [None] * len(requests)
-        active = {}                       # slot -> mutable request record
-        tok = jnp.zeros((self.num_slots,), jnp.int32)
-        done = jnp.ones((self.num_slots,), bool)
-        n_left = jnp.zeros((self.num_slots,), jnp.int32)
-        samp_i = jnp.zeros((self.num_slots,), jnp.int32)
-        req_keys = jnp.broadcast_to(self.rng, (self.num_slots,)
-                                    + self.rng.shape)
-        peak = 0
-
-        def observe_lifecycle(idx):
-            life = tr.lifecycle(idx)
-            for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
-                if name in life:
-                    H[name].observe(life[name])
-                    per_run[name].append(life[name])
-
-        def retire(slot):
-            rec = active.pop(slot)
-            outputs[rec["idx"]] = np.asarray(rec["tokens"], np.int32)
-            C["retired"].inc()
-            n_new = len(rec["tokens"])
-            tr.end(rec["idx"], "decode", new_tokens=n_new)
-            tr.event(rec["idx"], "retire", slot=slot, new_tokens=n_new)
-            self.events.emit("retire", request=rec["idx"], slot=slot,
-                             new_tokens=n_new)
-            observe_lifecycle(rec["idx"])
-            if self.prefix is None:
-                self.cache = self._free_jit(self.cache, jnp.int32(slot))
-                return
-            # written K/V = prompt + every token fed while alive (all but
-            # the final sampled token, which retires before its step);
-            # only full pages of that enter the tree — the partial
-            # boundary page (and the frozen-done garbage position right
-            # at ``written``) never becomes shareable
-            written = rec["s0"] + len(rec["tokens"]) - 1
-            seq = np.concatenate(
-                [rec["prompt"], np.asarray(rec["tokens"][:-1], np.int32)])
-            row = np.asarray(self.cache["block_tables"][slot])
-            keep = self.prefix.release_and_insert(seq, written,
-                                                  rec["nodes"], row)
-            self.cache = self._release_jit(self.cache, jnp.int32(slot),
-                                           jnp.asarray(keep))
-
-        while queue or active:
-            # --- admission: fill vacant slots while pages last ----------
-            free_slots = [s for s in range(self.num_slots)
-                          if s not in active]
-            admitted_any = False
-            for slot in free_slots:
-                if not queue:
-                    break
-                idx, req = queue[0]
-                prompt = np.asarray(req.prompt, np.int32).reshape(-1)
-                s0 = prompt.shape[0]
-                need_total = kv_pool.pages_for(s0 + req.max_new_tokens, ps)
-                # prefix match BEFORE the page check: matched pages are
-                # shared, not allocated, so they shrink the demand.
-                # Acquire immediately — the eviction below must see the
-                # matched nodes as pinned, not as LRU victims
-                nodes = (self.prefix.match(prompt)
-                         if self.prefix is not None else [])
-                # bucket the match depth (compile-count bound); the
-                # dropped tail of the match re-prefills and dedups back
-                # into the tree at retirement
-                nodes = nodes[:_bucket_match_pages(len(nodes))]
-                if nodes:
-                    self.prefix.acquire(nodes)
-                m = len(nodes)
-                need = need_total - m
-                free = int(kv_pool.free_page_count(self.cache))
-                if free < need and self.prefix is not None:
-                    # replenish the stack: LRU refcount-0 cached pages
-                    pages = self.prefix.evict(need - free)
-                    if pages:
-                        row = np.zeros((max_pages,), np.int32)
-                        row[:len(pages)] = pages
-                        self.cache = self._evict_jit(
-                            self.cache, jnp.asarray(row),
-                            jnp.int32(len(pages)))
-                        C["evicted_pages"].inc(len(pages))
-                        self.events.emit("evict", request=idx,
-                                         pages=len(pages))
-                        free += len(pages)
-                if free < need and self._leak_suspected(free, active):
-                    # liveness says more pages exist than the stack shows:
-                    # compact + rebuild the stack, remap the radix tree
-                    self._defrag_now()
-                    C["defrag_runs"].inc()
-                    self.events.emit("defrag", request=idx)
-                    free = int(kv_pool.free_page_count(self.cache))
-                if free < need:
-                    if nodes:
-                        self.prefix.release(nodes)
-                    C["deferred_admissions"].inc()
-                    self.events.emit("defer", request=idx, need_pages=need,
-                                     free_pages=free)
-                    break                 # head-of-line: wait for pages
-                queue.popleft()
-                tr.event(idx, "admit", slot=slot, free_pages=free,
-                         cached_pages=m)
-                req_key = jax.random.fold_in(self.rng, idx)
-                # prefill span: covers the admission program AND the
-                # first-token sync — its end IS the first token's arrival
-                with tr.span(idx, "prefill", cached_tokens=m * ps,
-                             computed_tokens=s0 - m * ps):
-                    if m == 0:
-                        bucket = prompt_bucket(
-                            s0, ps, cfg.max_position_embeddings)
-                        ids = np.zeros((1, bucket), np.int32)
-                        ids[0, :s0] = prompt
-                        self.cache, tok0 = self._admit_fn(bucket)(
-                            self.cache, self.variables, jnp.asarray(ids),
-                            jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
-                            req_key)
-                    else:
-                        C["prefix_hits"].inc()
-                        t_start = m * ps
-                        tail_bucket = min(round_up(s0 - t_start, ps),
-                                          cfg.max_position_embeddings
-                                          - t_start)
-                        ids = np.zeros((1, tail_bucket), np.int32)
-                        ids[0, :s0 - t_start] = prompt[t_start:]
-                        row = np.zeros((max_pages,), np.int32)
-                        row[:m] = [n.page for n in nodes]
-                        self.cache, tok0 = self._admit_shared_fn(
-                            t_start, tail_bucket)(
-                            self.cache, self.variables, jnp.asarray(ids),
-                            jnp.int32(s0), jnp.int32(slot),
-                            jnp.asarray(row), jnp.int32(need), req_key)
-                    tok0 = int(tok0)
-                tr.event(idx, "first_token", slot=slot)
-                tr.begin(idx, "decode", slot=slot)
-                C["admitted"].inc()
-                C["prefill_tokens_total"].inc(s0)
-                C["prefill_tokens_computed"].inc(s0 - m * ps)
-                self.events.emit("admit", request=idx, slot=slot,
-                                 prompt_tokens=s0, cached_tokens=m * ps)
-                rec = {"idx": idx, "tokens": [tok0],
-                       "max_new": req.max_new_tokens, "prompt": prompt,
-                       "s0": s0, "nodes": nodes, "n_private": need}
-                active[slot] = rec
-                admitted_any = True
-                if (self.eos_token_id is not None
-                        and tok0 == self.eos_token_id) \
-                        or req.max_new_tokens == 1:
-                    retire(slot)
-                    continue
-                tok = tok.at[slot].set(tok0)
-                done = done.at[slot].set(False)
-                n_left = n_left.at[slot].set(req.max_new_tokens - 1)
-                samp_i = samp_i.at[slot].set(1)   # token 0 drawn at admit
-                req_keys = req_keys.at[slot].set(req_key)
-            if not active:
-                if queue and not admitted_any:
-                    raise RuntimeError(
-                        "scheduler deadlock: queued request cannot be "
-                        "admitted even with every slot vacant and every "
-                        "evictable cached page evicted (pool too small "
-                        "for its page demand?)")
-                continue
-            peak = max(peak, len(active))
-            occ_gauge.set(len(active))
-
-            # --- one jitted multi-step decode chunk ---------------------
-            C["busy_slot_steps"].inc(len(active) * self.sync_every)
-            t_chunk = time.perf_counter()
-            self.cache, tok, done, n_left, samp_i, toks = self._step_fn()(
-                self.cache, self.variables, tok, done, n_left, req_keys,
-                samp_i)
-            toks_np = np.asarray(toks)               # (sync_every, slots)
-            # per-step wall time, synced at the harvest (with
-            # sync_every > 1 this is the chunk's per-step mean)
-            step_ms = ((time.perf_counter() - t_chunk) * 1e3
-                       / self.sync_every)
-            H["decode_step_ms"].observe(step_ms)
-            per_run["decode_step_ms"].append(step_ms)
-            C["decode_steps"].inc(self.sync_every)
-
-            # --- harvest + retirement at the sync boundary --------------
-            n_retired_chunk = 0
-            for slot in list(active):
-                rec = active[slot]
-                finished = False
-                for t in toks_np[:, slot]:
-                    t = int(t)
-                    rec["tokens"].append(t)
-                    if ((self.eos_token_id is not None
-                         and t == self.eos_token_id)
-                            or len(rec["tokens"]) >= rec["max_new"]):
-                        finished = True
-                        break
-                if finished:
-                    retire(slot)
-                    done = done.at[slot].set(True)
-                    n_retired_chunk += 1
-
-            # pool health gauges (free pages, active sharing refcounts —
-            # docs/observability.md catalog): only at boundaries where
-            # the pool actually changed (admission/retirement), so
-            # steady decode-only chunks pay no extra device->host reads
-            if admitted_any or n_retired_chunk:
-                kv_pool.observe_pool(self.cache, labels=self.obs_labels)
-
-        # final state after the drain
-        kv_pool.observe_pool(self.cache, labels=self.obs_labels)
-        occ_gauge.set(0)
-        d = {n: C[n].value - c0[n] for n in C}   # this run's contribution
-        stats = {
-            "decode_steps": int(d["decode_steps"]),
-            "admitted": int(d["admitted"]),
-            "retired": int(d["retired"]), "peak_slots_in_use": peak,
-            "slot_occupancy": (d["busy_slot_steps"]
-                               / max(d["decode_steps"] * self.num_slots,
-                                     1)),
-            "deferred_admissions": int(d["deferred_admissions"]),
-            "defrag_runs": int(d["defrag_runs"]),
-            "prefix_cache_enabled": self.prefix is not None,
-            "prefix_hits": int(d["prefix_hits"]),
-            "prefix_hit_rate": d["prefix_hits"] / max(d["admitted"], 1),
-            "prefix_cached_pages": (len(self.prefix)
-                                    if self.prefix is not None else 0),
-            "evicted_pages": int(d["evicted_pages"]),
-            "prefill_tokens_total": int(d["prefill_tokens_total"]),
-            "prefill_tokens_computed": int(d["prefill_tokens_computed"]),
-            "prefill_tokens_skipped": int(d["prefill_tokens_total"]
-                                          - d["prefill_tokens_computed"]),
-        }
-        # this run's latency percentiles (the global histograms hold the
-        # engine-lifetime distributions; these are run-local and exact)
-        for name, vals in per_run.items():
-            if vals:
-                stats[f"{name}_p50"] = float(np.percentile(vals, 50))
-                stats[f"{name}_p95"] = float(np.percentile(vals, 95))
-        for name, val in stats.items():
-            if isinstance(val, bool):
-                continue
-            metrics.record(f"serving.{name}", val)
-        return outputs, stats
+            self._validate_request(r)
+        frontend = ServingFrontend(self, policy=policy, tracer=tracer)
+        handles = [frontend.submit(r, request_id=i)
+                   for i, r in enumerate(requests)]
+        frontend.drain()
+        outputs = [np.asarray(h.result(timeout=0), np.int32)
+                   for h in handles]
+        return outputs, frontend.stats()
 
 
 # the host scheduling loop driving the jitted admit/step programs;

@@ -228,10 +228,15 @@ EOF
 fi
 # decode-throughput harvest (beyond reference — no gate dependency beyond
 # the suite's flash/xentropy compiles; cheap: one small-model compile).
-# Emits three metrics: lock-step decode, paged continuous batching, and
-# prefix-cached serving (shared-system-prompt workload; the offline AOT
-# sweep above covers the matching compile evidence via the
-# gpt2s_prefix_cached_admit + paged_attention_gpt2s_decode cases)
+# Emits four metrics: lock-step decode, paged continuous batching,
+# prefix-cached serving (shared-system-prompt workload), and the async
+# serving FRONT-END under an open-loop Poisson arrival stream with
+# priorities/deadlines + a forced preemption/spill/resume burst
+# (gpt2_frontend_* TTFT/TPOT/deadline-miss fields; docs/frontend.md).
+# The offline AOT sweep above covers the matching compile evidence via
+# the gpt2s_prefix_cached_admit + paged_attention_gpt2s_decode cases,
+# and the IR lint registry traces the frontend's admission/decode-chunk
+# programs (gpt2s_frontend_*)
 if bench_done && [ ! -f "DECODE_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] decode-throughput bench (GPT-2 small KV cache)..."
   # APEX_TPU_METRICS_OUT: the bench dumps the full instrument registry

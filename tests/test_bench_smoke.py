@@ -48,10 +48,12 @@ def test_bench_sharded_over_8_cpu_devices():
 
 
 def test_decode_bench_smoke_emits_json(tmp_path):
-    """tpu_decode_bench.py in smoke mode prints three parseable JSON
-    records (lock-step, paged, prefix-cached), the paged record carries
-    the TTFT/decode-step percentile fields (ISSUE 4), and the metrics
-    snapshot artifact lands where APEX_TPU_METRICS_OUT points."""
+    """tpu_decode_bench.py in smoke mode prints four parseable JSON
+    records (lock-step, paged, prefix-cached, async frontend), the paged
+    record carries the TTFT/decode-step percentile fields (ISSUE 4), the
+    frontend record carries the open-loop TTFT/TPOT/deadline-miss fields
+    with preemptions > 0 under the adversarial burst (ISSUE 6), and the
+    metrics snapshot artifact lands where APEX_TPU_METRICS_OUT points."""
     env = dict(os.environ)
     env["APEX_TPU_DECODE_SMOKE"] = "1"
     snap_path = tmp_path / "metrics_snapshot.json"
@@ -85,6 +87,26 @@ def test_decode_bench_smoke_emits_json(tmp_path):
 
     pc = recs["gpt2_prefix_cached_decode_tokens_per_sec_per_chip"]
     assert pc["ttft_ms_p50"] > 0 and pc["decode_step_ms_p50"] > 0
+
+    # the async front-end's open-loop record (docs/frontend.md): TTFT /
+    # TPOT percentiles + deadline accounting parse, and the adversarial
+    # burst (slots pinned low-priority, high-priority arrival) actually
+    # exercised the preempt/spill/resume path
+    fe = recs["gpt2_frontend_decode_tokens_per_sec_per_chip"]
+    assert fe["value"] > 0
+    assert fe["gpt2_frontend_ttft_ms_p50"] > 0
+    assert (fe["gpt2_frontend_ttft_ms_p95"]
+            >= fe["gpt2_frontend_ttft_ms_p50"])
+    assert fe["gpt2_frontend_tpot_ms_p50"] > 0
+    assert (fe["gpt2_frontend_tpot_ms_p95"]
+            >= fe["gpt2_frontend_tpot_ms_p50"])
+    assert 0.0 <= fe["gpt2_frontend_deadline_miss_rate"] <= 1.0
+    assert (fe["gpt2_frontend_deadline_misses"]
+            <= fe["deadlined_requests"])
+    assert fe["preemptions"] > 0
+    assert fe["resumes"] > 0
+    assert fe["peak_queue_depth"] >= 1
+    assert fe["prefill_tokens_skipped"] > 0   # resume = a cache hit
 
     # the run_tpu_round.sh metrics artifact: a strict-JSON registry
     # snapshot holding the serving histograms

@@ -1,0 +1,406 @@
+"""Serving front-end (apex_tpu/serving/frontend.py + policy.py).
+
+Policy tier (no model): queue ordering (priority desc, EDF inside a
+class, FIFO tiebreak), victim selection (strictly-lower priority only,
+most recent first), preemption arming (margin/deadline semantics).
+
+Frontend tier (tiny GPT): the acceptance bars for preemption-by-spill —
+greedy outputs token-identical with preemption forced on vs off, the
+resumed request's re-admission skipping its FULL-page prefix via the
+radix cache, priority inversion bounded (a low-priority flood cannot
+starve a high-priority arrival past its deadline), streaming handles
+delivering tokens in order and terminating on EOS/cancel, and sampled
+decode staying scheduling-invariant ACROSS a preemption (the resume
+continues the request's fold_in key stream)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.generation import generate
+from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+from apex_tpu.serving import (PagedDecodeEngine, PriorityDeadlinePolicy,
+                              Request, free_page_count)
+from apex_tpu.serving.frontend import ServingFrontend
+from apex_tpu.utils import metrics
+
+
+def _model():
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, v
+
+
+def _refs(model, v, reqs, **kw):
+    return [np.asarray(generate(model, v, np.asarray(r.prompt)[None],
+                                max_new_tokens=r.max_new_tokens, **kw)
+                       )[0, np.asarray(r.prompt).shape[0]:]
+            for r in reqs]
+
+
+# --------------------------------------------------------------------------
+# policy (pure host logic)
+# --------------------------------------------------------------------------
+
+class _E:
+    """Minimal entry stand-in for policy unit tests."""
+
+    def __init__(self, priority=0, deadline_at=None, arrival=0.0, seq=0):
+        self.priority = priority
+        self.deadline_at = deadline_at
+        self.arrival = arrival
+        self.seq = seq
+
+
+def test_request_backcompat_defaults():
+    """The pre-frontend constructor shape still works; the scheduling
+    fields default to plain FIFO traffic."""
+    r = Request(prompt=np.zeros((4,), np.int32), max_new_tokens=3)
+    assert (r.priority, r.deadline_ms, r.arrival_time) == (0, None, None)
+    r2 = Request(np.zeros((4,), np.int32), 3)         # positional form
+    assert r2.max_new_tokens == 3 and r2.priority == 0
+
+
+def test_policy_ordering():
+    pol = PriorityDeadlinePolicy()
+    hi = _E(priority=2, arrival=3.0, seq=3)
+    edf = _E(priority=0, deadline_at=5.0, arrival=2.0, seq=2)
+    old = _E(priority=0, arrival=0.0, seq=0)
+    new = _E(priority=0, arrival=1.0, seq=1)
+    ordered = sorted([new, old, edf, hi],
+                     key=lambda e: pol.sort_key(e, now=0.0))
+    # priority first, then earliest deadline, then arrival FIFO
+    assert ordered == [hi, edf, old, new]
+
+
+def test_policy_victim_selection_and_arming():
+    pol = PriorityDeadlinePolicy(preempt_margin_ms=100.0)
+    active = {0: _E(priority=1, seq=0), 1: _E(priority=0, seq=1),
+              2: _E(priority=0, seq=2)}
+    cand = _E(priority=2, deadline_at=1.0)
+    # lowest priority wins; inside the class, the most recent admission
+    assert pol.select_victim(cand, active, now=0.0) == 2
+    # equal-or-higher priority never qualifies (no ping-pong)
+    assert pol.select_victim(_E(priority=0), active, now=0.0) is None
+    assert pol.select_victim(_E(priority=1), active,
+                             now=0.0) in (1, 2)       # only the 0s
+    # arming: inside the margin of the deadline, or past it
+    assert not pol.at_risk(_E(deadline_at=10.0), now=0.0)
+    assert pol.at_risk(_E(deadline_at=10.0), now=9.95)
+    assert pol.at_risk(_E(deadline_at=10.0), now=11.0)
+    assert not pol.wants_preempt(_E(), now=0.0)       # no deadline
+    assert PriorityDeadlinePolicy(preempt_on_priority=True).wants_preempt(
+        _E(), now=0.0)
+    assert not PriorityDeadlinePolicy(preemption=False).wants_preempt(
+        _E(deadline_at=0.0), now=1.0)
+
+
+# --------------------------------------------------------------------------
+# streaming handles
+# --------------------------------------------------------------------------
+
+def test_streaming_tokens_in_order_and_eos_termination(rng):
+    """Tokens arrive on the handle in generation order as the pump runs
+    and the stream terminates; a request ending at EOS includes it and
+    stops."""
+    import queue as queue_mod
+
+    cfg, model, v = _model()
+    prompt = rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+    ref = np.asarray(generate(model, v, prompt[None], max_new_tokens=6))
+    eos = int(ref[0, 10])                 # forces an EOS mid-budget
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8,
+                               eos_token_id=eos)
+    fe = ServingFrontend(engine)
+    h = fe.submit(Request(prompt=prompt, max_new_tokens=6))
+    streamed = []
+    while fe.pump():                      # consume between boundaries
+        try:
+            while (tok := h.get(timeout=0)) is not None:
+                streamed.append(tok)
+        except queue_mod.Empty:
+            pass
+    streamed.extend(list(h))              # whatever the last chunk left
+    out = h.result()
+    assert h.done
+    assert streamed == list(out)          # in order, nothing dropped
+    assert h.tokens_so_far() == list(out)
+    assert int(out[-1]) == eos or out.shape[0] == 6
+    assert list(h) == []                  # the stream stays terminated
+
+
+def test_streaming_cancel_stops_stream_and_frees_pages(rng):
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8)
+    fe = ServingFrontend(engine)
+    prompt = rng.integers(0, cfg.vocab_size, (9,)).astype(np.int32)
+    h = fe.submit(Request(prompt=prompt, max_new_tokens=30))
+    for _ in range(4):
+        fe.pump()
+    h.cancel()
+    fe.drain()
+    out = h.result()
+    assert h.done
+    assert 1 <= out.shape[0] < 30         # truncated at the cancel point
+    # the prefix of an uncancelled run matches (cancel loses no tokens)
+    ref = np.asarray(generate(model, v, prompt[None], max_new_tokens=30)
+                     )[0, 9:]
+    np.testing.assert_array_equal(out, ref[:out.shape[0]])
+    # pages all returned (no prefix cache: everything frees)
+    assert int(free_page_count(engine.cache)) == \
+        engine.cache["free_stack"].shape[0] - 1
+    # a cancelled PENDING request never admits and finishes empty
+    fe2 = ServingFrontend(engine)
+    h2 = fe2.submit(Request(prompt=prompt, max_new_tokens=4))
+    h2.cancel()
+    fe2.drain()
+    assert h2.result().shape[0] == 0
+
+
+def test_background_pump_thread(rng):
+    """start()/stop(): submissions stream results without the caller
+    driving the pump."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8)
+    fe = ServingFrontend(engine)
+    fe.start()
+    try:
+        prompt = rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32)
+        h = fe.submit(Request(prompt=prompt, max_new_tokens=5))
+        out = h.result(timeout=120.0)
+    finally:
+        fe.stop()
+    ref = np.asarray(generate(model, v, prompt[None], max_new_tokens=5)
+                     )[0, 10:]
+    np.testing.assert_array_equal(out, ref)
+
+
+# --------------------------------------------------------------------------
+# preemption / resume
+# --------------------------------------------------------------------------
+
+def _forced_preemption_run(model, v, cfg, low, hi, *, engine_kw=None,
+                           warm_pumps=3):
+    """Admit the low-priority requests, let them decode a few chunks,
+    then submit the high-priority one under an aggressive policy — with
+    every slot busy it MUST preempt. Returns (frontend, handles)."""
+    engine = PagedDecodeEngine(model, v, num_slots=len(low), page_size=8,
+                               prefix_cache=True, **(engine_kw or {}))
+    fe = ServingFrontend(
+        engine, policy=PriorityDeadlinePolicy(preempt_on_priority=True))
+    handles = [fe.submit(r, request_id=i) for i, r in enumerate(low)]
+    while fe.queue_depth:
+        fe.pump()
+    for _ in range(warm_pumps):           # give the victims some progress
+        fe.pump()
+    handles.append(fe.submit(hi, request_id=len(low)))
+    fe.drain()
+    return fe, handles
+
+
+def test_forced_preemption_token_identity_and_full_prefix_resume(rng):
+    """THE acceptance bar: a high-priority arrival that must evict a
+    low-priority slot produces greedy output token-identical to the
+    unconstrained run for every request, and the resumed request's
+    re-admission skips its ENTIRE full-page written prefix via the
+    radix cache."""
+    cfg, model, v = _model()
+    low = [Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                       ).astype(np.int32),
+                   max_new_tokens=16, priority=0) for _ in range(2)]
+    hi = Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                     ).astype(np.int32),
+                 max_new_tokens=8, priority=5)
+    fe, handles = _forced_preemption_run(model, v, cfg, low, hi)
+    stats = fe.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["resumes"] >= 1
+
+    # token identity: every request matches its unconstrained lock-step
+    # run — the preempt/spill/resume cycle changed nothing
+    for h, ref in zip(handles, _refs(model, v, low + [hi])):
+        np.testing.assert_array_equal(h.result(), ref)
+
+    # the resume hit the cache for its FULL written full-page prefix:
+    # ample pages mean the spilled pages survived until the resume, and
+    # resumes skip the power-of-two match flooring
+    ring = fe.engine.events.tail()
+    preempts = {e["request"]: e for e in ring if e["kind"] == "preempt"}
+    resumes = [e for e in ring if e["kind"] == "resume"]
+    assert resumes, ring
+    for ev in resumes:
+        generated = preempts[ev["request"]]["generated"]
+        s0 = 24                           # every prompt here is 24 tokens
+        full_pages = (s0 + generated - 1) // 8
+        assert ev["cached_pages"] == full_pages, (ev, generated)
+    assert stats["prefill_tokens_skipped"] >= 8
+
+    # pool hygiene: every non-cached page returned after the drain
+    usable = fe.engine.cache["free_stack"].shape[0] - 1
+    assert int(free_page_count(fe.engine.cache)) == \
+        usable - len(fe.engine.prefix)
+
+
+def test_preemption_on_off_identical_via_run(rng):
+    """engine.run() outputs are identical whether the policy may preempt
+    or not (same requests, same engine config)."""
+    cfg, model, v = _model()
+    reqs = [Request(prompt=rng.integers(0, cfg.vocab_size, (int(s),)
+                                        ).astype(np.int32),
+                    max_new_tokens=int(m), priority=int(p))
+            for s, m, p in zip((16, 24, 9), (10, 6, 12), (0, 3, 1))]
+    e1 = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                           prefix_cache=True)
+    outs_off, _ = e1.run(reqs, policy=PriorityDeadlinePolicy(
+        preemption=False))
+    e2 = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                           prefix_cache=True)
+    outs_on, _ = e2.run(reqs, policy=PriorityDeadlinePolicy(
+        preempt_on_priority=True))
+    for a, b in zip(outs_off, outs_on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_priority_inversion_bounded(rng):
+    """A flood of low-priority work cannot starve a high-priority
+    deadline request: the policy preempts the running victim and the
+    high-priority request completes before any further low-priority
+    request is even admitted."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8)
+    # a huge margin arms preemption the moment the request is blocked —
+    # long before the (comfortable) deadline could be missed
+    fe = ServingFrontend(engine, policy=PriorityDeadlinePolicy(
+        preempt_margin_ms=1e7))
+    lows = [fe.submit(Request(
+        prompt=rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32),
+        max_new_tokens=12), request_id=i) for i in range(3)]
+    while fe.queue_depth == 3:            # let the first low admit
+        fe.pump()
+    fe.pump()
+    h_hi = fe.submit(Request(
+        prompt=rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32),
+        max_new_tokens=4, priority=9, deadline_ms=600000.0),
+        request_id=9)
+    fe.drain()
+    stats = fe.stats()
+    assert stats["preemptions"] >= 1
+    assert stats["deadline_misses"] == 0
+    ring = fe.engine.events.tail()
+    hi_retire = next(e["seq"] for e in ring
+                     if e["kind"] == "retire" and e["request"] == 9)
+    later_low_admits = [e["seq"] for e in ring
+                        if e["kind"] == "admit" and e["request"] in (1, 2)]
+    assert all(hi_retire < s for s in later_low_admits), ring
+    for h in lows:                        # the flood still completes
+        assert h.result().shape[0] == 12
+
+
+@pytest.mark.slow
+def test_sampled_preemption_scheduling_invariance(rng):
+    """Sampled decode draws the SAME tokens with and without a
+    preemption in the middle: the resume admission continues the
+    request's fold_in key stream at its token index (samp0)."""
+    cfg, model, v = _model()
+    key = jax.random.PRNGKey(3)
+    low = [Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                       ).astype(np.int32),
+                   max_new_tokens=12, priority=0) for _ in range(2)]
+    hi = Request(prompt=rng.integers(0, cfg.vocab_size, (16,)
+                                     ).astype(np.int32),
+                 max_new_tokens=6, priority=5)
+    kw = dict(temperature=1.0, top_k=8, rng=key)
+
+    # undisturbed: plain run, no preemption possible (FIFO, no deadlines)
+    e_plain = PagedDecodeEngine(model, v, num_slots=3, page_size=8,
+                                **kw)
+    outs_plain, stats_plain = e_plain.run(low + [hi])
+    assert stats_plain.get("preemptions", 0) == 0
+
+    # forced preemption mid-decode; prefix_cache on for the spill path
+    e_pre = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                              prefix_cache=True, **kw)
+    fe = ServingFrontend(e_pre, policy=PriorityDeadlinePolicy(
+        preempt_on_priority=True))
+    handles = [fe.submit(r, request_id=i) for i, r in enumerate(low)]
+    while fe.queue_depth:
+        fe.pump()
+    for _ in range(3):
+        fe.pump()
+    handles.append(fe.submit(hi, request_id=2))
+    fe.drain()
+    assert fe.stats()["preemptions"] >= 1
+    for h, ref in zip(handles, outs_plain):
+        np.testing.assert_array_equal(h.result(), np.asarray(ref))
+
+
+def test_deadline_miss_counted_and_queue_metrics(rng):
+    """An already-expired deadline is counted exactly once at first
+    token; the queue-depth gauge tracks ingest and the preemption
+    counters carry the engine label."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8)
+    fe = ServingFrontend(engine, policy=PriorityDeadlinePolicy(
+        preemption=False))
+    reqs = [Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                        ).astype(np.int32),
+                    max_new_tokens=3,
+                    deadline_ms=0.0 if i == 0 else None,
+                    arrival_time=time.perf_counter() - 1.0)
+            for i in range(3)]
+    for i, r in enumerate(reqs):
+        fe.submit(r, request_id=i)
+    assert fe.queue_depth == 3
+    assert metrics.gauge("serving.queue_depth",
+                         labels=engine.obs_labels).value == 3
+    fe.drain()
+    stats = fe.stats()
+    assert stats["deadline_misses"] == 1
+    assert stats["peak_queue_depth"] >= 3
+    assert stats["preemptions"] == 0 and stats["resumes"] == 0
+    assert metrics.counter("serving.deadline_misses",
+                           labels=engine.obs_labels).value >= 1
+    assert metrics.gauge("serving.queue_depth",
+                         labels=engine.obs_labels).value == 0
+
+
+def test_lifecycle_reports_time_in_preempted(rng):
+    """The span tracer's lifecycle sums decode segments across a
+    preemption and reports preempted_ms/preemptions; TTFT anchors on
+    the ORIGINAL first token, not the resume's."""
+    cfg, model, v = _model()
+    low = [Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                       ).astype(np.int32),
+                   max_new_tokens=10, priority=0) for _ in range(2)]
+    hi = Request(prompt=rng.integers(0, cfg.vocab_size, (16,)
+                                     ).astype(np.int32),
+                 max_new_tokens=4, priority=5)
+    fe, handles = _forced_preemption_run(model, v, cfg, low, hi)
+    ring = fe.engine.events.tail()
+    victim = next(e["request"] for e in ring if e["kind"] == "preempt")
+    life = fe.tracer.lifecycle(victim)
+    assert life["preemptions"] >= 1
+    assert life["preempted_ms"] > 0.0
+    assert life["new_tokens"] == handles[victim].result().shape[0]
+    assert life["ttft_ms"] >= 0.0
+    assert life["tpot_ms"] >= 0.0
+    # an unpreempted request reports no preemption keys
+    untouched = next(i for i in (0, 1) if i != victim)
+    assert "preemptions" not in fe.tracer.lifecycle(untouched)
+
+
+def test_deadlock_still_raises_and_fails_handles(rng):
+    """A request the pool can never hold dies loudly through the
+    frontend too (the engine's original deadlock contract)."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8,
+                               num_pages=3)
+    fe = ServingFrontend(engine)
+    fe.submit(Request(prompt=np.zeros((30,), np.int32),
+                      max_new_tokens=10))
+    with pytest.raises(RuntimeError, match="deadlock"):
+        fe.drain()

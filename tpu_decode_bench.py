@@ -28,6 +28,15 @@ dominant multi-user pattern) through the engine with
 with the radix-cache hit rate and prefill-tokens-skipped counters next to
 the total. The smoke run asserts the reduction: every request past the
 first concurrent wave must skip the full shared-header prefill.
+
+Fourth line: the ASYNC FRONT-END (docs/frontend.md) — an open-loop
+Poisson arrival stream with mixed priorities and TTFT deadlines through
+``ServingFrontend``, closed by an adversarial burst that forces the
+preemption/spill/resume path, emitting
+{"metric": "gpt2_frontend_decode_tokens_per_sec_per_chip", ...} with
+``gpt2_frontend_ttft/tpot`` percentiles and deadline-miss counts from
+the metrics registry plus preemption/resume counters. The smoke run
+asserts preemptions > 0 and resumes > 0 under the burst.
 """
 
 import json
@@ -240,6 +249,119 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(pc_rec), flush=True)
+
+    # --- open-loop async frontend workload (Poisson arrivals) ---------------
+    # the serving FRONT-END under an open arrival stream (docs/frontend.md):
+    # requests are submitted at Poisson arrival times regardless of
+    # completion (open loop — queueing is real, unlike the closed run()
+    # batches above), with mixed priorities and TTFT deadlines, followed
+    # by an adversarial burst (slots pinned by low-priority work, then a
+    # high-priority arrival) that FORCES the preemption/spill/resume
+    # path. Emits gpt2_frontend_* TTFT/TPOT/deadline-miss fields from the
+    # metrics registry; the smoke run asserts preemptions actually fired.
+    from apex_tpu.serving.frontend import ServingFrontend
+    from apex_tpu.serving.policy import PriorityDeadlinePolicy
+
+    wl3 = np.random.default_rng(3)
+    if smoke:
+        fe_slots, n_fe = 2, 8
+        fe_prompts = wl3.integers(8, 49, n_fe)
+        fe_new = wl3.integers(6, 15, n_fe)
+        mean_gap_s, fe_deadline_ms = 0.004, 2000.0
+        burst_prompt, burst_new = 24, 20
+    else:
+        fe_slots, n_fe = num_slots, 3 * batch
+        fe_prompts = wl3.integers(32, 129, n_fe)
+        fe_new = wl3.integers(32, 129, n_fe)
+        mean_gap_s, fe_deadline_ms = 0.01, 500.0
+        burst_prompt, burst_new = 128, 96
+    arrivals = np.cumsum(wl3.exponential(mean_gap_s, n_fe))
+    fe_priorities = wl3.integers(0, 3, n_fe)
+    fe_reqs = [
+        Request(prompt=wl3.integers(0, cfg.vocab_size, int(L)).astype(
+            np.int32), max_new_tokens=int(m), priority=int(p),
+            deadline_ms=fe_deadline_ms if p == 2 else None)
+        for L, m, p in zip(fe_prompts, fe_new, fe_priorities)]
+
+    fe_engine = PagedDecodeEngine(model, v, num_slots=fe_slots,
+                                  page_size=page_size, prefix_cache=True)
+    fe_engine.run(fe_reqs)      # warm: compile buckets, seed the cache
+    fe = ServingFrontend(fe_engine, policy=PriorityDeadlinePolicy(
+        preempt_on_priority=True))
+    handles = []
+    t0 = time.perf_counter()
+    i = 0
+    while i < n_fe:
+        now = time.perf_counter() - t0
+        while i < n_fe and arrivals[i] <= now:
+            handles.append(fe.submit(fe_reqs[i], request_id=i))
+            i += 1
+        if not fe.pump() and i < n_fe:
+            # idle before the next arrival — nap up to it (bounded so a
+            # late-arriving burst still sees a responsive pump)
+            time.sleep(min(max(arrivals[i] - (time.perf_counter() - t0),
+                               0.0), 0.002))
+    fe.drain()
+    # adversarial burst: pin every slot with low-priority long work,
+    # give it a little progress, then land a high-priority deadline
+    # arrival — with no vacancy the policy MUST preempt-and-spill
+    burst_low = [
+        Request(prompt=wl3.integers(0, cfg.vocab_size, burst_prompt
+                                    ).astype(np.int32),
+                max_new_tokens=burst_new, priority=0)
+        for _ in range(fe_slots)]
+    for j, r in enumerate(burst_low):
+        handles.append(fe.submit(r, request_id=n_fe + j))
+    while fe.queue_depth:
+        fe.pump()
+    for _ in range(3):
+        fe.pump()
+    handles.append(fe.submit(
+        Request(prompt=wl3.integers(0, cfg.vocab_size, burst_prompt
+                                    ).astype(np.int32),
+                max_new_tokens=max(burst_new // 4, 2), priority=9,
+                deadline_ms=fe_deadline_ms),
+        request_id=n_fe + fe_slots))
+    fe.drain()
+    fe_elapsed = time.perf_counter() - t0
+    fe_stats = fe.stats()
+    fe_tokens = int(sum(h.result().shape[0] for h in handles))
+    n_deadlined = sum(1 for r in fe_reqs if r.deadline_ms is not None) + 1
+    if smoke and fe_stats["preemptions"] < 1:
+        raise SystemExit(
+            "frontend preemption regressed: the adversarial burst (all "
+            "slots pinned low-priority, high-priority arrival, "
+            "preempt_on_priority policy) produced 0 preemptions")
+    if smoke and fe_stats["resumes"] < 1:
+        raise SystemExit("frontend resume regressed: preempted work was "
+                         "never resumed")
+    fe_rec = {
+        "metric": "gpt2_frontend_decode_tokens_per_sec_per_chip",
+        "value": round(fe_tokens / max(fe_elapsed, 1e-9), 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,  # no reference analog (apex ships no inference)
+        "requests": n_fe, "num_slots": fe_slots, "page_size": page_size,
+        "open_loop_mean_gap_ms": round(mean_gap_s * 1e3, 3),
+        "deadline_ms": fe_deadline_ms,
+        "deadlined_requests": n_deadlined,
+        "generated_tokens": fe_tokens,
+        # TTFT/TPOT percentiles + deadline misses, from the instrument
+        # registry (serving.* histograms/counters, docs/observability.md)
+        "gpt2_frontend_ttft_ms_p50": round(fe_stats["ttft_ms_p50"], 3),
+        "gpt2_frontend_ttft_ms_p95": round(fe_stats["ttft_ms_p95"], 3),
+        "gpt2_frontend_tpot_ms_p50": round(fe_stats["tpot_ms_p50"], 3),
+        "gpt2_frontend_tpot_ms_p95": round(fe_stats["tpot_ms_p95"], 3),
+        "gpt2_frontend_deadline_misses": fe_stats["deadline_misses"],
+        "gpt2_frontend_deadline_miss_rate": round(
+            fe_stats["deadline_misses"] / max(n_deadlined, 1), 3),
+        "preemptions": fe_stats["preemptions"],
+        "resumes": fe_stats["resumes"],
+        "peak_queue_depth": fe_stats["peak_queue_depth"],
+        "prefix_hits": fe_stats["prefix_hits"],
+        "prefill_tokens_skipped": fe_stats["prefill_tokens_skipped"],
+        "device": dev.device_kind, "platform": dev.platform,
+    }
+    print(json.dumps(fe_rec), flush=True)
 
     # --- metrics snapshot artifact (docs/observability.md) ------------------
     # run_tpu_round.sh sets APEX_TPU_METRICS_OUT so every round banks the
