@@ -17,7 +17,8 @@ convention and reviewer memory alone:
 * AOT case-list drift between ``tpu_aot.py`` and the CI tier's
   ``CASE_NAMES``.
 
-Two tiers share the CLI, the suppression pragmas and the baseline:
+Three tiers share the CLI, the suppression pragmas and the baseline
+(tier-partitioned by rule namespace — ``apex_tpu.analysis.tiers``):
 
 * the **AST tier** (this package's ``rules``/``walker``/``project``)
   reads source — whole-repo INTERPROCEDURAL since ISSUE 5: imports are
@@ -29,15 +30,21 @@ Two tiers share the CLI, the suppression pragmas and the baseline:
   engine programs) with ``jax.make_jaxpr`` on CPU and lints the STAGED
   programs — dtype promotion drift, dead scan state, ineffective
   donation, compile-key cardinality — mapping findings back to source
-  via ``eqn.source_info``.
+  via ``eqn.source_info``;
+* the **concurrency tier** (``apex_tpu.analysis.conc``, ``--conc``)
+  reads what the HOST does across threads — pump/exporter/callback
+  thread coloring, lockset propagation with Eraser-style GuardedBy
+  inference, lock-order cycles, blocking-under-lock, and
+  alloc/release / begin/end resource pairing on early-exit paths.
 
-The AST tier is stdlib-``ast`` only (no third-party lint deps, no jax
-import); the IR tier needs jax but no TPU.
+The AST and conc tiers are stdlib-``ast`` only (no third-party lint
+deps, no jax import); the IR tier needs jax but no TPU.
 
 Usage::
 
     python -m apex_tpu.analysis [paths ...] [--format text|json]
     python -m apex_tpu.analysis --ir [--ir-case NAME]
+    python -m apex_tpu.analysis --conc
     python -m apex_tpu.analysis --diff <base-rev>
     apex-tpu-lint --list-rules
 

@@ -70,21 +70,28 @@ def _rel(root: Path, path: Path) -> str:
         return path.as_posix()
 
 
-def analyze_sources(sources: "dict[str, str]", *,
-                    select: Optional[Iterable[str]] = None,
-                    interprocedural: bool = True,
-                    ) -> Tuple[List[Finding], int]:
-    """Run the MODULE rules over an in-memory ``{rel path: source}``
-    map; returns (surviving findings, #suppressed). This is the engine
-    under both :func:`analyze_paths` (sources read from disk) and
-    ``--diff`` (sources read from a git base rev).
+def read_sources(root: Path, paths: Sequence[str] = ()
+                 ) -> Tuple["dict[str, str]", List[Finding]]:
+    """The discovered surface as ``{rel posix path: source}`` plus
+    unreadable-file findings — the one surface reader every source-only
+    consumer (AST tier, conc tier, ``--diff``) shares."""
+    findings: List[Finding] = []
+    sources: "dict[str, str]" = {}
+    for path in discover(root, paths):
+        rel = _rel(root, path)
+        try:
+            sources[rel] = path.read_text()
+        except OSError as e:
+            findings.append(Finding(
+                rule="parse-error", severity="error", path=rel, line=1,
+                col=1, message=f"unreadable: {e}"))
+    return sources, findings
 
-    Phase 1 parses every module; phase 2 (``interprocedural``) links
-    them into one call graph (``project.ProjectIndex``) so jit
-    reachability and imported jit wrappers cross file boundaries; then
-    each module's rules run as before.
-    """
-    chosen = set(select) if select is not None else set(RULES)
+
+def parse_sources(sources: "dict[str, str]"
+                  ) -> Tuple["dict[str, ModuleIndex]", List[Finding]]:
+    """Phase 1 for the source-only tiers: parse every module, turning
+    syntax errors into findings instead of crashes."""
     findings: List[Finding] = []
     modules: "dict[str, ModuleIndex]" = {}
     for rel in sorted(sources):
@@ -95,8 +102,33 @@ def analyze_sources(sources: "dict[str, str]", *,
                 rule="parse-error", severity="error", path=rel,
                 line=e.lineno or 1, col=(e.offset or 0) + 1,
                 message=f"syntax error: {e.msg}"))
-    if interprocedural:
-        ProjectIndex(modules).link()
+    return modules, findings
+
+
+def analyze_sources(sources: "dict[str, str]", *,
+                    select: Optional[Iterable[str]] = None,
+                    interprocedural: bool = True,
+                    modules: "Optional[dict[str, ModuleIndex]]" = None,
+                    ) -> Tuple[List[Finding], int]:
+    """Run the MODULE rules over an in-memory ``{rel path: source}``
+    map; returns (surviving findings, #suppressed). This is the engine
+    under both :func:`analyze_paths` (sources read from disk) and
+    ``--diff`` (sources read from a git base rev).
+
+    Phase 1 parses every module; phase 2 (``interprocedural``) links
+    them into one call graph (``project.ProjectIndex``) so jit
+    reachability and imported jit wrappers cross file boundaries; then
+    each module's rules run as before. ``modules`` supplies a
+    pre-parsed (and, for interprocedural use, pre-LINKED) map so
+    ``--diff`` can feed one parse to both source-only tiers — the
+    caller then owns the parse-error findings.
+    """
+    chosen = set(select) if select is not None else set(RULES)
+    findings: List[Finding] = []
+    if modules is None:
+        modules, findings = parse_sources(sources)
+        if interprocedural:
+            ProjectIndex(modules).link()
     suppressed = 0
     for rel, mi in modules.items():
         supp = Suppressions(mi.source)
@@ -128,16 +160,7 @@ def analyze_paths(paths: Sequence[str] = (), *,
     if unknown:
         raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
 
-    findings: List[Finding] = []
-    sources: "dict[str, str]" = {}
-    for path in discover(root, paths):
-        rel = _rel(root, path)
-        try:
-            sources[rel] = path.read_text()
-        except OSError as e:
-            findings.append(Finding(
-                rule="parse-error", severity="error", path=rel, line=1,
-                col=1, message=f"unreadable: {e}"))
+    sources, findings = read_sources(root, paths)
     module_findings, suppressed = analyze_sources(sources, select=chosen)
     findings.extend(module_findings)
     if with_project_rules:
@@ -150,8 +173,10 @@ def analyze_paths(paths: Sequence[str] = (), *,
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="apex-tpu-lint",
-        description="AST + jaxpr-IR static analysis for jit/Pallas/"
-                    "serving hazards")
+        description="AST + jaxpr-IR + host-concurrency static analysis "
+                    "for jit/Pallas/serving hazards (three tiers: "
+                    "source, staged jaxprs, and the host threading/"
+                    "lock/resource discipline of the serving stack)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: apex_tpu/, "
                         "tpu_*.py, bench*.py under --root)")
@@ -177,10 +202,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "(no TPU needed) and lint the staged programs")
     p.add_argument("--ir-case", default=None, metavar="NAME",
                    help="IR tier for ONE registered case (implies --ir)")
+    p.add_argument("--conc", action="store_true",
+                   help="run the host-concurrency tier instead: thread "
+                        "coloring, lockset/GuardedBy inference, lock-"
+                        "order cycles, blocking-under-lock, resource-"
+                        "lifecycle pairing over the whole surface")
     p.add_argument("--diff", default=None, metavar="BASE_REV",
                    help="fail only on findings introduced relative to "
-                        "this git rev (AST tier; module rules) — the "
-                        "base rev's findings act as the baseline")
+                        "this git rev (AST module rules + the conc "
+                        "tier; both are source-only, so the base rev "
+                        "is analyzable) — the base rev's findings act "
+                        "as the baseline")
     return p
 
 
@@ -251,23 +283,44 @@ def _base_rev_sources(root: Path, rev: str) -> "dict[str, str]":
 
 
 def _run_diff(args, root: Path, select) -> int:
-    """Diff-aware mode: current module-rule findings, minus whatever the
-    base rev already had (counted with the same line-number-free
-    ``path::rule::scope`` keys the baseline uses). Project rules are
-    skipped on both sides — they need an on-disk tree; the absolute
-    gate still runs them."""
+    """Diff-aware mode: current module-rule AND conc-tier findings,
+    minus whatever the base rev already had (counted with the same
+    line-number-free ``path::rule::scope`` keys the baseline uses).
+    Both tiers are source-only, so the base side is fully analyzable
+    from git history. Project rules are skipped on both sides — they
+    need an on-disk tree; the absolute gate still runs them."""
     from collections import Counter
+
+    from apex_tpu.analysis.conc.conc_report import (analyze_conc_sources,
+                                                    build_model)
+    from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+
+    ast_sel = conc_sel = None
+    if select is not None:
+        ast_sel = [s for s in select if s in RULES]
+        conc_sel = [s for s in select if s in CONC_RULES]
+
+    def both_tiers(sources):
+        """AST module rules + conc rules over ONE parse+link of a
+        surface (each side of the diff pays the parse once)."""
+        model, findings = build_model(sources)
+        ast_f, ast_supp = analyze_sources(
+            sources, select=ast_sel, modules=model.modules)
+        conc_f, conc_supp = analyze_conc_sources(
+            sources, select=conc_sel, model=model)
+        return findings + ast_f + conc_f, ast_supp + conc_supp
 
     try:
         base_sources = _base_rev_sources(root, args.diff)
     except ValueError as e:
         print(f"error: --diff {args.diff}: {e}", file=sys.stderr)
         return 2
-    base_findings, _ = analyze_sources(base_sources, select=select)
+    base_findings, _ = both_tiers(base_sources)
     base = Baseline(Counter(f.baseline_key() for f in base_findings))
 
-    findings, suppressed = analyze_paths(
-        (), root=root, select=select, with_project_rules=False)
+    cur_sources, findings = read_sources(root)
+    cur_findings, suppressed = both_tiers(cur_sources)
+    findings += cur_findings
     new, absorbed = base.split(findings)
     if args.format == "json":
         print(report.render_json(new, absorbed, suppressed))
@@ -286,15 +339,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.ir_case:
         args.ir = True
     if args.list_rules:
+        from apex_tpu.analysis.conc.conc_rules import CONC_RULES
         from apex_tpu.analysis.ir.ir_rules import IR_RULES
 
-        width = max(len(n) for n in list(RULES) + list(IR_RULES))
+        width = max(len(n) for n in
+                    list(RULES) + list(IR_RULES) + list(CONC_RULES))
         for name, r in sorted(RULES.items()):
             kind = "project" if r.project else "module"
             print(f"{name:<{width}}  {r.severity:<7} ast:{kind:<7} "
                   f"{r.summary}")
         for name, r in sorted(IR_RULES.items()):
             print(f"{name:<{width}}  {r.severity:<7} ir:jaxpr    "
+                  f"{r.summary}")
+        for name, r in sorted(CONC_RULES.items()):
+            print(f"{name:<{width}}  {r.severity:<7} conc:host   "
                   f"{r.summary}")
         return 0
 
@@ -304,11 +362,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if args.ir and args.conc:
+        print("error: --ir and --conc are separate tiers; run them "
+              "in separate invocations", file=sys.stderr)
+        return 2
     if args.diff is not None:
         if args.ir:
-            print("error: --diff is AST-tier only (the base rev's "
-                  "programs cannot be traced from git history); run "
-                  "--ir separately", file=sys.stderr)
+            print("error: --diff covers the source-only tiers (AST "
+                  "module rules + conc); the base rev's programs "
+                  "cannot be traced from git history — run --ir "
+                  "separately", file=sys.stderr)
+            return 2
+        if args.conc:
+            print("error: --diff already covers the conc tier; drop "
+                  "--conc", file=sys.stderr)
             return 2
         if args.write_baseline or args.baseline:
             print("error: --diff uses the base rev's findings AS the "
@@ -325,7 +392,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         try:
             if select:
-                unknown = set(select) - set(RULES)
+                from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+
+                unknown = set(select) - set(RULES) - set(CONC_RULES)
                 if unknown:
                     raise ValueError("unknown rule(s): "
                                      + ", ".join(sorted(unknown)))
@@ -344,6 +413,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             findings, suppressed, _ = analyze_ir(
                 root, select=select, case=args.ir_case)
+        elif args.conc:
+            if args.paths:
+                print("error: --conc analyzes the whole default "
+                      "surface (locksets and thread colors come from "
+                      "the global call graph); drop the explicit paths",
+                      file=sys.stderr)
+                return 2
+            from apex_tpu.analysis.conc import analyze_conc
+
+            findings, suppressed = analyze_conc(root, select=select)
         else:
             findings, suppressed = analyze_paths(
                 args.paths, root=root, select=select)
@@ -365,34 +444,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
-        def rule_of(key: str) -> str:
-            parts = key.split("::")
-            return parts[1] if len(parts) > 2 else ""
+        from apex_tpu.analysis.tiers import tier_of_key
 
-        # the two tiers share one baseline file but never clobber each
-        # other: an AST write keeps ir-* entries and vice versa
-        if args.ir:
-            keep = {k: v for k, v in existing.counts.items()
-                    if not rule_of(k).startswith("ir-")}
-            if args.ir_case:
-                # case-scoped run: replace only THIS case's entries (IR
-                # scopes are case names — the last key component)
-                keep.update(
-                    {k: v for k, v in existing.counts.items()
-                     if rule_of(k).startswith("ir-")
-                     and k.split("::")[-1] != args.ir_case})
-        else:
-            keep = {k: v for k, v in existing.counts.items()
-                    if rule_of(k).startswith("ir-")}
-            if args.paths:
-                # scoped run: replace entries for the scanned files
-                # only, keep the rest of the baseline untouched
-                scanned = {_rel(root, p)
-                           for p in discover(root, args.paths)}
-                keep.update(
-                    {k: v for k, v in existing.counts.items()
-                     if not rule_of(k).startswith("ir-")
-                     and k.split("::", 1)[0] not in scanned})
+        # the tiers share one baseline file but never clobber each
+        # other: a write from one tier keeps every other tier's entries
+        # (tier membership comes from the rule-namespace registry in
+        # analysis/tiers.py, not per-tier string checks)
+        active = "ir" if args.ir else "conc" if args.conc else "ast"
+        keep = {k: v for k, v in existing.counts.items()
+                if tier_of_key(k) != active}
+        if args.ir and args.ir_case:
+            # case-scoped run: replace only THIS case's entries (IR
+            # scopes are case names — the last key component)
+            keep.update(
+                {k: v for k, v in existing.counts.items()
+                 if tier_of_key(k) == "ir"
+                 and k.split("::")[-1] != args.ir_case})
+        elif active == "ast" and args.paths:
+            # scoped run: replace entries for the scanned files
+            # only, keep the rest of the baseline untouched
+            scanned = {_rel(root, p)
+                       for p in discover(root, args.paths)}
+            keep.update(
+                {k: v for k, v in existing.counts.items()
+                 if tier_of_key(k) == "ast"
+                 and k.split("::", 1)[0] not in scanned})
         Baseline.write(baseline_path, findings, keep=keep)
         print(f"tpu-lint: wrote {len(findings)} finding(s) to "
               f"{baseline_path}"

@@ -1,0 +1,57 @@
+"""tpu-lint concurrency tier: host-thread & resource-lifecycle analysis.
+
+The third lint tier (``--conc``). The AST tier reads what the source
+says about *traced* code; the IR tier reads what JAX stages; this tier
+reads what the HOST side does across threads — the pump thread, the
+``/metrics`` exporter, XLA callback delivery, and every API caller —
+over the same interprocedural call graph PR 5 built (``project.py``).
+
+Four fact layers (``threads.py`` + ``locks.py``), eight rules
+(``conc_rules.py``):
+
+- **thread coloring** — ``threading.Thread``/``Timer`` targets,
+  executor submits, HTTP-handler ``do_*`` methods, and
+  ``jax.debug.callback`` payloads root a call-graph BFS, so every
+  function knows which extra threads it runs on;
+- **locksets + GuardedBy inference** — ``with lock:`` spans propagate
+  through call sites; a field whose access sites mostly hold one lock
+  is inferred guarded by it, and lock-free accesses from multi-thread
+  code are ``conc-unguarded-shared-field`` findings;
+- **lock-order graph** — ``conc-lock-order-cycle`` (ABBA),
+  ``conc-double-acquire`` (non-reentrant self-deadlock),
+  ``conc-blocking-under-lock`` (device syncs / queue waits that pin a
+  lock), ``conc-unreleased-lock``, ``conc-useless-local-lock``,
+  ``conc-thread-leak``;
+- **resource pairing** — ``conc-resource-leak``: alloc/acquire/begin
+  with an in-function release but an early return/raise that skips it.
+
+Usage::
+
+    python -m apex_tpu.analysis --conc
+    python -m apex_tpu.analysis --conc --select conc-lock-order-cycle
+
+Findings share the AST tier's suppression pragmas, baseline file
+(tier-partitioned by the ``conc-`` prefix — ``analysis/tiers.py``), and
+``--diff`` mode.
+"""
+
+from apex_tpu.analysis.conc.conc_report import (analyze_conc,
+                                                analyze_conc_sources,
+                                                build_model, model_from)
+from apex_tpu.analysis.conc.conc_rules import CONC_RULES, ConcRule
+from apex_tpu.analysis.conc.locks import ConcModel, FuncKey, LockKey
+from apex_tpu.analysis.conc.threads import color, thread_roots
+
+__all__ = [
+    "CONC_RULES",
+    "ConcModel",
+    "ConcRule",
+    "FuncKey",
+    "LockKey",
+    "analyze_conc",
+    "analyze_conc_sources",
+    "build_model",
+    "color",
+    "model_from",
+    "thread_roots",
+]

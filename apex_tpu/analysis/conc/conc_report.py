@@ -1,0 +1,93 @@
+"""Orchestration for the tpu-lint concurrency tier.
+
+:func:`analyze_conc_sources` is the engine: parse every module of the
+scanned surface, link the interprocedural graph (reusing PR 5's
+``ProjectIndex`` — the conc tier never re-walks modules on its own),
+build the :class:`~apex_tpu.analysis.conc.locks.ConcModel` fact base,
+color it with thread roots, run the selected ``conc-*`` rules, and
+apply the ordinary inline-suppression pragmas. Like the AST tier it is
+purely syntactic (stdlib ``ast``, no jax import), which is what lets
+``--diff`` run it against a git base rev's sources.
+
+:func:`analyze_conc` is the disk-backed wrapper the CLI uses: it scans
+the same default surface as the AST tier (the whole-program call graph
+is what gives locksets and thread colors their meaning, so the tier
+always analyzes the full surface rather than path subsets).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis.conc import threads as _threads
+from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+from apex_tpu.analysis.conc.locks import ConcModel
+from apex_tpu.analysis.project import ProjectIndex
+from apex_tpu.analysis.suppressions import Suppressions
+from apex_tpu.analysis.walker import Finding, ModuleIndex
+
+
+def model_from(modules: Dict[str, ModuleIndex],
+               project: ProjectIndex) -> ConcModel:
+    """Fact base over a pre-parsed, pre-LINKED surface — what ``--diff``
+    uses so one parse+link feeds both source-only tiers."""
+    model = ConcModel(modules, project)
+    _threads.color(model)
+    return model
+
+
+def build_model(sources: Dict[str, str]
+                ) -> Tuple[ConcModel, List[Finding]]:
+    """Parse + link + color one surface; returns the model and any
+    parse-error findings (a broken file must not hide the others)."""
+    from apex_tpu.analysis.cli import parse_sources
+
+    modules, findings = parse_sources(sources)
+    project = ProjectIndex(modules)
+    project.link()
+    return model_from(modules, project), findings
+
+
+def analyze_conc_sources(sources: Dict[str, str], *,
+                         select: Optional[Iterable[str]] = None,
+                         model: Optional[ConcModel] = None,
+                         ) -> Tuple[List[Finding], int]:
+    """Run the conc rules over an in-memory ``{rel path: source}`` map;
+    returns ``(surviving findings, #suppressed)``. ``model`` supplies a
+    pre-built fact base (the caller then owns its parse-error
+    findings)."""
+    chosen = set(select) if select is not None else set(CONC_RULES)
+    unknown = chosen - set(CONC_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown conc rule(s): {', '.join(sorted(unknown))}")
+    findings: List[Finding] = []
+    if model is None:
+        model, findings = build_model(sources)
+    raw: List[Finding] = []
+    for name in sorted(chosen):
+        raw.extend(CONC_RULES[name].check(model))
+    suppressed = 0
+    supp_cache: Dict[str, Suppressions] = {}
+    for f in raw:
+        supp = supp_cache.get(f.path)
+        if supp is None:
+            supp = Suppressions(sources.get(f.path, ""))
+            supp_cache[f.path] = supp
+        if supp.covers(f):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def analyze_conc(root, *, select: Optional[Iterable[str]] = None,
+                 ) -> Tuple[List[Finding], int]:
+    """Disk-backed run over the default lint surface under ``root``."""
+    from apex_tpu.analysis.cli import read_sources
+
+    sources, findings = read_sources(Path(root).resolve())
+    more, suppressed = analyze_conc_sources(sources, select=select)
+    findings.extend(more)
+    return findings, suppressed

@@ -1,0 +1,642 @@
+"""tpu-lint concurrency rules: the host-side hazard classes PR 6 bought.
+
+The serving front-end made the host side genuinely concurrent (a pump
+thread, thread-safe ``submit``/``StreamHandle``, the ``/metrics`` server
+thread, XLA runtime callback threads feeding the metrics registry), and
+none of the existing tiers can see a field touched from two threads
+without its lock, a device sync under a lock that stalls the pump, or a
+refcount leaked on an early-exit path. Each rule here walks the shared
+:class:`~apex_tpu.analysis.conc.locks.ConcModel` fact base.
+
+Same precision bias as the other tiers: every check fires only on
+statically resolvable patterns — registered lock objects, literal span
+names, receiver-classified blocking calls — and the Eraser-style field
+rule only speaks when the code itself establishes a guard convention
+(a field is flagged only when at least half its access sites hold one
+specific lock; lock-free-by-design state never fires).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, FrozenSet, Iterator, List, Optional, \
+    Set, Tuple
+
+from apex_tpu.analysis.conc.locks import ConcModel, LockKey, _FuncCtx
+from apex_tpu.analysis.conc.threads import describe_threads
+from apex_tpu.analysis.rules import _expr_key
+from apex_tpu.analysis.walker import (Finding, call_name, kwarg,
+                                      name_tail, walk_shallow)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcRule:
+    name: str
+    severity: str
+    summary: str
+    check: Callable                  # check(model: ConcModel) -> Iterator
+
+
+CONC_RULES: Dict[str, ConcRule] = {}
+
+
+def conc_rule(name: str, severity: str, summary: str):
+    def deco(fn):
+        CONC_RULES[name] = ConcRule(name=name, severity=severity,
+                                    summary=summary, check=fn)
+        return fn
+    return deco
+
+
+def _finding(rule: ConcRule, module: str, node: ast.AST, message: str,
+             scope: str) -> Finding:
+    return Finding(
+        rule=rule.name, severity=rule.severity, path=module,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message, scope=scope,
+        end_line=getattr(node, "end_lineno", 0)
+        or getattr(node, "lineno", 1))
+
+
+def _lockset_str(locks: FrozenSet[LockKey]) -> str:
+    if not locks:
+        return "no lock"
+    return "{" + ", ".join(sorted(lk.display() for lk in locks)) + "}"
+
+
+# --------------------------------------------------------------------------
+# 1. conc-unguarded-shared-field
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-unguarded-shared-field", "error",
+           "field inferred @GuardedBy(lock) — at least half its access "
+           "sites hold one specific lock — is accessed lock-free from "
+           "code that runs on more than one thread")
+def check_unguarded_shared_field(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-unguarded-shared-field"]
+    guards = model.inferred_guards()
+    by_field: Dict[tuple, list] = {}
+    for acc in model.accesses:
+        by_field.setdefault(acc.field, []).append(acc)
+    for field, (lock, n, total) in sorted(
+            guards.items(), key=lambda kv: kv[0]):
+        sites = by_field[field]
+        # shared = some access site runs on a non-caller thread; a field
+        # only ever touched from API-caller context has no second thread
+        # for the missing lock to race against (as far as we can see)
+        if not any(model.colors.get(s.func) for s in sites):
+            continue
+        _, cls, attr = field
+        for s in sites:
+            if lock in s.locks:
+                continue
+            yield _finding(
+                r, s.func.module, s.node,
+                f"`{cls}.{attr}` is inferred @GuardedBy"
+                f"({lock.display()}) — held at {n}/{total} access sites "
+                f"— but this {'write' if s.write else 'read'} in "
+                f"`{s.func.qualname}` (threads "
+                f"{describe_threads(model, s.func)}) holds "
+                f"{_lockset_str(s.locks)}",
+                scope=s.func.qualname)
+
+
+# --------------------------------------------------------------------------
+# 2. conc-lock-order-cycle
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-lock-order-cycle", "error",
+           "cycle in the acquires-while-holding graph — two call paths "
+           "take the same locks in opposite orders (ABBA deadlock)")
+def check_lock_order_cycle(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-lock-order-cycle"]
+    edges: Dict[LockKey, Dict[LockKey, object]] = {}
+    for acq in model.acquisition_events():
+        for held in acq.held:
+            if held == acq.lock:
+                continue             # self re-entry is rule 6's business
+            edges.setdefault(held, {}).setdefault(acq.lock, acq)
+
+    # Tarjan SCCs over the tiny lock graph; any SCC with >= 2 locks (or
+    # reciprocal edges) is an inversion
+    index: Dict[LockKey, int] = {}
+    low: Dict[LockKey, int] = {}
+    onstack: Set[LockKey] = set()
+    stack: List[LockKey] = []
+    sccs: List[List[LockKey]] = []
+    counter = [0]
+
+    def strongconnect(v: LockKey) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in edges.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            if len(scc) > 1:
+                sccs.append(scc)
+
+    for v in sorted(edges, key=lambda lk: lk.display()):
+        if v not in index:
+            strongconnect(v)
+
+    for scc in sccs:
+        members = sorted(scc, key=lambda lk: lk.display())
+        sites = []
+        for a in members:
+            for b, acq in edges.get(a, {}).items():
+                if b in scc:
+                    sites.append((a, b, acq))
+        sites.sort(key=lambda s: (s[2].func.module, s[2].node.lineno))
+        where = "; ".join(
+            f"{a.display()} -> {b.display()} at "
+            f"{acq.func.module}:{acq.node.lineno}"
+            for a, b, acq in sites[:4])
+        anchor = sites[0][2]
+        yield _finding(
+            r, anchor.func.module, anchor.node,
+            f"lock-order cycle over "
+            f"{{{', '.join(lk.display() for lk in members)}}}: {where} — "
+            "two threads taking these in opposite orders deadlock",
+            scope=anchor.func.qualname)
+
+
+# --------------------------------------------------------------------------
+# 3. conc-blocking-under-lock
+# --------------------------------------------------------------------------
+
+_DEVICE_SYNCS = {"jax.device_get", "device_get"}
+_EVENTISH = ("evt", "event", "cond")
+_FUTUREISH = ("handle", "future", "fut")
+
+
+def _blocking_reason(model: ConcModel, ctx: _FuncCtx,
+                     call: ast.Call) -> Optional[str]:
+    cn = call_name(call)
+    tail = cn.split(".")[-1] if cn else None
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = call.func.value
+        if attr == "block_until_ready":
+            return "`.block_until_ready()` blocks on the device"
+        if model.resolve_lock(ctx, recv) is not None:
+            return None              # lock ops are rules 2/5/6's domain
+        kind = model.attr_kind(ctx, recv)
+        rt = (name_tail(recv) or "").lower()
+        if attr == "get" and (kind == "queue" or "queue" in rt
+                              or rt in ("q", "_q")):
+            return "`Queue.get()` blocks until an item arrives"
+        if attr == "join" and (kind == "thread" or "thread" in rt):
+            return "`Thread.join()` blocks on the other thread"
+        if attr == "wait" and (kind in ("event", "condition")
+                               or any(w in rt for w in _EVENTISH)):
+            return "`.wait()` blocks until another thread signals"
+        if attr == "result" and any(w in rt for w in _FUTUREISH):
+            return "`.result()` blocks for another thread's work"
+    if cn in _DEVICE_SYNCS:
+        return "`jax.device_get` synchronizes with the device"
+    if tail == "block_until_ready":
+        return "`jax.block_until_ready` blocks on the device"
+    if cn in ("time.sleep", "sleep"):
+        return "`sleep` parks the thread"
+    return None
+
+
+@conc_rule("conc-blocking-under-lock", "warning",
+           "blocking operation (device sync, queue.get, thread join, "
+           "Event.wait, handle.result, sleep) while holding a lock — "
+           "every thread contending for the lock stalls with it")
+def check_blocking_under_lock(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-blocking-under-lock"]
+    for key, ctx in sorted(model.funcs.items(),
+                           key=lambda kv: (kv[0].module, kv[0].qualname)):
+        # walk_shallow: a nested def's body runs when CALLED (often on
+        # another thread, lock-free) — it is its own ctx with its own
+        # entry lockset, and visiting it here would both inherit the
+        # enclosing function's locks and double-report
+        for node in walk_shallow(ctx.info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            held = model.effective_locks(key, node)
+            if not held:
+                continue
+            why = _blocking_reason(model, ctx, node)
+            if why:
+                yield _finding(
+                    r, key.module, node,
+                    f"{why}, but `{key.qualname}` holds "
+                    f"{_lockset_str(held)} here — the lock is pinned "
+                    "for the operation's full latency",
+                    scope=key.qualname)
+
+
+# --------------------------------------------------------------------------
+# 4-5. resource pairing (pages / prefix refs / spans, and bare locks)
+# --------------------------------------------------------------------------
+
+_POOL_ACQ = {"alloc_slot", "alloc_slot_shared"}
+_POOL_REL = {"release_slot", "free_slot"}
+
+#: event kinds the pairing walk understands
+_ACQ, _REL, _ESC = "acq", "rel", "esc"
+
+
+def _in_order(node: ast.AST) -> Iterator[ast.AST]:
+    """Source-order DFS that stays in the current runtime scope."""
+    stack = [node]
+    order: List[ast.AST] = []
+    while stack:
+        n = stack.pop()
+        order.append(n)
+        children = [c for c in ast.iter_child_nodes(n)
+                    if not isinstance(c, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef))]
+        stack.extend(reversed(children))
+    return iter(order)
+
+
+def _classify_resources(model: ConcModel, ctx: _FuncCtx,
+                        node: ast.AST) -> Iterator[tuple]:
+    """(kind, key, node) events for the page/prefix-ref/span protocols."""
+    if isinstance(node, (ast.Assign, ast.Return, ast.Yield)):
+        # a handle stored into an attribute/name or returned escapes the
+        # function: ownership transferred, the pairing obligation too
+        value = node.value
+        if value is not None:
+            for sub in ast.walk(value):
+                k = _expr_key(sub)
+                if k is not None:
+                    yield (_ESC, ("ref", k), node)
+        return
+    if not isinstance(node, ast.Call):
+        return
+    cn = call_name(node)
+    tail = cn.split(".")[-1] if cn else None
+    if tail in _POOL_ACQ:
+        yield (_ACQ, ("pool",), node)
+        return
+    if tail in _POOL_REL:
+        yield (_REL, ("pool",), node)
+        return
+    if not isinstance(node.func, ast.Attribute):
+        return
+    recv_key = _expr_key(node.func.value)
+    if tail == "release_and_insert":
+        yield (_REL, ("pool",), node)
+        for arg in node.args:
+            k = _expr_key(arg)
+            if k is not None:
+                yield (_REL, ("ref", k), node)
+        return
+    if model.resolve_lock(ctx, node.func.value) is not None:
+        return                       # lock ops: the lock classifier's job
+    if tail == "acquire" and node.args:
+        k = _expr_key(node.args[0])
+        if k is not None:
+            yield (_ACQ, ("ref", k), node)
+    elif tail == "release" and node.args:
+        k = _expr_key(node.args[0])
+        if k is not None:
+            yield (_REL, ("ref", k), node)
+    elif tail == "begin" and len(node.args) >= 2 \
+            and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        yield (_ACQ, ("span", recv_key, node.args[1].value), node)
+    elif tail == "end" and len(node.args) >= 2 \
+            and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        yield (_REL, ("span", recv_key, node.args[1].value), node)
+
+
+def _classify_locks(model: ConcModel, ctx: _FuncCtx,
+                    node: ast.AST) -> Iterator[tuple]:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return
+    lk = model.resolve_lock(ctx, node.func.value)
+    if lk is None:
+        return
+    if node.func.attr == "acquire":
+        yield (_ACQ, ("lock", lk), node)
+    elif node.func.attr == "release":
+        yield (_REL, ("lock", lk), node)
+
+
+class _PairWalk:
+    """Path-sensitive-enough acquire/release matching over one function.
+
+    State is the set of open acquire tokens; branch merges INTERSECT
+    (a token counts as released if any path released it — the tier's
+    precision bias: report only exits NO path can reach with the
+    resource closed). ``finally`` blocks release for every exit they
+    enclose. With ``gate=True`` only acquire keys that have a matching
+    in-function release are tracked at all — a protocol whose release
+    lives in another function (the engine's admit/retire split, the span
+    tracer's cross-phase begin/end) is an ownership transfer, not a
+    leak.
+    """
+
+    def __init__(self, model: ConcModel, ctx: _FuncCtx,
+                 classify: Callable, gate: bool):
+        self.model = model
+        self.ctx = ctx
+        self.classify = classify
+        self.tokens: Dict[int, tuple] = {}   # id -> (key, node)
+        self.leaks: Dict[int, ast.AST] = {}  # token id -> exit node
+        acq_keys: Set[tuple] = set()
+        rel_keys: Set[tuple] = set()
+        for n in _in_order(ctx.info.node):
+            for kind, key, node in classify(model, ctx, n):
+                if kind == _ACQ:
+                    acq_keys.add(key)
+                elif kind == _REL:
+                    rel_keys.add(key)
+        self.tracked = acq_keys & rel_keys if gate else acq_keys
+
+    def run(self) -> Iterator[Tuple[ast.AST, ast.AST]]:
+        body = getattr(self.ctx.info.node, "body", [])
+        final = self._block(body, frozenset(), [])
+        if final is not None and final:
+            self._report(final, self.ctx.info.node, [])
+        for tid, exit_node in sorted(self.leaks.items(),
+                                     key=lambda kv: kv[1].lineno):
+            yield self.tokens[tid][1], exit_node
+
+    # -- events ---------------------------------------------------------
+
+    def _apply(self, node: ast.AST, cur: FrozenSet[int]) -> FrozenSet[int]:
+        out = set(cur)
+        for n in _in_order(node):
+            for kind, key, knode in self.classify(self.model, self.ctx, n):
+                if kind == _ACQ and key in self.tracked:
+                    self.tokens[id(knode)] = (key, knode)
+                    out.add(id(knode))
+                elif kind in (_REL, _ESC):
+                    out = {t for t in out if self.tokens[t][0] != key}
+        return frozenset(out)
+
+    def _report(self, cur: FrozenSet[int], exit_node: ast.AST,
+                fin: List[Set[tuple]]) -> None:
+        covered = set().union(*fin) if fin else set()
+        for tid in cur:
+            if self.tokens[tid][0] in covered:
+                continue
+            self.leaks.setdefault(tid, exit_node)
+
+    # -- control flow ---------------------------------------------------
+
+    def _block(self, stmts: List[ast.stmt],
+               cur: Optional[FrozenSet[int]],
+               fin: List[Set[tuple]]) -> Optional[FrozenSet[int]]:
+        for stmt in stmts:
+            if cur is None:
+                return None
+            cur = self._stmt(stmt, cur, fin)
+        return cur
+
+    @staticmethod
+    def _merge(a: Optional[FrozenSet[int]],
+               b: Optional[FrozenSet[int]]) -> Optional[FrozenSet[int]]:
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a & b
+
+    def _stmt(self, stmt: ast.stmt, cur: FrozenSet[int],
+              fin: List[Set[tuple]]) -> Optional[FrozenSet[int]]:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return cur
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                cur = self._apply(stmt.value, cur)
+            if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                cur = self._apply(stmt.exc, cur)
+            self._report(cur, stmt, fin)
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return cur
+        if isinstance(stmt, ast.If):
+            cur = self._apply(stmt.test, cur)
+            return self._merge(self._block(list(stmt.body), cur, fin),
+                               self._block(list(stmt.orelse), cur, fin))
+        if isinstance(stmt, (ast.While,)):
+            cur = self._apply(stmt.test, cur)
+            once = self._block(list(stmt.body), cur, fin)
+            after = self._merge(once, cur) if once is not None else cur
+            return self._block(list(stmt.orelse), after, fin)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            cur = self._apply(stmt.iter, cur)
+            once = self._block(list(stmt.body), cur, fin)
+            after = self._merge(once, cur) if once is not None else cur
+            return self._block(list(stmt.orelse), after, fin)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                cur = self._apply(item.context_expr, cur)
+            return self._block(list(stmt.body), cur, fin)
+        if isinstance(stmt, ast.Try):
+            fin_keys: Set[tuple] = set()
+            for n in stmt.finalbody:
+                for sub in _in_order(n):
+                    for kind, key, _ in self.classify(self.model,
+                                                      self.ctx, sub):
+                        if kind == _REL:
+                            fin_keys.add(key)
+            inner_fin = fin + [fin_keys] if fin_keys else fin
+            body_out = self._block(list(stmt.body), cur, inner_fin)
+            outs = [body_out]
+            for h in stmt.handlers:
+                outs.append(self._block(list(h.body), cur, inner_fin))
+            if stmt.orelse and body_out is not None:
+                outs[0] = self._block(list(stmt.orelse), body_out,
+                                      inner_fin)
+            merged: Optional[FrozenSet[int]] = None
+            for o in outs:
+                merged = self._merge(merged, o)
+            if merged is not None:
+                for n in stmt.finalbody:
+                    merged = self._apply(n, merged)
+            return merged
+        return self._apply(stmt, cur)
+
+
+@conc_rule("conc-resource-leak", "error",
+           "alloc/acquire/begin with a matching release in the same "
+           "function, but an early return/raise path exits with the "
+           "resource still open (leaked pages, dangling prefix "
+           "refcount, unclosed span)")
+def check_resource_leak(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-resource-leak"]
+    for key, ctx in sorted(model.funcs.items(),
+                           key=lambda kv: (kv[0].module, kv[0].qualname)):
+        walk = _PairWalk(model, ctx, _classify_resources, gate=True)
+        if not walk.tracked:
+            continue
+        for acq_node, exit_node in walk.run():
+            what = call_name(acq_node) or "resource"
+            yield _finding(
+                r, key.module, acq_node,
+                f"`{what}(...)` in `{key.qualname}` is not released on "
+                f"the exit at line {exit_node.lineno} — this function "
+                "pairs acquire with release on its other paths, so the "
+                "early exit leaks the resource",
+                scope=key.qualname)
+
+
+@conc_rule("conc-unreleased-lock", "error",
+           "manual lock.acquire() with an exit path that skips the "
+           "release (and no enclosing try/finally) — prefer `with`")
+def check_unreleased_lock(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-unreleased-lock"]
+    for key, ctx in sorted(model.funcs.items(),
+                           key=lambda kv: (kv[0].module, kv[0].qualname)):
+        walk = _PairWalk(model, ctx, _classify_locks, gate=False)
+        if not walk.tracked:
+            continue
+        for acq_node, exit_node in walk.run():
+            yield _finding(
+                r, key.module, acq_node,
+                f"lock acquired here is still held at the exit on line "
+                f"{exit_node.lineno} of `{key.qualname}` — use `with`, "
+                "or release in a `finally`",
+                scope=key.qualname)
+
+
+# --------------------------------------------------------------------------
+# 6. conc-double-acquire
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-double-acquire", "error",
+           "re-acquiring a non-reentrant threading.Lock already held on "
+           "this path — self-deadlock (RLocks are exempt)")
+def check_double_acquire(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-double-acquire"]
+    seen: Set[Tuple[str, int]] = set()
+    for acq in model.acquisition_events():
+        if acq.lock not in acq.held or acq.lock.reentrant:
+            continue
+        where = (acq.func.module, acq.node.lineno)
+        if where in seen:
+            continue
+        seen.add(where)
+        yield _finding(
+            r, acq.func.module, acq.node,
+            f"`{acq.lock.display()}` is a non-reentrant Lock and is "
+            f"already held when `{acq.func.qualname}` acquires it again "
+            "— this thread deadlocks on itself",
+            scope=acq.func.qualname)
+
+
+# --------------------------------------------------------------------------
+# 7. conc-thread-leak
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-thread-leak", "warning",
+           "non-daemon thread started but never joined — it pins "
+           "interpreter shutdown; pass daemon=True or join it")
+def check_thread_leak(model: ConcModel) -> Iterator[Finding]:
+    r = CONC_RULES["conc-thread-leak"]
+    for rel, mi in sorted(model.modules.items()):
+        joined: Set[str] = set()
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                t = name_tail(node.func.value)
+                if t:
+                    joined.add(t)
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if not cn or cn.split(".")[-1] not in ("Thread", "Timer"):
+                continue
+            daemon = kwarg(node, "daemon")
+            if isinstance(daemon, ast.Constant) and daemon.value is True:
+                continue
+            # assigned somewhere a later .join() reaches?
+            info = mi.enclosing_function(node)
+            scope = info.qualname if info else "<module>"
+            target = None
+            parent_assigns = [a for a in ast.walk(mi.tree)
+                              if isinstance(a, ast.Assign)
+                              and a.value is node]
+            for a in parent_assigns:
+                t = name_tail(a.targets[0])
+                if t:
+                    target = t
+            if target is not None and target in joined:
+                continue
+            yield _finding(
+                r, rel, node,
+                "thread is neither daemon=True nor joined anywhere in "
+                "this module — it outlives (and blocks) interpreter "
+                "shutdown",
+                scope=scope)
+
+
+# --------------------------------------------------------------------------
+# 8. conc-useless-local-lock
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-useless-local-lock", "warning",
+           "lock created inside a function and used only there — a "
+           "fresh lock per call excludes nobody")
+def check_useless_local_lock(model: ConcModel) -> Iterator[Finding]:
+    from apex_tpu.analysis.conc.locks import _is_sync_ctor
+
+    r = CONC_RULES["conc-useless-local-lock"]
+    for key, ctx in sorted(model.funcs.items(),
+                           key=lambda kv: (kv[0].module, kv[0].qualname)):
+        if key.qualname.split(".")[-1] in ("__init__", "__post_init__"):
+            continue
+        locals_: Dict[str, ast.AST] = {}
+        for node in _in_order(ctx.info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and _is_sync_ctor(node.value) in ("lock", "rlock"):
+                locals_[node.targets[0].id] = node.value
+        if not locals_:
+            continue
+        for node in _in_order(ctx.info.node):
+            used = None
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    t = name_tail(item.context_expr)
+                    if t in locals_:
+                        used = t
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                t = name_tail(node.func.value)
+                if t in locals_:
+                    used = t
+            if used is not None:
+                ctor = locals_.pop(used)
+                yield _finding(
+                    r, key.module, ctor,
+                    f"`{used}` is created fresh on every call of "
+                    f"`{key.qualname}` and locked in the same function "
+                    "— no two threads ever share it; hoist it to the "
+                    "instance or module",
+                    scope=key.qualname)
+
+
+def conc_rules() -> List[ConcRule]:
+    return list(CONC_RULES.values())

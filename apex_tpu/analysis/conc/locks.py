@@ -1,0 +1,599 @@
+"""Lockset machinery for the tpu-lint concurrency tier.
+
+:class:`ConcModel` is the shared fact base every ``conc-*`` rule reads:
+
+- **lock registry** — ``self.X = threading.Lock()/RLock()`` in a class
+  body and module-level ``NAME = threading.Lock()`` assignments become
+  named locks (reentrancy recorded); sibling sync primitives
+  (``queue.Queue``, ``threading.Event``/``Condition``/``Thread``) are
+  classified so rules can tell a blocking ``q.get()`` from ``dict.get``
+  and never treat a lock attribute as a data field;
+- **locksets** — ``with self._lock:`` nesting plus linear
+  ``acquire()``..``release()`` spans give every AST node in a function
+  the set of locks lexically held there; a cross-function fixpoint
+  (entry lockset of ``f`` = intersection over ``f``'s call sites of the
+  locks held at each) extends that interprocedurally, so a helper only
+  ever called under the metrics registry lock counts as guarded;
+- **acquisition events** — every lock acquisition with the set held at
+  that moment, the raw material for the acquires-while-holding order
+  graph and the double-acquire check;
+- **field accesses** — every ``self.ATTR`` read/write outside
+  ``__init__``, keyed ``(module, class, attr)`` with its effective
+  lockset, feeding the Eraser-style GuardedBy inference.
+
+Like the AST tier, everything here is purely syntactic over
+:class:`~apex_tpu.analysis.walker.ModuleIndex` objects — nothing is
+imported or executed, and unresolvable references simply contribute no
+fact (precision over recall, per the tier-1 design bias).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import Counter
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.project import ProjectIndex
+from apex_tpu.analysis.walker import (ModuleIndex, call_name, name_tail,
+                                      unwrap_partial, walk_shallow)
+
+#: constructor tails -> sync-primitive kind. A ``self.X = <ctor>()``
+#: assignment anywhere in a class registers the attribute's kind; kinds
+#: other than "lock"/"rlock" exist so rules can classify receivers
+#: (blocking ``.get``/``.wait``/``.join``) and exclude sync primitives
+#: from the shared-field analysis (they synchronize themselves).
+_CTOR_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Queue": "queue",
+    "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "SimpleQueue": "queue",
+    "Event": "event",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Thread": "thread",
+    "Timer": "thread",
+}
+
+SYNC_KINDS = frozenset(_CTOR_KINDS.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class LockKey:
+    """Identity of one lock object: a class attribute (``owner`` is the
+    class qualname) or a module global (``owner`` is None)."""
+
+    module: str                 # rel posix path of the defining module
+    owner: Optional[str]        # class qualname, or None for a global
+    attr: str                   # attribute / global name
+    reentrant: bool = dataclasses.field(default=False, compare=False)
+
+    def display(self) -> str:
+        base = f"{self.owner}.{self.attr}" if self.owner else self.attr
+        return base
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncKey:
+    module: str
+    qualname: str
+
+
+@dataclasses.dataclass
+class FieldAccess:
+    """One ``self.ATTR`` access site outside ``__init__``."""
+
+    field: Tuple[str, str, str]      # (module, class, attr)
+    func: FuncKey
+    node: ast.AST
+    write: bool
+    locks: FrozenSet[LockKey] = frozenset()   # effective (local + entry)
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: LockKey
+    held: FrozenSet[LockKey]         # locks held when this one is taken
+    node: ast.AST
+    func: FuncKey
+
+
+class _FuncCtx:
+    """Per-function analysis context."""
+
+    __slots__ = ("key", "mi", "info", "owner_class", "self_name",
+                 "node_locks", "acquisitions", "parents")
+
+    def __init__(self, key, mi, info, owner_class, self_name):
+        self.key = key
+        self.mi = mi
+        self.info = info
+        self.owner_class = owner_class      # class qualname or None
+        self.self_name = self_name          # "self" param name or None
+        self.node_locks: Dict[int, FrozenSet[LockKey]] = {}
+        self.acquisitions: List[Acquisition] = []
+        self.parents: Dict[int, ast.AST] = {}
+
+
+def _class_qualnames(tree: ast.AST) -> Dict[str, ast.ClassDef]:
+    """Class qualnames mirroring ModuleIndex's function-qualname scheme
+    (``Outer.Inner`` for nested classes, classes inside functions keep
+    the function prefix)."""
+    out: Dict[str, ast.ClassDef] = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                qn = f"{prefix}{child.name}" if prefix else child.name
+                out[qn] = child
+                visit(child, qn + ".")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _is_sync_ctor(node: ast.AST) -> Optional[str]:
+    """Kind string when ``node`` is a recognized sync-primitive
+    constructor call (``threading.Lock()``, ``queue.Queue()``, bare
+    ``Lock()`` after a from-import), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    cn = call_name(node)
+    if cn is None:
+        return None
+    return _CTOR_KINDS.get(cn.split(".")[-1])
+
+
+class ConcModel:
+    """Whole-surface concurrency fact base (see module docstring)."""
+
+    def __init__(self, modules: Dict[str, ModuleIndex],
+                 project: Optional[ProjectIndex] = None):
+        self.modules = modules
+        self.project = project if project is not None \
+            else ProjectIndex(modules)
+        if project is None:
+            self.project.link()
+        #: (module, class-or-None, attr) -> kind from SYNC_KINDS
+        self.attr_kinds: Dict[Tuple[str, Optional[str], str], str] = {}
+        #: registered locks by the same key
+        self.locks: Dict[Tuple[str, Optional[str], str], LockKey] = {}
+        self.funcs: Dict[FuncKey, _FuncCtx] = {}
+        #: caller -> [(call node, callee FuncKey)]
+        self.call_edges: Dict[FuncKey, List[Tuple[ast.AST, FuncKey]]] = {}
+        self.entry_locks: Dict[FuncKey, FrozenSet[LockKey]] = {}
+        self.accesses: List[FieldAccess] = []
+        #: FuncKey -> thread-root names reaching it (threads.py fills it)
+        self.colors: Dict[FuncKey, FrozenSet[str]] = {}
+
+        self._index()
+        self._register_sync_attrs()
+        self._compute_locksets()
+        self._collect_call_edges()
+        self._entry_fixpoint()
+        self._collect_field_accesses()
+
+    # ------------------------------------------------------------ indexing
+
+    def _index(self) -> None:
+        self._classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        for rel, mi in self.modules.items():
+            classes = _class_qualnames(mi.tree)
+            self._classes[rel] = classes
+            for qn, info in mi.functions.items():
+                owner = self._owning_class(classes, qn)
+                self_name = None
+                if owner is not None:
+                    # the shallowest function under the class prefix is
+                    # the method whose first param names the instance;
+                    # nested defs close over the same name
+                    method_qn = qn[:len(owner) + 1] \
+                        + qn[len(owner) + 1:].split(".")[0]
+                    method = mi.functions.get(method_qn)
+                    if method is not None and method.params:
+                        first = method.params[0]
+                        if first in ("self", "cls"):
+                            self_name = first
+                key = FuncKey(rel, qn)
+                self.funcs[key] = _FuncCtx(key, mi, info, owner, self_name)
+
+    @staticmethod
+    def _owning_class(classes: Dict[str, ast.ClassDef],
+                      qualname: str) -> Optional[str]:
+        best = None
+        for cqn in classes:
+            if qualname.startswith(cqn + ".") \
+                    and (best is None or len(cqn) > len(best)):
+                best = cqn
+        return best
+
+    def _register_sync_attrs(self) -> None:
+        """``self.X = <sync ctor>()`` in any method registers
+        ``(module, class, X)``; module-level ``NAME = <sync ctor>()``
+        registers ``(module, None, NAME)``. Locks/RLocks additionally
+        enter the lock registry with their reentrancy."""
+        def register(module, owner, attr, kind):
+            fkey = (module, owner, attr)
+            self.attr_kinds.setdefault(fkey, kind)
+            if kind in ("lock", "rlock"):
+                self.locks.setdefault(
+                    fkey, LockKey(module, owner, attr,
+                                  reentrant=(kind == "rlock")))
+
+        for rel, mi in self.modules.items():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1:
+                    continue
+                kind = _is_sync_ctor(node.value)
+                if kind is None:
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    # module-level global (assignments inside functions
+                    # are rule conc-useless-local-lock's business)
+                    if mi.scope_of(tgt) == "<module>":
+                        register(rel, None, tgt.id, kind)
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name):
+                    info = mi.enclosing_function(tgt)
+                    if info is None:
+                        continue
+                    key = FuncKey(rel, info.qualname)
+                    ctx = self.funcs.get(key)
+                    if ctx is None or ctx.owner_class is None:
+                        continue
+                    if tgt.value.id == (ctx.self_name or "self"):
+                        register(rel, ctx.owner_class, tgt.attr, kind)
+
+    # -------------------------------------------------------- lock lookup
+
+    def attr_kind(self, ctx: "_FuncCtx", expr: ast.AST) -> Optional[str]:
+        """Sync-primitive kind of ``expr`` when it resolves to a
+        registered attribute/global of this function's view, else None."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and ctx.self_name is not None \
+                and expr.value.id == ctx.self_name:
+            return self.attr_kinds.get(
+                (ctx.key.module, ctx.owner_class, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.attr_kinds.get((ctx.key.module, None, expr.id))
+        return None
+
+    def resolve_lock(self, ctx: "_FuncCtx",
+                     expr: ast.AST) -> Optional[LockKey]:
+        """``self._lock`` / module-global ``_LOCK`` -> its LockKey."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and ctx.self_name is not None \
+                and expr.value.id == ctx.self_name:
+            return self.locks.get(
+                (ctx.key.module, ctx.owner_class, expr.attr))
+        if isinstance(expr, ast.Name):
+            return self.locks.get((ctx.key.module, None, expr.id))
+        return None
+
+    # ----------------------------------------------------------- locksets
+
+    def _compute_locksets(self) -> None:
+        for ctx in self.funcs.values():
+            self._lockset_of(ctx)
+
+    def _lockset_of(self, ctx: "_FuncCtx") -> None:
+        """Per-node LOCAL locksets + acquisition events for one function.
+        ``with`` nesting is exact; manual ``acquire()``/``release()`` is
+        tracked linearly within each statement block (good enough for
+        the straight-line spans the repo and fixtures use)."""
+
+        def mark(node: ast.AST, held: FrozenSet[LockKey]) -> None:
+            # walk_shallow: nested defs get their own locksets (their
+            # bodies run when CALLED, not under this lexical lock);
+            # lambdas inherit (they usually run in place, e.g. sort keys)
+            ctx.node_locks[id(node)] = held
+            for sub in walk_shallow(node):
+                ctx.node_locks[id(sub)] = held
+
+        def manual_ops(stmt: ast.stmt) -> Iterator[Tuple[str, LockKey,
+                                                         ast.AST]]:
+            for sub in walk_shallow(stmt):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in ("acquire", "release"):
+                    lk = self.resolve_lock(ctx, sub.func.value)
+                    if lk is not None:
+                        yield sub.func.attr, lk, sub
+
+        def visit_block(stmts: List[ast.stmt],
+                        held: FrozenSet[LockKey]) -> None:
+            extra: FrozenSet[LockKey] = frozenset()
+            for stmt in stmts:
+                eff = held | extra
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    inner = eff
+                    for item in stmt.items:
+                        mark(item.context_expr, inner)
+                        if item.optional_vars is not None:
+                            mark(item.optional_vars, inner)
+                        lk = self.resolve_lock(ctx, item.context_expr)
+                        if lk is not None:
+                            ctx.acquisitions.append(Acquisition(
+                                lk, inner, item.context_expr, ctx.key))
+                            inner = inner | {lk}
+                    visit_block(stmt.body, inner)
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    mark(stmt.test, eff)
+                    visit_block(stmt.body, eff)
+                    visit_block(stmt.orelse, eff)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    mark(stmt.target, eff)
+                    mark(stmt.iter, eff)
+                    visit_block(stmt.body, eff)
+                    visit_block(stmt.orelse, eff)
+                elif isinstance(stmt, ast.Try):
+                    visit_block(stmt.body, eff)
+                    for h in stmt.handlers:
+                        if h.type is not None:
+                            mark(h.type, eff)
+                        visit_block(h.body, eff)
+                    visit_block(stmt.orelse, eff)
+                    visit_block(stmt.finalbody, eff)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef)):
+                    for dec in getattr(stmt, "decorator_list", ()):
+                        mark(dec, eff)
+                else:
+                    mark(stmt, eff)
+                    for op, lk, node in manual_ops(stmt):
+                        if op == "acquire":
+                            ctx.acquisitions.append(Acquisition(
+                                lk, eff, node, ctx.key))
+                            extra = extra | {lk}
+                        else:
+                            extra = extra - {lk}
+
+        body = getattr(ctx.info.node, "body", [])
+        visit_block(body, frozenset())
+        # parent map for the field-access classifier
+        for node in walk_shallow(ctx.info.node):
+            for child in ast.iter_child_nodes(node):
+                ctx.parents[id(child)] = node
+
+    def local_locks(self, func: FuncKey, node: ast.AST) -> FrozenSet[LockKey]:
+        ctx = self.funcs.get(func)
+        if ctx is None:
+            return frozenset()
+        return ctx.node_locks.get(id(node), frozenset())
+
+    def effective_locks(self, func: FuncKey,
+                        node: ast.AST) -> FrozenSet[LockKey]:
+        return (self.local_locks(func, node)
+                | self.entry_locks.get(func, frozenset()))
+
+    # --------------------------------------------------------- call graph
+
+    #: method names every builtin collection/string also has — an attr
+    #: call through one of these on a non-self receiver is far more
+    #: likely ``dict.update``/``list.pop`` than a module method of the
+    #: same name, and a wrong edge here poisons the entry-lock fixpoint
+    #: (``entry.update(...)`` under the metrics lock must not make
+    #: ``AverageMeter.update`` look lock-guarded)
+    _COLLECTION_METHODS = frozenset(
+        n for t in (dict, list, set, frozenset, str, bytes, tuple)
+        for n in dir(t) if not n.startswith("__"))
+
+    def _resolve_callees(self, mi: ModuleIndex, callee: ast.AST,
+                         ctx: Optional["_FuncCtx"] = None
+                         ) -> List[FuncKey]:
+        """Receiver-aware resolution — tighter than the walker's
+        tail-matching, because lockset/coloring facts flow through these
+        edges and a spurious edge manufactures false guards:
+
+        - bare ``f(...)`` -> top-level functions named ``f``, plus
+          nested defs lexically visible from the caller (a bare name
+          cannot reach another class's method);
+        - ``self.m(...)`` -> the owning class's ``m`` exactly;
+        - ``x.m(...)`` -> same-module METHODS named ``m``, unless ``m``
+          is a builtin-collection method name (see above); dotted refs
+          (``kv_pool.observe_pool``) resolve precisely via the project
+          linker either way.
+        """
+        target = unwrap_partial(callee)
+        tail = name_tail(target)
+        out: List[FuncKey] = []
+        if isinstance(target, ast.Name):
+            caller_qn = ctx.key.qualname if ctx is not None else None
+            for info in mi.by_name.get(tail, ()):
+                if "." not in info.qualname:
+                    out.append(FuncKey(mi.path, info.qualname))
+                elif caller_qn is not None and info.parent is not None \
+                        and (caller_qn == info.parent
+                             or caller_qn.startswith(info.parent + ".")):
+                    out.append(FuncKey(mi.path, info.qualname))
+            if out:
+                return out
+        elif isinstance(target, ast.Attribute):
+            recv_is_self = (ctx is not None and ctx.self_name is not None
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == ctx.self_name)
+            if recv_is_self and ctx.owner_class is not None:
+                info = mi.functions.get(f"{ctx.owner_class}.{tail}")
+                if info is not None:
+                    return [FuncKey(mi.path, info.qualname)]
+            elif not recv_is_self \
+                    and tail not in self._COLLECTION_METHODS:
+                out.extend(FuncKey(mi.path, info.qualname)
+                           for info in mi.by_name.get(tail, ())
+                           if "." in info.qualname)
+                if out:
+                    return out
+        from apex_tpu.analysis.walker import dotted_name
+        dn = dotted_name(target)
+        if dn:
+            hit = self.project.resolve_function(mi, dn)
+            if hit is not None:
+                out.append(FuncKey(hit[0].path, hit[1].qualname))
+        return out
+
+    def _collect_call_edges(self) -> None:
+        for key, ctx in self.funcs.items():
+            edges: List[Tuple[ast.AST, FuncKey]] = []
+            for node in walk_shallow(ctx.info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = unwrap_partial(node.func) \
+                    if isinstance(node.func, ast.Call) else node.func
+                for ck in self._resolve_callees(ctx.mi, callee, ctx):
+                    if ck in self.funcs:
+                        edges.append((node, ck))
+            self.call_edges[key] = edges
+
+    def _entry_fixpoint(self) -> None:
+        """entry(f) = intersection over f's call sites of (caller entry
+        | locks held at the site). Intersection is the precise direction
+        for race detection: a callee reached both with and without a
+        lock counts as unguarded. Functions with no known callers start
+        (and stay) at the empty set — anything may call them lock-free."""
+        TOP = None                   # lattice top: intersection identity
+        callers: Dict[FuncKey, List[Tuple[FuncKey, ast.AST]]] = {}
+        for caller, edges in self.call_edges.items():
+            for node, callee in edges:
+                if callee == caller:
+                    continue         # a function's FIRST activation
+                #                      always arrives from outside —
+                #                      recursive self-calls say nothing
+                #                      about its entry lockset
+                callers.setdefault(callee, []).append((caller, node))
+        entry: Dict[FuncKey, Optional[FrozenSet[LockKey]]] = {}
+        for key in self.funcs:
+            entry[key] = TOP if key in callers else frozenset()
+
+        def converge() -> None:
+            changed, rounds = True, 0
+            while changed and rounds < 50:
+                changed = False
+                rounds += 1
+                for key, sites in callers.items():
+                    acc: Optional[FrozenSet[LockKey]] = TOP
+                    for caller, node in sites:
+                        ce = entry[caller]
+                        if ce is TOP:
+                            continue   # unresolved caller: contributes top
+                        site = self.local_locks(caller, node) | ce
+                        acc = site if acc is TOP else (acc & site)
+                    if acc is not TOP and acc != entry[key]:
+                        entry[key] = acc
+                        changed = True
+
+        converge()
+        # call cycles with no resolved external entry stay at top; pin
+        # them to the empty set (anything could call into the cycle
+        # lock-free) and re-converge so their downstream callees still
+        # get their call-site locks
+        if any(v is TOP for v in entry.values()):
+            for k, v in entry.items():
+                if v is TOP:
+                    entry[k] = frozenset()
+            converge()
+        self.entry_locks = {k: (v if v is not TOP else frozenset())
+                            for k, v in entry.items()}
+
+    # ------------------------------------------------------ field accesses
+
+    _INIT_NAMES = ("__init__", "__post_init__", "__new__")
+
+    #: method names that mutate their receiver in place —
+    #: ``self._tokens.append(tok)`` is a WRITE to the field for race
+    #: purposes even though the attribute node itself is a Load
+    _MUTATOR_METHODS = frozenset({
+        "append", "appendleft", "extend", "extendleft", "insert", "pop",
+        "popleft", "popitem", "remove", "clear", "update", "add",
+        "discard", "setdefault", "sort", "reverse", "rotate",
+    })
+
+    def _collect_field_accesses(self) -> None:
+        for key, ctx in self.funcs.items():
+            if ctx.owner_class is None or ctx.self_name is None:
+                continue
+            method = key.qualname.split(".")
+            if any(part in self._INIT_NAMES for part in method):
+                continue             # construction is thread-confined
+            mi = ctx.mi
+            for node in walk_shallow(ctx.info.node):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == ctx.self_name):
+                    continue
+                fkey = (key.module, ctx.owner_class, node.attr)
+                if self.attr_kinds.get(fkey) in SYNC_KINDS:
+                    continue         # sync primitives guard themselves
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    write = True
+                else:
+                    parent = ctx.parents.get(id(node))
+                    if isinstance(parent, ast.Call) \
+                            and parent.func is node \
+                            and f"{ctx.owner_class}.{node.attr}" \
+                            in mi.functions:
+                        continue     # plain method call, not a field
+                    write = False
+                    if isinstance(parent, ast.Attribute) \
+                            and parent.value is node:
+                        gp = ctx.parents.get(id(parent))
+                        if isinstance(gp, ast.Call) \
+                                and gp.func is parent \
+                                and parent.attr in self._MUTATOR_METHODS:
+                            write = True    # in-place container mutation
+                    elif isinstance(parent, ast.Subscript) \
+                            and parent.value is node \
+                            and isinstance(parent.ctx,
+                                           (ast.Store, ast.Del)):
+                        write = True        # self.f[k] = v mutates f
+                self.accesses.append(FieldAccess(
+                    field=fkey, func=key, node=node, write=write,
+                    locks=self.effective_locks(key, node)))
+
+    # ------------------------------------------------------- derived views
+
+    def acquisition_events(self) -> Iterator[Acquisition]:
+        for ctx in self.funcs.values():
+            for acq in ctx.acquisitions:
+                # effective held set = lexically held | caller context
+                yield Acquisition(
+                    acq.lock,
+                    acq.held | self.entry_locks.get(acq.func, frozenset()),
+                    acq.node, acq.func)
+
+    def inferred_guards(self) -> Dict[Tuple[str, str, str],
+                                      Tuple[LockKey, int, int]]:
+        """Eraser-style GuardedBy inference: for each field with at
+        least one write outside ``__init__``, the lock held at the
+        largest share of its access sites — inferred as the field's
+        guard when that share is at least half. Returns
+        ``field -> (lock, guarded_sites, total_sites)``; fields whose
+        accesses never hold any lock are absent (lock-free by design,
+        e.g. pump-confined state — not this tier's business)."""
+        by_field: Dict[Tuple[str, str, str], List[FieldAccess]] = {}
+        for acc in self.accesses:
+            by_field.setdefault(acc.field, []).append(acc)
+        out: Dict[Tuple[str, str, str], Tuple[LockKey, int, int]] = {}
+        for field, sites in by_field.items():
+            if not any(s.write for s in sites):
+                continue
+            counts: Counter = Counter()
+            for s in sites:
+                counts.update(s.locks)
+            if not counts:
+                continue
+            lock, n = counts.most_common(1)[0]
+            if n * 2 >= len(sites):
+                out[field] = (lock, n, len(sites))
+        return out

@@ -1,0 +1,156 @@
+"""Thread-entry discovery and call-graph coloring for the conc tier.
+
+Every function implicitly runs on the MAIN thread (anything may call a
+public API from anywhere — the serving front-end's ``submit()`` contract
+is exactly that). What this module adds is the set of *extra* threads a
+function can run on, by rooting a BFS at every statically visible
+thread entry point:
+
+- ``threading.Thread(target=f, ...)`` / ``threading.Timer(t, f)`` —
+  the root is named by the ctor's literal ``name=`` when present (the
+  pump thread's ``"serving-frontend-pump"``), else the target's name;
+- ``<executor|pool>.submit(f, ...)`` — worker-pool dispatch (the
+  receiver must *look like* an executor so the serving front-end's
+  ``submit(request)`` ingest API never becomes a false root);
+- ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses — the
+  ``/metrics`` endpoint's handler runs on server threads;
+- the callable handed to ``jax.debug.callback`` — the metrics channel
+  delivers on XLA runtime threads (the ``record()`` docstring's
+  contract), so its payload is colored ``jax-callback``.
+
+Colors propagate through the same resolved call edges the lockset
+machinery uses. A function with any color is *multi-thread*: it runs on
+that thread AND (implicitly) wherever else its callers live, which is
+what the shared-field rule needs to know.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from apex_tpu.analysis.conc.locks import ConcModel, FuncKey
+from apex_tpu.analysis.walker import (call_name, kwarg, name_tail,
+                                      unwrap_partial)
+
+#: receiver-name fragments that make an ``.submit(fn, ...)`` call a
+#: worker-pool dispatch rather than an application-level submit API
+_EXECUTORISH = ("executor", "pool", "workers")
+
+_HOST_CALLBACK_FNS = {"jax.debug.callback", "debug.callback"}
+
+
+def _literal_name(call: ast.Call) -> Optional[str]:
+    v = kwarg(call, "name")
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return v.value
+    return None
+
+
+def _target_expr(call: ast.Call, tail: str) -> Optional[ast.AST]:
+    if tail in ("Thread",):
+        v = kwarg(call, "target")
+        if v is not None:
+            return v
+        return call.args[1] if len(call.args) > 1 else None
+    if tail in ("Timer",):
+        v = kwarg(call, "function")
+        if v is not None:
+            return v
+        return call.args[1] if len(call.args) > 1 else None
+    return None
+
+
+def thread_roots(model: ConcModel) -> List[Tuple[str, FuncKey]]:
+    """Statically visible thread entry points: ``(thread name, func)``."""
+    roots: List[Tuple[str, FuncKey]] = []
+
+    def resolve(mi, expr, site) -> List[FuncKey]:
+        if expr is None:
+            return []
+        # resolve from the enclosing function's context so a nested
+        # target (`Thread(target=loop)` inside `start()`) is visible
+        info = mi.enclosing_function(site)
+        ctx = model.funcs.get(FuncKey(mi.path, info.qualname)) \
+            if info is not None else None
+        return model._resolve_callees(mi, unwrap_partial(expr), ctx)
+
+    for rel, mi in model.modules.items():
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = {name_tail(b) or "" for b in node.bases}
+                if any(b.endswith("HTTPRequestHandler") for b in bases):
+                    prefix = _handler_prefix(model, rel, node)
+                    for key, ctx in model.funcs.items():
+                        if key.module == rel \
+                                and ctx.owner_class == prefix \
+                                and key.qualname.split(".")[-1]\
+                                .startswith("do_"):
+                            roots.append(("http-handler", key))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            tail = cn.split(".")[-1] if cn else None
+            if tail in ("Thread", "Timer"):
+                target = _target_expr(node, tail)
+                for fk in resolve(mi, target, node):
+                    roots.append((
+                        _literal_name(node)
+                        or fk.qualname.split(".")[-1], fk))
+            elif tail == "submit" and isinstance(node.func, ast.Attribute):
+                recv = name_tail(node.func.value) or ""
+                if any(w in recv.lower() for w in _EXECUTORISH) \
+                        and node.args:
+                    for fk in resolve(mi, node.args[0], node):
+                        roots.append(("executor", fk))
+            elif cn in _HOST_CALLBACK_FNS and node.args:
+                # only the bare-name / partial forms resolve — a factory
+                # call in the callable position stays opaque, exactly
+                # like the AST tier's exemption logic
+                for fk in resolve(mi, node.args[0], node):
+                    roots.append(("jax-callback", fk))
+    return roots
+
+
+def _handler_prefix(model: ConcModel, rel: str,
+                    cls: ast.ClassDef) -> Optional[str]:
+    """The class qualname matching ``cls`` in the model's class table."""
+    for qn, node in model._classes.get(rel, {}).items():
+        if node is cls:
+            return qn
+    return None
+
+
+def color(model: ConcModel) -> Dict[FuncKey, FrozenSet[str]]:
+    """Propagate thread-root names over the call graph; writes the
+    result into ``model.colors`` and returns it."""
+    colors: Dict[FuncKey, Set[str]] = {}
+    work: List[FuncKey] = []
+    for name, key in thread_roots(model):
+        cur = colors.setdefault(key, set())
+        if name not in cur:
+            cur.add(name)
+            work.append(key)
+    while work:
+        key = work.pop()
+        mine = colors.get(key, set())
+        # lexically nested defs of a thread function also run on it
+        nested = [k for k in model.funcs
+                  if k.module == key.module
+                  and k.qualname.startswith(key.qualname + ".")]
+        callees = [ck for _, ck in model.call_edges.get(key, ())]
+        for nxt in nested + callees:
+            cur = colors.setdefault(nxt, set())
+            if not mine <= cur:
+                cur.update(mine)
+                work.append(nxt)
+    model.colors = {k: frozenset(v) for k, v in colors.items()}
+    return model.colors
+
+
+def describe_threads(model: ConcModel, key: FuncKey) -> str:
+    """``{caller, serving-frontend-pump}`` — the thread set a function
+    runs on, for findings (``caller`` stands for main/any API caller)."""
+    extra = sorted(model.colors.get(key, ()))
+    return "{" + ", ".join(["caller"] + extra) + "}"

@@ -1,0 +1,41 @@
+"""Shared rule-namespace registry for tpu-lint's tiers.
+
+The three tiers (AST, jaxpr IR, host-concurrency) share one CLI, one
+suppression-pragma syntax, and one baseline file; what keeps them from
+clobbering each other's recorded debt is the RULE NAMESPACE: ``ir-*``
+rules belong to the IR tier, ``conc-*`` to the concurrency tier, and
+everything else to the AST tier. This module is the single place that
+mapping lives — ``cli.py``'s tier-partitioned ``--write-baseline`` and
+any future consumer derive a rule's tier from here instead of
+re-implementing per-tier string checks (which is how the IR tier's
+``startswith("ir-")`` special case would have silently mis-filed
+``conc-*`` keys).
+"""
+
+from __future__ import annotations
+
+#: prefix -> tier name, longest-prefix-first if that ever matters.
+#: Adding a tier = adding one entry here; the baseline partitioning,
+#: ``--list-rules`` grouping, and tests pick it up automatically.
+TIER_PREFIXES = (
+    ("ir-", "ir"),
+    ("conc-", "conc"),
+)
+
+AST_TIER = "ast"
+
+
+def tier_of(rule_name: str) -> str:
+    """The tier a rule name belongs to (``ast`` when no prefix claims
+    it — the AST tier owns the unprefixed namespace)."""
+    for prefix, tier in TIER_PREFIXES:
+        if rule_name.startswith(prefix):
+            return tier
+    return AST_TIER
+
+
+def tier_of_key(baseline_key: str) -> str:
+    """Tier of a baseline key (``path::rule::scope``); keys without a
+    rule component count as AST (legacy shape, pre-tier)."""
+    parts = baseline_key.split("::")
+    return tier_of(parts[1]) if len(parts) > 2 else AST_TIER

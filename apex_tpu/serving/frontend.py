@@ -288,9 +288,13 @@ class ServingFrontend:
         it keys the tracer's lifecycle AND the request's sampling stream
         (``fold_in(rng, request_id)``), so two frontends given the same
         ids and rng draw identical streams."""
-        if self._failure is not None:
-            raise RuntimeError("frontend pump has failed") \
-                from self._failure
+        # lock-free fast-fail is intentional double-checked locking (one
+        # snapshot read): the locked re-check below is authoritative;
+        # this only saves validation work on an already-dead frontend
+        # tpu-lint: disable=conc-unguarded-shared-field -- benign race
+        failure = self._failure
+        if failure is not None:
+            raise RuntimeError("frontend pump has failed") from failure
         self.engine._validate_request(request)
         seq = next(self._submit_seq)
         idx = request_id if request_id is not None else seq
@@ -318,8 +322,10 @@ class ServingFrontend:
                     from self._failure
             self._ingest.append(entry)
             depth = len(self._ingest) + len(self._pending)
+            # peak tracking is a read-modify-write; two racing submits
+            # outside the lock could each lose the other's peak
+            self.peak_queue_depth = max(self.peak_queue_depth, depth)
         self._qdepth.set(depth)
-        self.peak_queue_depth = max(self.peak_queue_depth, depth)
         self._work_evt.set()
         return handle
 
@@ -778,6 +784,8 @@ class ServingFrontend:
         stat as a ``serving.<name>`` raw series — call once per run."""
         eng = self.engine
         d = {name: c.value - self._c0[name] for name, c in self._C.items()}
+        with self._ingest_lock:      # peak is written under this lock
+            peak_queue_depth = self.peak_queue_depth
         stats = {
             "decode_steps": int(d["decode_steps"]),
             "admitted": int(d["admitted"]),
@@ -791,7 +799,7 @@ class ServingFrontend:
             "preemptions": int(d["preemptions"]),
             "resumes": int(d["resumes"]),
             "deadline_misses": int(d["deadline_misses"]),
-            "peak_queue_depth": self.peak_queue_depth,
+            "peak_queue_depth": peak_queue_depth,
             "prefix_cache_enabled": eng.prefix is not None,
             "prefix_hits": int(d["prefix_hits"]),
             "prefix_hit_rate": d["prefix_hits"] / max(d["admitted"], 1),

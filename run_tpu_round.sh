@@ -30,9 +30,20 @@ if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --ir; then
   echo "  fix or suppress with justification (docs/static_analysis.md)"
   exit 1
 fi
+# Concurrency tier: thread coloring + lockset/GuardedBy inference +
+# lock-order + resource-lifecycle pairing over the host side of the
+# serving stack (the pump thread, /metrics exporter, callback threads).
+# A race or ABBA inversion should die here, not as a wedged pump on chip.
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate (conc tier)..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --conc; then
+  echo "[$(date +%H:%M:%S)] tpu-lint --conc found new host-concurrency"
+  echo "  hazards; fix or suppress with justification (docs/static_analysis.md)"
+  exit 1
+fi
 # diff-aware gate: when CI exports LINT_DIFF_BASE (e.g. the PR merge
-# base), ALSO fail on AST findings introduced relative to it — catches
-# regressions even if someone grows the baseline file in the same PR
+# base), ALSO fail on AST + conc findings introduced relative to it —
+# catches regressions even if someone grows the baseline file in the
+# same PR (both tiers are source-only, so the base rev is analyzable)
 if [ -n "${LINT_DIFF_BASE:-}" ]; then
   echo "[$(date +%H:%M:%S)] tpu-lint diff gate vs ${LINT_DIFF_BASE}..."
   if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --diff "$LINT_DIFF_BASE"; then

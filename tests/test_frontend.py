@@ -404,3 +404,78 @@ def test_deadlock_still_raises_and_fails_handles(rng):
                       max_new_tokens=10))
     with pytest.raises(RuntimeError, match="deadlock"):
         fe.drain()
+
+
+# --------------------------------------------------------------------------
+# host-concurrency stress (ISSUE 7: the dynamic counterpart of --conc)
+# --------------------------------------------------------------------------
+
+def test_concurrent_submit_cancel_stress(rng):
+    """N producer threads concurrently submit()/cancel()/iterate handles
+    against the background pump under a watchdog: no lost or duplicated
+    tokens (each handle's streamed sequence equals its final output), no
+    deadlock (every thread finishes inside the timeout), and the pool's
+    free-page count returns to baseline after the drain (leak check —
+    preemption spill/resume and cancellation paths all release)."""
+    import threading
+
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                               prefix_cache=True)
+    fe = ServingFrontend(engine, policy=PriorityDeadlinePolicy(
+        preempt_on_priority=True))
+    fe.start()
+    n_threads, n_req = 3, 3
+    errors: list = []
+    results: dict = {}
+
+    def producer(tid: int) -> None:
+        try:
+            local = np.random.default_rng(tid)
+            for i in range(n_req):
+                s0 = 8 + 2 * ((tid + i) % 3)
+                prompt = local.integers(0, cfg.vocab_size, (s0,)
+                                        ).astype(np.int32)
+                h = fe.submit(Request(prompt=prompt, max_new_tokens=5,
+                                      priority=(tid + i) % 3),
+                              request_id=tid * 10 + i)
+                streamed: list = []
+                if (tid + i) % 4 == 3:
+                    # consume one token, then cancel mid-stream
+                    tok = h.get(timeout=120)
+                    if tok is not None:
+                        streamed.append(tok)
+                    h.cancel()
+                for tok in h:            # live-stream the rest
+                    streamed.append(tok)
+                out = h.result(timeout=120)
+                results[(tid, i)] = (streamed, list(out), h.cancelled)
+        except BaseException as exc:     # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=producer, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:                # watchdog: a hang fails, not wedges
+            t.join(timeout=300)
+        stuck = [t.name for t in threads if t.is_alive()]
+        assert not stuck, f"deadlocked producer threads: {stuck}"
+    finally:
+        fe.stop()
+    assert not errors, errors
+    assert len(results) == n_threads * n_req
+    for (tid, i), (streamed, out, cancelled) in results.items():
+        # in-order, nothing dropped, nothing pushed twice
+        assert streamed == out, (tid, i, streamed, out)
+        if not cancelled:
+            assert len(out) == 5 or (
+                engine.eos_token_id is not None)
+    # pool hygiene: every non-cached page returned after the drain
+    usable = engine.cache["free_stack"].shape[0] - 1
+    assert int(free_page_count(engine.cache)) == \
+        usable - len(engine.prefix)
+    # the cached pages are all refcount-0 (no dangling prefix refs)
+    assert int(np.asarray(engine.cache["page_ref"]).sum()) == 0
+    assert fe.stats()["retired"] == n_threads * n_req
