@@ -13,13 +13,28 @@ Dapper (Sigelman et al. 2010):
 - ``events`` — :class:`EventLog`: bounded ring-buffer event log with a
   JSONL postmortem ``dump()``.
 - ``export`` — Prometheus text exposition + JSON snapshots of the
-  metric registry, file-based or via a stdlib HTTP endpoint.
+  metric registry, file-based or via a stdlib HTTP endpoint
+  (``/metrics``, ``/healthz``, ``/costs``).
+
+Performance attribution (PR 8) adds three more, CLI-first:
+
+- ``costs``  — deterministic jaxpr roofline cost model over the lint
+  harness's programs (``python -m apex_tpu.obs.costs``).
+- ``compile_watch`` — :class:`CompileWatcher`: jit recompile /
+  trace-cache-miss counters keyed by function name, with the serving
+  frontend's recompile-storm warning built on top.
+- ``ledger`` — the persistent perf ledger + regression gate
+  (``python -m apex_tpu.obs.ledger --check``, ``PERF_LEDGER.jsonl``).
 """
 
+from apex_tpu.obs.compile_watch import CompileWatcher, watcher
 from apex_tpu.obs.events import EventLog
-from apex_tpu.obs.export import (json_snapshot, prometheus_text, serve,
+from apex_tpu.obs.export import (health_doc, json_snapshot, latest_costs,
+                                 prometheus_text, publish_costs, serve,
                                  write_snapshot)
 from apex_tpu.obs.spans import PHASES, Span, SpanTracer
 
-__all__ = ["EventLog", "PHASES", "Span", "SpanTracer", "json_snapshot",
-           "prometheus_text", "serve", "write_snapshot"]
+__all__ = ["CompileWatcher", "EventLog", "PHASES", "Span", "SpanTracer",
+           "health_doc", "json_snapshot", "latest_costs",
+           "prometheus_text", "publish_costs", "serve", "watcher",
+           "write_snapshot"]

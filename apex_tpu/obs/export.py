@@ -14,10 +14,12 @@ touches a device. Three transports:
   one JSON document (CI artifacts: ``run_tpu_round.sh`` banks one per
   round next to the bench JSON).
 - :func:`serve` — optional stdlib ``http.server`` endpoint exposing
-  ``/metrics`` (Prometheus) and ``/metrics.json`` on a daemon thread;
-  returns the server (``.server_address`` for the bound port,
-  ``.shutdown()`` to stop). No third-party client library, per the
-  no-new-deps rule.
+  ``/metrics`` (Prometheus), ``/metrics.json``, ``/healthz`` (liveness:
+  pump-alive + queue depth of the frontend passed via ``serve(...,
+  frontend=)``), and ``/costs`` (the latest cost-model snapshot
+  registered via :func:`publish_costs`) on a daemon thread; returns the
+  server (``.server_address`` for the bound port, ``.shutdown()`` to
+  stop). No third-party client library, per the no-new-deps rule.
 """
 
 from __future__ import annotations
@@ -32,7 +34,8 @@ from typing import Dict, Optional
 
 from apex_tpu.utils import metrics
 
-__all__ = ["prometheus_text", "json_snapshot", "write_snapshot", "serve"]
+__all__ = ["prometheus_text", "json_snapshot", "write_snapshot", "serve",
+           "publish_costs", "latest_costs", "health_doc"]
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -161,6 +164,44 @@ def write_snapshot(path: str, fmt: Optional[str] = None,
     return path
 
 
+# latest published cost-model snapshot (``/costs``): one process-wide
+# document, written by whoever ran the cost CLI/report last
+_COSTS_LOCK = threading.Lock()
+_COSTS_DOC: Optional[dict] = None
+
+
+def publish_costs(doc: Optional[dict]) -> None:
+    """Make a cost report (``apex_tpu.obs.costs.cost_report(...)``) the
+    document ``/costs`` serves (``None`` unpublishes: back to 404)."""
+    global _COSTS_DOC
+    with _COSTS_LOCK:
+        _COSTS_DOC = doc
+
+
+def latest_costs() -> Optional[dict]:
+    with _COSTS_LOCK:
+        return _COSTS_DOC
+
+
+def health_doc(frontend=None) -> dict:
+    """The ``/healthz`` payload: process liveness plus — when a serving
+    frontend is wired in — pump-thread liveness, queue depth, active
+    slots, and the pump's terminal failure if it died. Shape pinned by
+    tests/test_observability.py."""
+    doc = {"ok": True, "time_unix": time.time(), "frontend": False,
+           "pump_alive": False, "queue_depth": None, "active_slots": None,
+           "failure": None}
+    if frontend is not None:
+        failure = frontend.failure
+        doc.update(
+            frontend=True, pump_alive=frontend.pump_alive,
+            queue_depth=frontend.queue_depth,
+            active_slots=frontend.active_slots,
+            failure=repr(failure) if failure is not None else None)
+        doc["ok"] = failure is None
+    return doc
+
+
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib handler contract)
         path = self.path.split("?", 1)[0]
@@ -170,6 +211,17 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics.json":
             body = (json.dumps(json_snapshot(), sort_keys=True)
                     + "\n").encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            doc = health_doc(getattr(self.server, "frontend", None))
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/costs":
+            doc = latest_costs()
+            if doc is None:
+                self.send_error(404, "no cost snapshot published")
+                return
+            body = (json.dumps(doc, sort_keys=True) + "\n").encode()
             ctype = "application/json"
         else:
             self.send_error(404)
@@ -184,10 +236,14 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(port: int = 0, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+def serve(port: int = 0, host: str = "127.0.0.1",
+          frontend=None) -> ThreadingHTTPServer:
     """Start the metrics endpoint on a daemon thread. ``port=0`` binds an
-    ephemeral port (read it from ``server.server_address[1]``)."""
+    ephemeral port (read it from ``server.server_address[1]``).
+    ``frontend=`` wires a :class:`~apex_tpu.serving.frontend.
+    ServingFrontend` into ``/healthz``."""
     server = ThreadingHTTPServer((host, port), _Handler)
+    server.frontend = frontend
     thread = threading.Thread(target=server.serve_forever,
                               name="apex-tpu-metrics", daemon=True)
     thread.start()

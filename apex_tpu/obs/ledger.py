@@ -1,0 +1,393 @@
+"""Persistent perf ledger + regression gate (``PERF_LEDGER.jsonl``).
+
+Rounds 3–5 taught the lesson this module exists for: the TPU tunnel died
+and the repo's perf trajectory silently went EMPTY — three rounds of
+``BENCH_r0*.json`` record nothing but backend-init failures, so none of
+the serving work since has a checked baseline. The ledger fixes both
+halves:
+
+- **Trajectory**: every round appends one JSON line per source — the
+  deviceless cost-model rollups (``obs/costs.py``, deterministic on
+  CPU), and the bench/decode fields when the tunnel cooperates — each
+  stamped with git rev + timestamp. ``run_tpu_round.sh`` appends the
+  cost entry BEFORE the tunnel probe, so a dead tunnel can no longer
+  empty a round.
+- **Gate**: ``python -m apex_tpu.obs.ledger --check`` recomputes HEAD's
+  metrics and compares them against the most recent ledger values.
+  Deterministic ``cost.*`` metrics must match EXACTLY (they only change
+  when the staged programs change — which is precisely what a reviewer
+  must see); wall-time metrics get a tolerance band (default ±20 %),
+  direction-aware (throughput may rise freely, latency may fall
+  freely). Exit 1 on regression/drift, 2 on a broken ledger.
+
+The ratchet workflow mirrors tpu-lint's baseline: an intentional
+cost-model change fails ``--check`` until the author runs
+``python -m apex_tpu.obs.ledger --append`` and commits the new entry —
+the perf delta is then an explicit, reviewable line in the PR.
+
+Entry format (one JSON object per line)::
+
+    {"schema": 1, "kind": "cost"|"bench"|"seed", "tag": "r06",
+     "git_rev": "<sha>[-dirty]", "time_unix": 1699...,
+     "metrics": {"cost.total_flops": ..., ...}, "meta": {...}}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+import subprocess
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LEDGER_NAME", "load", "append_entry", "head_cost_metrics",
+           "bench_metrics_from_file", "check", "main"]
+
+LEDGER_NAME = "PERF_LEDGER.jsonl"
+
+#: substrings classifying a wall-time metric's good direction; anything
+#: matching neither is recorded but not gated (informational counters)
+_HIGHER_BETTER = ("tokens_per_sec", "_per_sec", "hit_rate", "step_savings",
+                  "speedup")
+_LOWER_BETTER = ("_ms", "misses", "miss_rate", "bubble")
+
+
+def _git_rev(root: Path) -> str:
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True,
+            text=True, timeout=10).stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=root,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        return (rev + "-dirty") if dirty else rev or "unknown"
+    except Exception:       # noqa: BLE001 — the ledger works without git
+        return "unknown"
+
+
+# --------------------------------------------------------------------------
+# storage
+# --------------------------------------------------------------------------
+
+def load(path) -> List[dict]:
+    """Parse the ledger; raises ValueError on a corrupt line (a broken
+    trajectory should fail loudly, not truncate silently)."""
+    entries = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{i}: corrupt ledger line: {e}") from e
+            if not isinstance(entry, dict) or "metrics" not in entry:
+                raise ValueError(
+                    f"{path}:{i}: ledger entry without metrics")
+            entries.append(entry)
+    return entries
+
+
+def append_entry(path, *, kind: str, tag: str,
+                 metrics: Dict[str, float], root=None,
+                 meta: Optional[dict] = None,
+                 when: Optional[float] = None) -> dict:
+    entry = {
+        "schema": 1, "kind": kind, "tag": tag,
+        "git_rev": _git_rev(Path(root) if root else Path(path).parent),
+        "time_unix": round(when if when is not None else time.time(), 3),
+        "metrics": {k: metrics[k] for k in sorted(metrics)},
+    }
+    if meta:
+        entry["meta"] = meta
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return entry
+
+
+# --------------------------------------------------------------------------
+# metric sources
+# --------------------------------------------------------------------------
+
+def head_cost_metrics(root, *, costs_json: Optional[str] = None,
+                      profile: str = "v5e") -> Dict[str, float]:
+    """HEAD's deterministic cost metrics — from a pre-computed
+    ``--json`` report when given (``run_tpu_round.sh`` banks one per
+    round), else by tracing the registry now (~15 s on CPU)."""
+    from apex_tpu.obs import costs
+
+    if costs_json:
+        with open(costs_json) as f:
+            report = json.load(f)
+    else:
+        report = costs.cost_report(root, profile=profile)
+    if report.get("errors"):
+        raise RuntimeError(
+            "cost report has trace errors; fix those before gating: "
+            + "; ".join(e["case"] for e in report["errors"]))
+    return costs.ledger_metrics(report)
+
+
+#: numeric bench-record fields worth tracking besides the headline value
+_BENCH_FIELDS = (
+    "step_ms", "int8_speedup", "step_savings",
+    "gpt2_paged_decode_ttft_ms_p50", "gpt2_paged_decode_ttft_ms_p95",
+    "decode_step_ms_p50", "decode_step_ms_p95",
+    "gpt2_frontend_ttft_ms_p50", "gpt2_frontend_ttft_ms_p95",
+    "gpt2_frontend_tpot_ms_p50", "gpt2_frontend_tpot_ms_p95",
+    "gpt2_frontend_deadline_miss_rate", "prefix_hit_rate",
+    "pump.bubble_ms", "jit.compiles",
+)
+
+
+def bench_metrics_from_file(path) -> Tuple[Dict[str, float], dict]:
+    """Extract (metrics, meta) from a bench artifact. Accepts the
+    driver's wrapper shape (``BENCH_r0*.json``: one object with a
+    ``parsed`` record), a bare record, or JSONL of records
+    (``DECODE_*.json``)."""
+    text = Path(path).read_text().strip()
+    records: List[dict] = []
+    meta: dict = {"source": os.path.basename(str(path))}
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "parsed" in doc:
+            meta["rc"] = doc.get("rc")
+            if isinstance(doc.get("parsed"), dict):
+                records = [doc["parsed"]]
+        elif isinstance(doc, dict):
+            records = [doc]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                records.append(rec)
+    out: Dict[str, float] = {}
+    errors = []
+    for rec in records:
+        name = rec.get("metric")
+        if name and isinstance(rec.get("value"), (int, float)):
+            out[name] = float(rec["value"])
+        if rec.get("error"):
+            errors.append(str(rec["error"])[:200])
+        for field in _BENCH_FIELDS:
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[field] = float(v)
+    if errors:
+        meta["errors"] = errors
+    return out, meta
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Regression:
+    metric: str
+    baseline: float
+    head: float
+    kind: str                         # "exact-drift" | "band"
+    baseline_tag: str
+
+    def __str__(self):
+        return (f"{self.metric}: {self.baseline} -> {self.head} "
+                f"[{self.kind}, baseline {self.baseline_tag}]")
+
+
+def _direction(name: str) -> Optional[str]:
+    if any(s in name for s in _HIGHER_BETTER):
+        return "higher"
+    if any(s in name for s in _LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def check(head: Dict[str, float], entries: List[dict], *,
+          band_pct: float = 20.0) -> List[Regression]:
+    """Compare HEAD metrics against the most recent ledger value of
+    EACH metric, scanning the whole history — cost entries append every
+    round (deliberately, even with the tunnel dead), so a fixed entry
+    window would age the bench metrics out of the baseline and silently
+    stop gating them. Only metrics present on BOTH sides gate — a newly
+    added metric passes, a retired one is the next append's business."""
+    baseline: Dict[str, Tuple[float, str]] = {}
+    for entry in entries:            # oldest -> newest: newest wins
+        tag = f"{entry.get('tag', '?')}@{entry.get('git_rev', '?')[:12]}"
+        for name, value in entry.get("metrics", {}).items():
+            if isinstance(value, (int, float)):
+                baseline[name] = (float(value), tag)
+    out: List[Regression] = []
+    for name, head_v in sorted(head.items()):
+        if name not in baseline:
+            continue
+        base_v, tag = baseline[name]
+        if name.startswith("cost."):
+            # deterministic: any drift is a (possibly intentional)
+            # change that must be appended, i.e. reviewed
+            if head_v != base_v:
+                out.append(Regression(name, base_v, head_v,
+                                      "exact-drift", tag))
+            continue
+        direction = _direction(name)
+        if direction is None or base_v == 0.0:
+            continue                 # informational, or dead baseline
+        worse = (base_v - head_v) if direction == "higher" \
+            else (head_v - base_v)
+        if worse > abs(base_v) * band_pct / 100.0:
+            out.append(Regression(name, base_v, head_v, "band", tag))
+    return out
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _seed_history(root: Path, path: Path) -> int:
+    """Backfill the ledger from the banked round artifacts
+    (``BENCH_r0*.json`` wrappers; failed rounds land with value 0.0 and
+    their error in meta — an honest record of the empty stretch).
+    Idempotent: a round whose seed entry already exists is skipped, so
+    re-running cannot duplicate the committed trajectory."""
+    seeded = set()
+    if path.exists():
+        seeded = {(e.get("kind"), e.get("tag")) for e in load(path)}
+    n = 0
+    for bench in sorted(_glob.glob(str(root / "BENCH_r[0-9]*.json"))):
+        base = os.path.basename(bench)
+        tag = base[len("BENCH_"):].split(".")[0].split("_")[0]
+        if ("seed", tag) in seeded:
+            continue
+        metrics, meta = bench_metrics_from_file(bench)
+        if not metrics:
+            continue
+        append_entry(path, kind="seed", tag=tag, metrics=metrics,
+                     root=root, meta=meta,
+                     when=os.path.getmtime(bench))
+        n += 1
+    return n
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.obs.ledger",
+        description="Perf ledger: append round entries, gate HEAD "
+                    "against the trajectory (docs/observability.md)")
+    parser.add_argument("--root", default=None)
+    parser.add_argument("--ledger", default=None,
+                        help=f"path (default <root>/{LEDGER_NAME})")
+    parser.add_argument("--tag", default="head")
+    parser.add_argument("--costs", default=None, metavar="JSON",
+                        help="pre-computed obs.costs --json report")
+    parser.add_argument("--bench", default=None, metavar="JSON",
+                        help="bench/decode artifact to extract metrics "
+                             "from")
+    parser.add_argument("--profile", default="v5e")
+    parser.add_argument("--band-pct", type=float, default=20.0)
+    action = parser.add_mutually_exclusive_group(required=True)
+    action.add_argument("--check", action="store_true",
+                        help="exit 1 if HEAD regressed vs the ledger")
+    action.add_argument("--append", action="store_true",
+                        help="append HEAD's entry (cost metrics, plus "
+                             "--bench fields when given)")
+    action.add_argument("--seed-history", action="store_true",
+                        help="backfill from banked BENCH_r0*.json")
+    action.add_argument("--show", action="store_true")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root).resolve() if args.root \
+        else Path(__file__).resolve().parents[2]
+    path = Path(args.ledger) if args.ledger else root / LEDGER_NAME
+
+    if args.seed_history:
+        n = _seed_history(root, path)
+        print(f"[ledger] seeded {n} historical entries into {path}")
+        return 0
+
+    if args.show:
+        try:
+            entries = load(path)
+        except (OSError, ValueError) as e:
+            print(f"[ledger] {e}")
+            return 2
+        for entry in entries:
+            named = entry.get("metrics", {})
+            print(f"{entry.get('tag'):>6s} {entry.get('kind'):>5s} "
+                  f"{entry.get('git_rev', '')[:12]:12s} "
+                  f"{len(named)} metrics")
+        return 0
+
+    if args.append:
+        try:
+            if args.bench:
+                metrics, meta = bench_metrics_from_file(args.bench)
+                entry = append_entry(path, kind="bench", tag=args.tag,
+                                     metrics=metrics, root=root,
+                                     meta=meta)
+            else:
+                metrics = head_cost_metrics(root, costs_json=args.costs,
+                                            profile=args.profile)
+                entry = append_entry(path, kind="cost", tag=args.tag,
+                                     metrics=metrics, root=root)
+        except (OSError, ValueError, RuntimeError,
+                json.JSONDecodeError) as e:
+            print(f"[ledger] append failed: {e}")
+            return 2
+        print(f"[ledger] appended {entry['kind']} entry "
+              f"({len(entry['metrics'])} metrics) as {entry['git_rev']}")
+        return 0
+
+    # --check
+    if not path.exists():
+        print(f"[ledger] {path} missing — the perf trajectory is empty. "
+              f"Seed it: python -m apex_tpu.obs.ledger --seed-history "
+              f"&& ... --append")
+        return 2
+    try:
+        entries = load(path)
+    except ValueError as e:
+        print(f"[ledger] {e}")
+        return 2
+    if not entries:
+        print(f"[ledger] {path} is empty — append an entry first")
+        return 2
+    try:
+        head = head_cost_metrics(root, costs_json=args.costs,
+                                 profile=args.profile)
+        if args.bench:
+            bench, _ = bench_metrics_from_file(args.bench)
+            head.update(bench)
+    except (OSError, ValueError, RuntimeError,
+            json.JSONDecodeError) as e:
+        print(f"[ledger] cannot compute HEAD metrics: {e}")
+        return 2
+    regressions = check(head, entries, band_pct=args.band_pct)
+    if regressions:
+        print(f"[ledger] {len(regressions)} regression(s) vs "
+              f"{path.name}:")
+        for r in regressions:
+            print(f"  {r}")
+        print("[ledger] if intentional, append + commit the new entry: "
+              "python -m apex_tpu.obs.ledger --append --tag <tag>")
+        return 1
+    print(f"[ledger] OK — {len(head)} HEAD metrics checked against "
+          f"{len(entries)} entries, no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

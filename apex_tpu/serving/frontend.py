@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.obs import compile_watch
 from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.serving import kv_pool
@@ -77,6 +78,17 @@ __all__ = ["ServingFrontend", "StreamHandle"]
 
 #: sentinel closing a handle's token stream
 _END = object()
+
+#: pump pipeline timing series (run-local percentiles in ``stats()``;
+#: cumulative distributions in the engine-labeled histograms):
+#: ``dispatch_ready_ms`` = device wall time of one decode chunk from
+#: dispatch to the host observing its tokens, ``host_work_ms`` = the
+#: host side of one pump iteration NET of time blocked on the device,
+#: ``bubble_ms`` = device idle between a chunk completing and the next
+#: dispatch — the direct measurement of whether the double-buffered
+#: host work is actually hidden (docs/frontend.md)
+_PUMP_SERIES = ("pump.dispatch_ready_ms", "pump.host_work_ms",
+                "pump.bubble_ms")
 
 
 class StreamHandle:
@@ -174,7 +186,7 @@ class _Entry:
     __slots__ = ("idx", "handle", "prompt", "total_new", "priority",
                  "deadline_at", "arrival", "seq", "resume", "prev",
                  "seg_tokens", "nodes", "n_private", "joined",
-                 "first_token_seen")
+                 "first_token_seen", "tpot_slo", "deadline_missed")
 
     def __init__(self, idx, handle, prompt, total_new, priority,
                  deadline_at, arrival, seq):
@@ -193,6 +205,8 @@ class _Entry:
         self.n_private = 0
         self.joined = 0
         self.first_token_seen = False
+        self.tpot_slo = None
+        self.deadline_missed = False
 
     @property
     def s0(self) -> int:
@@ -268,9 +282,34 @@ class ServingFrontend:
         self._c0 = {name: c.value for name, c in self._C.items()}
         self._H = {name: metrics.histogram(f"serving.{name}", labels=labels)
                    for name in _RUN_HISTOGRAMS}
-        self._per_run = {name: [] for name in _RUN_HISTOGRAMS}
+        self._per_run = {name: [] for name in _RUN_HISTOGRAMS
+                         + _PUMP_SERIES}
         self._occ = metrics.gauge("serving.slots_in_use", labels=labels)
         self._qdepth = metrics.gauge("serving.queue_depth", labels=labels)
+        # pump pipeline timing (docs/frontend.md "Measuring the pump"):
+        # chunk device time is labeled by phase — a preempt-flush chunk
+        # is harvested synchronously mid-iteration and must not pollute
+        # the steady-state distribution
+        self._pump_H = {
+            (name, phase): metrics.histogram(
+                name, labels={**labels, "phase": phase})
+            for name in ("pump.dispatch_ready_ms",)
+            for phase in ("steady", "preempt")}
+        self._host_H = metrics.histogram("pump.host_work_ms",
+                                         labels=labels)
+        self._bubble = metrics.gauge("pump.bubble_ms", labels=labels)
+        self._last_ready: Optional[float] = None
+        self._wait_s = 0.0
+        # TPOT-SLO burn rate: (time, missed) per SLO-carrying retirement
+        # inside the policy's rolling window (pump-confined state)
+        self._slo_window: deque = deque()
+        self._slo_burn = metrics.gauge("serving.slo_burn", labels=labels)
+        # recompile watcher (docs/observability.md): process-wide hooks,
+        # per-frontend delta window for stats + storm warnings
+        self._watch = compile_watch.watcher()
+        self._jit0 = self._watch.counts()
+        self._jit_totals0 = self._watch.totals()
+        self._storm_seen: set = set()
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._work_evt = threading.Event()
@@ -307,6 +346,7 @@ class ServingFrontend:
         handle = StreamHandle(idx)
         entry = _Entry(idx, handle, prompt, request.max_new_tokens,
                        request.priority, deadline_at, arrival, seq)
+        entry.tpot_slo = request.tpot_slo_ms
         self.tracer.event(idx, "enqueue",
                           prompt_tokens=int(prompt.shape[0]),
                           max_new_tokens=request.max_new_tokens,
@@ -333,6 +373,27 @@ class ServingFrontend:
     def queue_depth(self) -> int:
         with self._ingest_lock:
             return len(self._ingest) + len(self._pending)
+
+    @property
+    def active_slots(self) -> int:
+        """Slots currently decoding (an instantaneous read — the pump
+        owns ``_active``; ``len`` of a dict is atomic in CPython)."""
+        return len(self._active)
+
+    @property
+    def pump_alive(self) -> bool:
+        """True while the background pump thread is running (the
+        ``/healthz`` liveness bit; a synchronously driven frontend
+        reports False — its caller IS the pump)."""
+        thread = self._thread
+        return thread is not None and thread.is_alive()
+
+    @property
+    def failure(self) -> Optional[BaseException]:
+        """The pump's terminal failure, if any (``/healthz`` surfaces
+        its repr)."""
+        with self._ingest_lock:
+            return self._failure
 
     def _drain_ingest(self) -> None:
         with self._ingest_lock:
@@ -371,10 +432,27 @@ class ServingFrontend:
         deadlock (a queued request that cannot be admitted even with
         every slot vacant and every evictable page evicted)."""
         eng = self.engine
+        t_iter0 = self.clock()
+        self._wait_s = 0.0
         self._drain_ingest()
         prev, self._inflight = self._inflight, None
         if self._active:
+            # the device sat idle iff everything dispatched so far has
+            # already completed: either nothing was in flight (the last
+            # chunk's completion time is in _last_ready), or the chunk
+            # still nominally in flight was materialized early by an
+            # admission's pool read. The gap from that completion to
+            # this dispatch is the pipeline bubble the double-buffering
+            # exists to hide — pay attention when it grows.
+            idle_since = prev.t_done if prev is not None \
+                else self._last_ready
             self._dispatch()
+            if idle_since is not None:
+                bubble_ms = max(0.0,
+                                (self._inflight.t0 - idle_since) * 1e3)
+                self._bubble.set(bubble_ms)
+                self._per_run["pump.bubble_ms"].append(bubble_ms)
+                self._last_ready = None
         if prev is not None:
             self._harvest(prev)
         admitted = self._admission()
@@ -388,7 +466,19 @@ class ServingFrontend:
             kv_pool.observe_pool(eng.cache, labels=eng.obs_labels)
             self._pool_dirty = False
         self._qdepth.set(len(self._pending))
-        return bool(self._pending or self._active or self._inflight)
+        if prev is not None or admitted:
+            # host cost of this iteration net of time blocked on the
+            # device — with the chunk in flight, this is the work the
+            # pipeline hides (bubble_ms above is what leaked through)
+            host_ms = max(0.0, (self.clock() - t_iter0 - self._wait_s)
+                          * 1e3)
+            self._host_H.observe(host_ms)
+            self._per_run["pump.host_work_ms"].append(host_ms)
+        self._check_compile_storm()
+        alive = bool(self._pending or self._active or self._inflight)
+        if not alive:
+            self._last_ready = None      # idle gaps are not bubbles
+        return alive
 
     # tpu-lint: host-boundary -- synchronous drive of the pump loop
     def drain(self) -> None:
@@ -464,16 +554,27 @@ class ServingFrontend:
         done (harvest, or an admission's pool read) fixes the
         measurement before unrelated host work can inflate it."""
         if chunk.toks_np is None:
+            t_enter = self.clock()
             chunk.toks_np = np.asarray(chunk.toks)
             chunk.t_done = self.clock()
+            # the blocked span counts as device wait, not host work
+            self._wait_s += chunk.t_done - t_enter
+            self._last_ready = chunk.t_done
         return chunk.toks_np
 
-    def _harvest(self, chunk: _Chunk) -> None:
+    def _harvest(self, chunk: _Chunk, *, phase: str = "steady") -> None:
         eng = self.engine
         toks_np = self._materialize(chunk)
-        step_ms = (chunk.t_done - chunk.t0) * 1e3 / eng.sync_every
+        chunk_ms = (chunk.t_done - chunk.t0) * 1e3
+        step_ms = chunk_ms / eng.sync_every
         self._H["decode_step_ms"].observe(step_ms)
         self._per_run["decode_step_ms"].append(step_ms)
+        self._pump_H[("pump.dispatch_ready_ms", phase)].observe(chunk_ms)
+        if phase == "steady":
+            # the run percentiles are the STEADY-state device time; a
+            # preemption flush harvests mid-chunk and only its labeled
+            # histogram keeps that wall time
+            self._per_run["pump.dispatch_ready_ms"].append(chunk_ms)
         eos = eng.eos_token_id
         for slot in list(self._active):
             entry = self._active[slot]
@@ -502,7 +603,7 @@ class ServingFrontend:
         precondition for a correct preemption spill."""
         prev, self._inflight = self._inflight, None
         if prev is not None:
-            self._harvest(prev)
+            self._harvest(prev, phase="preempt")
 
     # --- retirement / preemption --------------------------------------------
 
@@ -526,12 +627,39 @@ class ServingFrontend:
         eng.cache = eng._release_jit(eng.cache, jnp.int32(slot),
                                      jnp.asarray(keep))
 
-    def _observe_lifecycle(self, idx) -> None:
+    def _observe_lifecycle(self, idx) -> dict:
         life = self.tracer.lifecycle(idx)
         for name in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
             if name in life:
                 self._H[name].observe(life[name])
                 self._per_run[name].append(life[name])
+        return life
+
+    def _observe_slo(self, entry: _Entry, life: dict, now: float) -> None:
+        """The TPOT-SLO check (once, at retirement) + the rolling burn
+        gauge over the policy's window: SLO-carrying retirements that
+        missed either their TTFT deadline or their TPOT target, as a
+        rate. Pump-confined — no lock."""
+        missed_tpot = (entry.tpot_slo is not None
+                       and life.get("tpot_ms") is not None
+                       and life["tpot_ms"] > entry.tpot_slo)
+        if missed_tpot:
+            self._C["tpot_slo_misses"].inc()
+            self.tracer.event(entry.idx, "tpot_slo_miss",
+                              tpot_ms=life["tpot_ms"],
+                              slo_ms=entry.tpot_slo)
+            self.engine.events.emit("tpot_slo_miss", request=entry.idx,
+                                    tpot_ms=round(life["tpot_ms"], 3),
+                                    slo_ms=entry.tpot_slo)
+        if entry.tpot_slo is None and entry.deadline_at is None:
+            return
+        self._slo_window.append(
+            (now, bool(missed_tpot or entry.deadline_missed)))
+        horizon = now - self.policy.slo_window_s
+        while self._slo_window and self._slo_window[0][0] < horizon:
+            self._slo_window.popleft()
+        misses = sum(1 for _, m in self._slo_window if m)
+        self._slo_burn.set(misses / len(self._slo_window))
 
     def _retire(self, slot: int, *, cancelled: bool = False) -> None:
         eng = self.engine
@@ -546,7 +674,9 @@ class ServingFrontend:
         eng.events.emit("cancel" if cancelled else "retire",
                         request=entry.idx, slot=slot,
                         new_tokens=int(output.shape[0]))
-        self._observe_lifecycle(entry.idx)
+        life = self._observe_lifecycle(entry.idx)
+        if not cancelled:
+            self._observe_slo(entry, life, self.clock())
         self._release_pages(slot, entry)
         self._pool_dirty = True
         entry.handle._finish(output)
@@ -707,6 +837,7 @@ class ServingFrontend:
             # re-admission never re-counts
             if (entry.deadline_at is not None
                     and self.clock() > entry.deadline_at):
+                entry.deadline_missed = True
                 self._C["deadline_misses"].inc()
                 tr.event(idx, "deadline_miss")
                 eng.events.emit("deadline_miss", request=idx)
@@ -774,6 +905,22 @@ class ServingFrontend:
             break
         return admitted
 
+    # --- recompile storm check ----------------------------------------------
+
+    def _check_compile_storm(self) -> None:
+        """Warn (once per function name, into the engine's postmortem
+        ring) when one program recompiled storm-many times within this
+        frontend's lifetime — a recompile inside the pump is a serving
+        latency cliff the IR tier's cardinality lint can only bound
+        statically (docs/observability.md)."""
+        storms = self._watch.storms(
+            self._jit0, threshold=compile_watch.DEFAULT_STORM_THRESHOLD)
+        for name, n in storms.items():
+            if name not in self._storm_seen:
+                self._storm_seen.add(name)
+                self.engine.events.emit("compile_storm", fn=name,
+                                        compiles=n)
+
     # --- run-scoped stats ---------------------------------------------------
 
     def stats(self) -> dict:
@@ -799,6 +946,8 @@ class ServingFrontend:
             "preemptions": int(d["preemptions"]),
             "resumes": int(d["resumes"]),
             "deadline_misses": int(d["deadline_misses"]),
+            "tpot_slo_misses": int(d["tpot_slo_misses"]),
+            "slo_burn": self._slo_burn.value,
             "peak_queue_depth": peak_queue_depth,
             "prefix_cache_enabled": eng.prefix is not None,
             "prefix_hits": int(d["prefix_hits"]),
@@ -811,6 +960,17 @@ class ServingFrontend:
             "prefill_tokens_skipped": int(d["prefill_tokens_total"]
                                           - d["prefill_tokens_computed"]),
         }
+        # pump pipeline attribution + the recompile window
+        # (docs/frontend.md "Measuring the pump"): bubble is the mean
+        # device-idle gap per handoff — ~0 when double-buffering hides
+        # the host work
+        bubbles = self._per_run["pump.bubble_ms"]
+        stats["pump.bubble_ms"] = float(np.mean(bubbles)) if bubbles \
+            else 0.0
+        compiles, trace_misses = self._watch.totals()
+        stats["jit.compiles"] = compiles - self._jit_totals0[0]
+        stats["jit.trace_cache_misses"] = \
+            trace_misses - self._jit_totals0[1]
         # run-local latency percentiles (the global histograms hold the
         # engine-lifetime distributions; these are exact per run)
         for name, vals in self._per_run.items():

@@ -54,11 +54,16 @@ class PriorityDeadlinePolicy:
     ``preempt_on_priority``: preempt for any strictly-higher-priority
     blocked request even without a deadline — the most aggressive
     setting, used by the forced-preemption bench workload.
+    ``slo_window_s``: the rolling window over which the frontend's
+    ``serving.slo_burn`` gauge reports the SLO miss RATE (TTFT-deadline
+    and TPOT-SLO misses over SLO-carrying retirements) — the policy owns
+    the deadline semantics, so it owns the burn-rate horizon too.
     """
 
     preemption: bool = True
     preempt_margin_ms: float = 0.0
     preempt_on_priority: bool = False
+    slo_window_s: float = 60.0
 
     # -- queue ordering ------------------------------------------------------
 

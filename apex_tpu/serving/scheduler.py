@@ -76,7 +76,8 @@ _RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
                  "prefix_hits", "prefill_tokens_total",
                  "prefill_tokens_computed", "evicted_pages",
                  "deferred_admissions", "defrag_runs",
-                 "preemptions", "resumes", "deadline_misses")
+                 "preemptions", "resumes", "deadline_misses",
+                 "tpot_slo_misses")
 
 #: per-request latency histograms (``serving.<name>``, log-bucketed ms)
 _RUN_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "decode_step_ms")
@@ -106,6 +107,12 @@ class Request:
       monotonic ``time.perf_counter`` timebase (NOT wall clock) so
       deadlines survive clock steps. None = stamped at ``submit()``;
       trace replays pass explicit values.
+    - ``tpot_slo_ms``: a steady-state time-per-output-token SLO. Checked
+      once at retirement against the request's lifecycle TPOT
+      (docs/observability.md); a miss increments
+      ``serving.tpot_slo_misses`` and feeds the rolling
+      ``serving.slo_burn`` gauge — the request is never truncated.
+      None = no TPOT SLO.
     """
 
     prompt: Any                      # (s0,) int array
@@ -113,6 +120,7 @@ class Request:
     priority: int = 0
     deadline_ms: Optional[float] = None
     arrival_time: Optional[float] = None
+    tpot_slo_ms: Optional[float] = None
 
 
 def _donate_cache():

@@ -52,6 +52,31 @@ if [ -n "${LINT_DIFF_BASE:-}" ]; then
   fi
 fi
 
+# PERF-ATTRIBUTION gates (docs/observability.md "Cost attribution & perf
+# ledger"): the deviceless roofline cost report over every lint-harness
+# program, banked as a round artifact, then the perf-ledger regression
+# gate — deterministic cost.* metrics must match the last committed
+# entry exactly (an intentional change is appended + committed, i.e.
+# reviewed), wall-time metrics get a tolerance band.
+echo "[$(date +%H:%M:%S)] cost-model report (deviceless roofline)..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.costs --json "COSTS_${TAG}.json"; then
+  echo "[$(date +%H:%M:%S)] cost model failed to trace a registered case;"
+  echo "  fix the entry point (or its harness registration) first"
+  exit 1
+fi
+echo "[$(date +%H:%M:%S)] perf-ledger regression gate..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check --costs "COSTS_${TAG}.json"; then
+  echo "[$(date +%H:%M:%S)] perf ledger: HEAD drifted/regressed vs"
+  echo "  PERF_LEDGER.jsonl; if intentional, append + commit:"
+  echo "  python -m apex_tpu.obs.ledger --append --tag ${TAG}"
+  exit 1
+fi
+# append this round's deterministic entry NOW — before the tunnel probe
+# can exit the script — so a dead tunnel never leaves the round's perf
+# trajectory empty again (the r03–r05 failure mode)
+JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
+  --costs "COSTS_${TAG}.json"
+
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
@@ -260,5 +285,23 @@ if bench_done && [ ! -f "DECODE_${TAG}.json" ]; then
   tail -2 "decode_${TAG}.stderr.log"
   [ -f "METRICS_${TAG}.json" ] && \
     echo "[$(date +%H:%M:%S)] metrics snapshot banked: METRICS_${TAG}.json"
+  # band-gate THIS round's wall-time numbers against the trajectory
+  # (the pre-probe gate only covers the deterministic cost metrics —
+  # bench fields exist only once the chip has spoken), then bank them.
+  # Check BEFORE append: checking after would compare the round to
+  # itself. A regression fails the round at exit, after all evidence
+  # is banked.
+  if [ -f "DECODE_${TAG}.json" ]; then
+    if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check \
+        --costs "COSTS_${TAG}.json" --bench "DECODE_${TAG}.json"; then
+      echo "[$(date +%H:%M:%S)] perf ledger: WALL-TIME regression vs the"
+      echo "  trajectory (see above); round marked failed — the entry is"
+      echo "  still appended so the regression itself is on record"
+      LEDGER_BENCH_RC=1
+    fi
+    JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append \
+      --tag "$TAG" --bench "DECODE_${TAG}.json"
+  fi
 fi
 echo "[$(date +%H:%M:%S)] done — commit TPU_TESTS_${TAG}.log + BENCH_${TAG}.json.local if nonzero"
+exit "${LEDGER_BENCH_RC:-0}"

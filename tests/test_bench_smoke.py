@@ -107,6 +107,14 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     assert fe["resumes"] > 0
     assert fe["peak_queue_depth"] >= 1
     assert fe["prefill_tokens_skipped"] > 0   # resume = a cache hit
+    # pump pipeline attribution + recompile window (ISSUE 8): the
+    # acceptance fields, present and sane
+    assert fe["pump.bubble_ms"] >= 0.0
+    assert fe["pump.dispatch_ready_ms_p50"] > 0
+    assert fe["pump.host_work_ms_p50"] >= 0
+    assert fe["jit.compiles"] >= 0
+    assert fe["jit.trace_cache_misses"] >= 0
+    assert fe["tpot_slo_misses"] >= 0 and 0.0 <= fe["slo_burn"] <= 1.0
 
     # the run_tpu_round.sh metrics artifact: a strict-JSON registry
     # snapshot holding the serving histograms

@@ -350,6 +350,15 @@ def test_guardedby_inference_on_live_frontend():
     assert guards[("SpanTracer", "_spans")] == "SpanTracer._lock"
     assert guards[("EventLog", "_buf")] == "EventLog._lock"
     assert guards[("Counter", "_value")] == "_LOCK"
+    # ISSUE 8: the pump timing / SLO-window fields are pump-confined by
+    # design — never locked anywhere, so the inference must NOT claim a
+    # guard for them (a half-locked access pattern would fire the rule)
+    for field in ("_last_ready", "_wait_s", "_slo_window",
+                  "_storm_seen"):
+        assert ("ServingFrontend", field) not in guards
+    # the compile watcher's tables ARE locked everywhere
+    assert guards[("CompileWatcher", "_compiles")] == \
+        "CompileWatcher._lock"
 
 
 def test_docs_thread_safety_contract_matches_inference():

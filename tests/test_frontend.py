@@ -393,6 +393,125 @@ def test_lifecycle_reports_time_in_preempted(rng):
     assert "preemptions" not in fe.tracer.lifecycle(untouched)
 
 
+def test_pump_timing_fields_present_and_sane(rng):
+    """ISSUE 8 acceptance: a frontend run's stats carry the pump
+    pipeline attribution (`pump.bubble_ms`, dispatch-ready/host-work
+    percentiles) and the recompile window (`jit.compiles`), and the
+    engine-labeled pump instruments exist in the registry."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                               sync_every=2)
+    fe = ServingFrontend(engine)
+    reqs = [Request(prompt=rng.integers(0, cfg.vocab_size, (9,)
+                                        ).astype(np.int32),
+                    max_new_tokens=6) for _ in range(4)]
+    handles = [fe.submit(r, request_id=i) for i, r in enumerate(reqs)]
+    fe.drain()
+    for h in handles:
+        h.result(timeout=0)
+    stats = fe.stats()
+    assert stats["pump.bubble_ms"] >= 0.0
+    assert stats["pump.dispatch_ready_ms_p50"] > 0.0
+    assert (stats["pump.dispatch_ready_ms_p95"]
+            >= stats["pump.dispatch_ready_ms_p50"])
+    assert (0.0 <= stats["pump.host_work_ms_p50"]
+            <= stats["pump.host_work_ms_p95"])
+    assert stats["jit.compiles"] >= 0
+    assert stats["jit.trace_cache_misses"] >= stats["jit.compiles"]
+    labels = dict(engine.obs_labels, phase="steady")
+    assert metrics.histogram("pump.dispatch_ready_ms",
+                             labels=labels).count > 0
+    assert metrics.histogram("pump.host_work_ms",
+                             labels=engine.obs_labels).count > 0
+    assert metrics.gauge("pump.bubble_ms",
+                         labels=engine.obs_labels).value >= 0.0
+
+
+def test_preempt_flush_chunks_labeled_separately(rng):
+    """A preemption flush harvests the in-flight chunk synchronously;
+    its device time lands under phase="preempt", not in the
+    steady-state distribution."""
+    cfg, model, v = _model()
+    low = [Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                       ).astype(np.int32),
+                   max_new_tokens=10, priority=0) for _ in range(2)]
+    hi = Request(prompt=rng.integers(0, cfg.vocab_size, (16,)
+                                     ).astype(np.int32),
+                 max_new_tokens=4, priority=5)
+    fe, _ = _forced_preemption_run(model, v, cfg, low, hi)
+    eng_labels = fe.engine.obs_labels
+    preempt = metrics.histogram(
+        "pump.dispatch_ready_ms", labels=dict(eng_labels,
+                                              phase="preempt"))
+    assert fe.stats()["preemptions"] >= 1
+    assert preempt.count >= 1
+
+
+def test_tpot_slo_miss_counted_and_burn_gauge(rng):
+    """ISSUE 8 satellite: a request with an impossible TPOT SLO is
+    counted once (`serving.tpot_slo_misses`, engine-labeled) and the
+    rolling `serving.slo_burn` gauge reports the miss rate over
+    SLO-carrying retirements; a generous SLO records no miss."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8)
+    fe = ServingFrontend(engine)
+    reqs = [
+        Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                    ).astype(np.int32),
+                max_new_tokens=4, tpot_slo_ms=0.0),       # must miss
+        Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                    ).astype(np.int32),
+                max_new_tokens=4, tpot_slo_ms=1e9),       # cannot miss
+        Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                    ).astype(np.int32),
+                max_new_tokens=4),                        # no SLO
+    ]
+    for i, r in enumerate(reqs):
+        fe.submit(r, request_id=i)
+    fe.drain()
+    stats = fe.stats()
+    assert stats["tpot_slo_misses"] == 1
+    # burn = misses / SLO-carrying retirements in the window (the
+    # no-SLO request does not dilute it)
+    assert stats["slo_burn"] == pytest.approx(0.5)
+    assert metrics.counter("serving.tpot_slo_misses",
+                           labels=engine.obs_labels).value == 1
+    assert metrics.gauge("serving.slo_burn",
+                         labels=engine.obs_labels).value \
+        == pytest.approx(0.5)
+    ring = engine.events.tail()
+    misses = [e for e in ring if e["kind"] == "tpot_slo_miss"]
+    assert len(misses) == 1 and misses[0]["request"] == 0
+    assert fe.tracer.lifecycle(0)["tpot_ms"] > 0.0
+
+
+def test_slo_window_prunes_by_policy_horizon(rng):
+    """The burn gauge forgets misses older than the policy's
+    slo_window_s (injected clock)."""
+    cfg, model, v = _model()
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=8)
+    t = [0.0]
+    fe = ServingFrontend(
+        engine, policy=PriorityDeadlinePolicy(slo_window_s=10.0),
+        clock=lambda: t[0])
+    miss = Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                       ).astype(np.int32),
+                   max_new_tokens=4, tpot_slo_ms=0.0)
+    fe.submit(miss, request_id=0)
+    fe.drain()
+    assert fe.stats()["slo_burn"] == 1.0
+    # 60 fake seconds later, a healthy retirement: the old miss has
+    # aged out of the 10 s window
+    t[0] = 60.0
+    ok = Request(prompt=rng.integers(0, cfg.vocab_size, (8,)
+                                     ).astype(np.int32),
+                 max_new_tokens=4, tpot_slo_ms=1e9)
+    fe.submit(ok, request_id=1)
+    fe.drain()
+    assert metrics.gauge("serving.slo_burn",
+                         labels=engine.obs_labels).value == 0.0
+
+
 def test_deadlock_still_raises_and_fails_handles(rng):
     """A request the pool can never hold dies loudly through the
     frontend too (the engine's original deadlock contract)."""

@@ -22,6 +22,7 @@ import math
 import os
 import re
 import threading
+import urllib.error
 import urllib.request
 
 import jax
@@ -515,6 +516,89 @@ def test_http_endpoint():
             doc = json.loads(r.read())
             assert doc["gauges"][0]["name"] == "kv_pool.free_pages"
     finally:
+        server.shutdown()
+        server.server_close()
+
+
+#: the /healthz payload shape (ISSUE 8 satellite) — golden-pinned key
+#: set so operators' probes can rely on it
+_HEALTHZ_KEYS = {"ok", "time_unix", "frontend", "pump_alive",
+                 "queue_depth", "active_slots", "failure"}
+
+
+def test_healthz_endpoint_without_frontend():
+    from apex_tpu.obs import export
+
+    server = serve(port=0)
+    try:
+        host, port = server.server_address[:2]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz") as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read())
+        assert set(doc) == _HEALTHZ_KEYS
+        assert doc["ok"] is True and doc["frontend"] is False
+        assert doc["pump_alive"] is False
+        assert doc["queue_depth"] is None and doc["failure"] is None
+    finally:
+        server.shutdown()
+        server.server_close()
+    # the doc builder is directly usable too (no server needed)
+    assert set(export.health_doc()) == _HEALTHZ_KEYS
+
+
+def test_healthz_endpoint_with_live_frontend():
+    from apex_tpu.serving.frontend import ServingFrontend
+
+    rng = np.random.default_rng(5)
+    cfg, engine = _tiny_engine()
+    fe = ServingFrontend(engine)
+    fe.start()
+    server = serve(port=0, frontend=fe)
+    try:
+        host, port = server.server_address[:2]
+        h = fe.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (9,)
+                                ).astype(np.int32), max_new_tokens=4))
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz") as r:
+            doc = json.loads(r.read())
+        assert doc["ok"] is True and doc["frontend"] is True
+        assert doc["pump_alive"] is True
+        assert doc["queue_depth"] >= 0 and doc["active_slots"] >= 0
+        h.result(timeout=60.0)
+    finally:
+        fe.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_costs_endpoint_payload_shape():
+    """/costs 404s until a snapshot is published, then serves the
+    report with the pinned top-level shape."""
+    from apex_tpu.obs import export
+
+    server = serve(port=0)
+    try:
+        host, port = server.server_address[:2]
+        url = f"http://{host}:{port}/costs"
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url)
+        assert err.value.code == 404
+        export.publish_costs({
+            "schema": 1, "profile": {"name": "v5e"},
+            "totals": {"flops": 1, "hbm_bytes": 2, "predicted_ms": 0.1},
+            "cases": [], "by_domain": {}, "decode_split": None,
+            "errors": []})
+        with urllib.request.urlopen(url) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read())
+        assert set(doc) == {"schema", "profile", "totals", "cases",
+                            "by_domain", "decode_split", "errors"}
+        assert export.latest_costs()["schema"] == 1
+    finally:
+        export.publish_costs(None)     # leave no cross-test snapshot
         server.shutdown()
         server.server_close()
 

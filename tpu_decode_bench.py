@@ -359,6 +359,20 @@ def main():
         "peak_queue_depth": fe_stats["peak_queue_depth"],
         "prefix_hits": fe_stats["prefix_hits"],
         "prefill_tokens_skipped": fe_stats["prefill_tokens_skipped"],
+        # pump pipeline attribution + recompile window (PR 8,
+        # docs/observability.md): bubble_ms ≈ 0 means the double-buffered
+        # host work is actually hidden behind the decode chunks;
+        # jit.compiles during the measured window should be ~0 after the
+        # warm run (a recompile storm here is a served-latency cliff)
+        "pump.bubble_ms": round(fe_stats["pump.bubble_ms"], 3),
+        "pump.host_work_ms_p50": round(
+            fe_stats.get("pump.host_work_ms_p50", 0.0), 3),
+        "pump.dispatch_ready_ms_p50": round(
+            fe_stats.get("pump.dispatch_ready_ms_p50", 0.0), 3),
+        "jit.compiles": fe_stats["jit.compiles"],
+        "jit.trace_cache_misses": fe_stats["jit.trace_cache_misses"],
+        "tpot_slo_misses": fe_stats["tpot_slo_misses"],
+        "slo_burn": round(fe_stats["slo_burn"], 3),
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(fe_rec), flush=True)
