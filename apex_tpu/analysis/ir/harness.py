@@ -261,6 +261,56 @@ def _build_frontend_program(kind: str) -> CaseProgram:
                        max_traces=1)
 
 
+def _build_llama_windowed_program(kind: str) -> CaseProgram:
+    """The windowed-Llama PAGED serving programs (the model-coverage gap
+    ISSUE 9 closed): the engine's admission + ``sync_every``-step decode
+    chunk over a sliding-window tiny-Llama pool — the decode chunk
+    stages the band-gated paged-attention kernel, the admission the
+    window-banded flash prefill. Same compile-key contract as the GPT
+    cases (two same-bucket admission variants, ``max_traces=1``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.llama import LlamaModel, llama_tiny_config
+    from apex_tpu.serving.scheduler import (PagedDecodeEngine,
+                                            prompt_bucket)
+
+    cfg = llama_tiny_config(sliding_window=16)
+    model = LlamaModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=2,
+                               page_size=8, num_pages=17,
+                               max_pages_per_seq=8, sync_every=2)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)))
+    i32 = jnp.int32
+    if kind == "decode":
+        args = (cache_abs, dvars,
+                jax.ShapeDtypeStruct((2,), i32),           # tok
+                jax.ShapeDtypeStruct((2,), jnp.bool_),     # done
+                jax.ShapeDtypeStruct((2,), i32),           # n_left
+                jax.ShapeDtypeStruct((2, 2), jnp.uint32),  # req_keys
+                jax.ShapeDtypeStruct((2,), i32))           # samp_i
+        return CaseProgram(fn=engine._step_fn(), args=args)
+    assert kind == "admit"
+
+    def args_for(s0: int) -> tuple:
+        bucket = prompt_bucket(s0, engine.page_size,
+                               cfg.max_position_embeddings)
+        return (cache_abs, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
+    bucket = prompt_bucket(20, engine.page_size,
+                           cfg.max_position_embeddings)
+    return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(20),
+                       variants=[args_for(22)], max_traces=1)
+
+
 def _build_optimizer_update(kind: str) -> CaseProgram:
     """sgd/novograd fused-update steps over the flat-buffer layout
     (adam/lamb already arrive via ``kernel_cases``)."""
@@ -308,6 +358,12 @@ def analysis_cases(root) -> List[AnalysisCase]:
     cases.append(AnalysisCase(
         "gpt2s_frontend_admit_bucketed", "serving",
         lambda: _build_frontend_program("admit")))
+    cases.append(AnalysisCase(
+        "llama_windowed_engine_decode_chunk", "serving",
+        lambda: _build_llama_windowed_program("decode")))
+    cases.append(AnalysisCase(
+        "llama_windowed_engine_admit_bucketed", "serving",
+        lambda: _build_llama_windowed_program("admit")))
     cases.append(AnalysisCase(
         "optim_sgd_momentum_buffer", "optimizers",
         lambda: _build_optimizer_update("sgd")))

@@ -195,8 +195,15 @@ class LlamaDecoderBlock(nn.Module):
             from apex_tpu.ops.paged_attention import paged_attention
 
             cache = update_paged_layer_cache(cache, k, v)
+            # sliding_window bands the paged kernel to the exact
+            # rolling-cache attention set; the serving engine additionally
+            # DROPS pages that fall fully below the band from the block
+            # table (kv_pool.drop_slot_pages) — O(window) live pages per
+            # slot for arbitrarily long generation, the paged analog of
+            # the rolling ring buffer
             ctx = paged_attention(q, cache["k_pages"], cache["v_pages"],
-                                  cache["block_tables"], cache["len"] + 1)
+                                  cache["block_tables"], cache["len"] + 1,
+                                  window=cfg.sliding_window)
         elif cache is not None:
             # incremental decoding: append K/V at the cache offset; a
             # trace-time-provable prefill rides the training flash kernel,
@@ -304,11 +311,6 @@ class LlamaModel(nn.Module):
                         "paged decode takes single-token steps only "
                         "(prefill rides the contiguous flash path and is "
                         "scattered into pages by the scheduler)")
-                if cfg.sliding_window is not None:
-                    raise NotImplementedError(
-                        "paged serving decode does not band the paged "
-                        "kernel to a sliding window yet; decode windowed "
-                        "models on the contiguous or rolling cache")
                 if cfg.rolling_cache:
                     raise NotImplementedError(
                         "rolling_cache (ring buffer) does not compose "

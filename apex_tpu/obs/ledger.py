@@ -54,6 +54,13 @@ _HIGHER_BETTER = ("tokens_per_sec", "_per_sec", "hit_rate", "step_savings",
                   "speedup")
 _LOWER_BETTER = ("_ms", "misses", "miss_rate", "bubble")
 
+#: [0, 1] ratios with small integer denominators (one request flipping a
+#: ~8-deadline scenario moves miss_rate by 0.125 — a relative ±20 % band
+#: would flag scheduling noise as a regression): gate on ABSOLUTE
+#: worsening beyond this instead
+_RATE_SUFFIXES = ("miss_rate", "hit_rate")
+_RATE_ABS_TOL = 0.25
+
 
 def _git_rev(root: Path) -> str:
     try:
@@ -133,6 +140,12 @@ def head_cost_metrics(root, *, costs_json: Optional[str] = None,
     return costs.ledger_metrics(report)
 
 
+#: per-scenario SLO fields extracted from a SCENARIOS_<tag>.json doc
+#: (``python -m apex_tpu.serving.scenarios --json``) as
+#: ``scenario.<name>.<field>`` — each matches a direction class below
+#: (``_ms`` relative band / ``miss_rate`` absolute ±``_RATE_ABS_TOL``)
+_SCENARIO_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "deadline_miss_rate")
+
 #: numeric bench-record fields worth tracking besides the headline value
 _BENCH_FIELDS = (
     "step_ms", "int8_speedup", "step_savings",
@@ -145,16 +158,36 @@ _BENCH_FIELDS = (
 )
 
 
+def _scenario_metrics(doc: dict) -> Dict[str, float]:
+    """Flatten a scenarios document's aggregate SLO fields into ledger
+    metrics (``scenario.<name>.ttft_ms_p95`` etc.)."""
+    out: Dict[str, float] = {}
+    for name, rep in sorted(doc.get("scenarios", {}).items()):
+        agg = rep.get("aggregate", {}) if isinstance(rep, dict) else {}
+        for field in _SCENARIO_FIELDS:
+            v = agg.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"scenario.{name}.{field}"] = float(v)
+    return out
+
+
 def bench_metrics_from_file(path) -> Tuple[Dict[str, float], dict]:
     """Extract (metrics, meta) from a bench artifact. Accepts the
     driver's wrapper shape (``BENCH_r0*.json``: one object with a
-    ``parsed`` record), a bare record, or JSONL of records
-    (``DECODE_*.json``)."""
+    ``parsed`` record), a bare record, JSONL of records
+    (``DECODE_*.json``), or a scenarios document
+    (``SCENARIOS_*.json`` — per-scenario SLO fields, see
+    ``_SCENARIO_FIELDS``)."""
     text = Path(path).read_text().strip()
     records: List[dict] = []
     meta: dict = {"source": os.path.basename(str(path))}
     try:
         doc = json.loads(text)
+        if (isinstance(doc, dict)
+                and str(doc.get("schema", "")).startswith(
+                    "apex-tpu/scenarios")):
+            meta["schema"] = doc["schema"]
+            return _scenario_metrics(doc), meta
         if isinstance(doc, dict) and "parsed" in doc:
             meta["rc"] = doc.get("rc")
             if isinstance(doc.get("parsed"), dict):
@@ -241,10 +274,22 @@ def check(head: Dict[str, float], entries: List[dict], *,
                                       "exact-drift", tag))
             continue
         direction = _direction(name)
-        if direction is None or base_v == 0.0:
-            continue                 # informational, or dead baseline
+        if direction is None:
+            continue                 # informational counter
         worse = (base_v - head_v) if direction == "higher" \
             else (head_v - base_v)
+        if name.endswith(_RATE_SUFFIXES):
+            # quantized [0,1] ratio: absolute tolerance, not relative —
+            # and checked BEFORE the zero-baseline skip, because a 0.0
+            # miss-rate baseline is a healthy perfect score that must
+            # keep gating (the zero skip exists for dead-round seeds,
+            # which record throughputs, not rates; a 0.0 higher-better
+            # rate can never flag anyway since worse = -head <= 0)
+            if worse > _RATE_ABS_TOL:
+                out.append(Regression(name, base_v, head_v, "band", tag))
+            continue
+        if base_v == 0.0:
+            continue                 # dead baseline (failed-round seed)
         if worse > abs(base_v) * band_pct / 100.0:
             out.append(Regression(name, base_v, head_v, "band", tag))
     return out

@@ -47,7 +47,8 @@ _INTERPRET = _dispatch.interpret
 
 
 def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, scale, page_size, max_pages):
+                  acc_ref, m_ref, l_ref, *, scale, page_size, max_pages,
+                  window=None):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -63,7 +64,19 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # past the sequence end) skip both their FLOPs and their accumulator
     # update; their DMA fetched whatever page id the table holds (0 = the
     # reserved null page) — never read, so never wrong
-    @pl.when(j * page_size < seq_len)
+    page_live = j * page_size < seq_len
+    if window is not None:
+        # sliding-window band: the single query sits at position
+        # seq_len - 1 and attends (seq_len - 1 - window, seq_len - 1].
+        # A page whose LAST position is at or below the band floor is
+        # dead for this and every later step (the band only moves
+        # forward) — the serving engine drops such pages from the block
+        # table entirely (kv_pool.drop_slot_pages), and this gate skips
+        # whatever the dropped entry now points at (the null page)
+        page_live = jnp.logical_and(
+            page_live, (j + 1) * page_size + window > seq_len)
+
+    @pl.when(page_live)
     def _body():
         q = q_ref[0, 0]                                   # (rep, d)
         k = k_ref[0, 0]                                   # (ps, d)
@@ -72,6 +85,10 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32) * scale   # (rep, ps)
         pos = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
         live = pos < seq_len
+        if window is not None:
+            # positions inside the boundary page but below the band
+            # floor mask out — exactly cached_attention_rolling's band
+            live = jnp.logical_and(live, pos > seq_len - 1 - window)
         s = jnp.where(live, s, DEFAULT_MASK_VALUE)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -92,7 +109,10 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-def _validate(q, k_pages, v_pages, block_tables, lengths):
+def _validate(q, k_pages, v_pages, block_tables, lengths, window=None):
+    if window is not None and (not isinstance(window, int) or window < 1):
+        raise ValueError(f"window must be a static positive int, got "
+                         f"{window!r}")
     if q.ndim != 4 or q.shape[2] != 1:
         raise ValueError(f"q must be (batch, heads, 1, d) single-token "
                          f"decode queries, got {q.shape}")
@@ -117,7 +137,8 @@ def _validate(q, k_pages, v_pages, block_tables, lengths):
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None,
+                    window: Optional[int] = None):
     """Single-step GQA attention over a paged KV pool.
 
     Args:
@@ -135,10 +156,18 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         current token (its K/V must already be written to the pool).
         Length 0 (idle slot) outputs exactly 0.
       scale: softmax scale; default ``1/sqrt(head_dim)``.
+      window: optional STATIC sliding-window band (Mistral-style): the
+        query at position ``lengths[b] - 1`` attends only positions
+        ``(lengths[b] - 1 - window, lengths[b] - 1]`` — the exact band
+        ``cached_attention``/``cached_attention_rolling`` mask, so a
+        windowed model's paged decode is token-identical to its
+        contiguous/rolling decode. Pages fully below the band skip their
+        FLOPs (and may be dropped from the block table entirely — the
+        serving engine's O(window)-HBM trick, ``kv_pool.drop_slot_pages``).
 
     Returns ``(batch, heads, 1, head_dim)`` in ``q.dtype``.
     """
-    _validate(q, k_pages, v_pages, block_tables, lengths)
+    _validate(q, k_pages, v_pages, block_tables, lengths, window)
     num_pages, kv, page_size, d = k_pages.shape
     b, h = q.shape[0], q.shape[1]
     rep = h // kv
@@ -171,7 +200,8 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     )
     out = _dispatch.pallas_call(
         functools.partial(_paged_kernel, scale=float(scale),
-                          page_size=page_size, max_pages=max_pages),
+                          page_size=page_size, max_pages=max_pages,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kv, rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -183,11 +213,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
-                              scale: Optional[float] = None):
+                              scale: Optional[float] = None,
+                              window: Optional[int] = None):
     """Pure-jnp ground truth: gather every table entry into a contiguous
     ``(b, kv, max_pages*page_size, d)`` view and run dense masked GQA
     attention — O(batch * max_len) HBM, exactly what the kernel avoids."""
-    _validate(q, k_pages, v_pages, block_tables, lengths)
+    _validate(q, k_pages, v_pages, block_tables, lengths, window)
     num_pages, kv, page_size, d = k_pages.shape
     b, h = q.shape[0], q.shape[1]
     rep = h // kv
@@ -204,8 +235,11 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
     qf = q.reshape(b, kv, rep, d).astype(jnp.float32)
     s = jnp.einsum("bkrd,bktd->bkrt", qf, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
-    mask = (jnp.arange(max_pages * page_size, dtype=jnp.int32)[None, None, None]
-            < lengths[:, None, None, None])
+    pos = jnp.arange(max_pages * page_size, dtype=jnp.int32)[None, None, None]
+    ln = lengths[:, None, None, None]
+    mask = pos < ln
+    if window is not None:
+        mask = jnp.logical_and(mask, pos > ln - 1 - window)
     s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     p = jnp.where(mask, p, 0.0)  # length-0 rows: softmax(-inf row) -> NaN

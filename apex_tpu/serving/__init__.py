@@ -47,6 +47,7 @@ from apex_tpu.serving.kv_pool import (  # noqa: F401
     alloc_slot_shared,
     defrag,
     defrag_map,
+    drop_slot_pages,
     evict_pages,
     free_page_count,
     free_slot,

@@ -186,7 +186,8 @@ class _Entry:
     __slots__ = ("idx", "handle", "prompt", "total_new", "priority",
                  "deadline_at", "arrival", "seq", "resume", "prev",
                  "seg_tokens", "nodes", "n_private", "joined",
-                 "first_token_seen", "tpot_slo", "deadline_missed")
+                 "first_token_seen", "tpot_slo", "deadline_missed",
+                 "win_dropped")
 
     def __init__(self, idx, handle, prompt, total_new, priority,
                  deadline_at, arrival, seq):
@@ -207,6 +208,8 @@ class _Entry:
         self.first_token_seen = False
         self.tpot_slo = None
         self.deadline_missed = False
+        self.win_dropped = 0             # leading block-table entries
+        #                                  already window-dropped
 
     @property
     def s0(self) -> int:
@@ -455,6 +458,7 @@ class ServingFrontend:
                 self._last_ready = None
         if prev is not None:
             self._harvest(prev)
+        self._drop_window_pages()
         admitted = self._admission()
         if (self._pending and not self._active and self._inflight is None
                 and not admitted):
@@ -596,6 +600,36 @@ class ServingFrontend:
             if finished:
                 self._retire(slot)
                 self._done = self._done.at[slot].set(True)
+
+    def _drop_window_pages(self) -> None:
+        """Sliding-window models only: free every active slot's pages
+        that fell fully below the attention band — the rolling-cache
+        eviction trick at page granularity (``kv_pool.drop_slot_pages``).
+        Block-table entry ``j`` is dead once the NEXT query position
+        ``p`` satisfies ``(j+1)*page_size - 1 <= p - window``; the band
+        only moves forward, so a dead entry stays dead and each page
+        frees exactly once. The drop is an async dispatch queued AFTER
+        the in-flight decode chunk on the device stream, so program
+        order keeps the chunk's banded reads ahead of it."""
+        eng = self.engine
+        window = eng.window
+        if window is None:
+            return
+        ps = eng.page_size
+        for slot, entry in self._active.items():
+            # device len at the last harvested boundary = prompt + every
+            # decode step run (tok0 samples at admit, writes at step 1);
+            # the next query position equals that len
+            nxt = entry.s0 + len(entry.seg_tokens) - 1
+            upto = max((nxt + 1 - window) // ps, 0)
+            if upto > entry.win_dropped:
+                eng.cache = eng._drop_jit(eng.cache, jnp.int32(slot),
+                                          jnp.int32(upto))
+                freed = upto - entry.win_dropped
+                entry.win_dropped = upto
+                entry.n_private -= freed
+                self._C["window_dropped_pages"].inc(freed)
+                self._pool_dirty = True
 
     def _flush(self) -> None:
         """Synchronize the pipeline: harvest the in-flight chunk (if
@@ -849,6 +883,7 @@ class ServingFrontend:
                         cached_tokens=m * ps, priority=entry.priority)
         entry.nodes = nodes
         entry.n_private = need
+        entry.win_dropped = 0            # fresh row: nothing dropped yet
         entry.seg_tokens = [tok0]
         entry.joined = self._chunk + 1
         self._active[slot] = entry
@@ -947,6 +982,7 @@ class ServingFrontend:
             "resumes": int(d["resumes"]),
             "deadline_misses": int(d["deadline_misses"]),
             "tpot_slo_misses": int(d["tpot_slo_misses"]),
+            "window_dropped_pages": int(d["window_dropped_pages"]),
             "slo_burn": self._slo_burn.value,
             "peak_queue_depth": peak_queue_depth,
             "prefix_cache_enabled": eng.prefix is not None,

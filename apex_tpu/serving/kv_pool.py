@@ -19,6 +19,10 @@ models' incremental-decode path, recognized by its ``block_tables`` key):
 request's worst case (``ceil((prompt+max_new)/page_size)``) up front, so a
 slot owns pages its length has not reached yet — free/defrag must treat
 those as live (freeing by ``ceil(len/page_size)`` would leak the tail).
+It bounds the slot's row RANGE, not a live-page count: a sliding-window
+slot's leading entries may be NULLED mid-flight (``drop_slot_pages`` —
+pages below the attention band return to the stack early) and
+``release_slot`` skips null entries inside the range.
 
 Prefix caching (``serving/prefix_cache.py``) adds page SHARING on top of
 ownership: a slot's block-table row is ``[shared cached pages | owned
@@ -210,14 +214,20 @@ def release_slot(cache, slot, keep):
     sh = cache["shared_pages"][slot]
     total = sh + cache["alloc_pages"][slot]
     idx = jnp.arange(max_pages, dtype=jnp.int32)
-    freeable = jnp.logical_and(idx < total, jnp.logical_not(keep))
+    # entries inside the owned range may already be NULL: a sliding-window
+    # slot drops pages below its attention band mid-flight
+    # (``drop_slot_pages``) — those freed already and must not push the
+    # null page onto the stack here
+    nonnull = row != 0
+    freeable = jnp.logical_and(
+        jnp.logical_and(idx < total, jnp.logical_not(keep)), nonnull)
     n_free = jnp.sum(freeable.astype(jnp.int32))
     pos = jnp.cumsum(freeable.astype(jnp.int32)) - 1
     dst = jnp.where(freeable, top + pos, num_pages)   # OOB -> dropped
     out = dict(cache)
     out["free_stack"] = stack.at[dst].set(row, mode="drop")
     out["free_top"] = top + n_free
-    ref_ids = jnp.where(idx < sh, row, num_pages)
+    ref_ids = jnp.where(jnp.logical_and(idx < sh, nonnull), row, num_pages)
     out["page_ref"] = cache["page_ref"].at[ref_ids].add(-1, mode="drop")
     out["block_tables"] = bt.at[slot].set(jnp.zeros((max_pages,), jnp.int32))
     out["len"] = cache["len"].at[slot].set(0)
@@ -238,6 +248,41 @@ def free_slot(cache, slot):
     keep = (jnp.arange(max_pages, dtype=jnp.int32)
             < cache["shared_pages"][slot])
     return release_slot(cache, slot, keep)
+
+
+def drop_slot_pages(cache, slot, upto):
+    """Free the pages behind slot ``slot``'s leading ``upto`` block-table
+    entries and null the entries — the sliding-window page-eviction trick
+    (docs/serving.md): once a page's positions all sit at or below the
+    attention band's floor, no future decode step of this slot can read
+    it (the band only moves forward), so the page is dead storage and
+    returns to the free stack. Entries already dropped (null) are
+    skipped, so repeated calls with a monotonically growing ``upto`` free
+    each page exactly once; a windowed slot's steady-state footprint is
+    O(window) pages regardless of generation length — the paged analog of
+    the rolling ring buffer.
+
+    CALLER contract: the dropped entries must be PRIVATE pages (the
+    engine refuses ``prefix_cache`` for sliding-window models, so a
+    windowed slot never holds shared entries) and fully below the band.
+    ``alloc_pages`` is NOT decremented — it bounds the slot's row RANGE,
+    and ``release_slot`` skips the nulled entries at retirement."""
+    bt, stack, top = (cache["block_tables"], cache["free_stack"],
+                      cache["free_top"])
+    max_pages = bt.shape[1]
+    num_pages = stack.shape[0]
+    row = bt[slot]
+    idx = jnp.arange(max_pages, dtype=jnp.int32)
+    droppable = jnp.logical_and(idx < jnp.asarray(upto, jnp.int32),
+                                row != 0)
+    n = jnp.sum(droppable.astype(jnp.int32))
+    pos = jnp.cumsum(droppable.astype(jnp.int32)) - 1
+    dst = jnp.where(droppable, top + pos, num_pages)  # OOB -> dropped
+    out = dict(cache)
+    out["free_stack"] = stack.at[dst].set(row, mode="drop")
+    out["free_top"] = top + n
+    out["block_tables"] = bt.at[slot].set(jnp.where(droppable, 0, row))
+    return out
 
 
 def evict_pages(cache, pages_row, n):

@@ -49,6 +49,7 @@ force a device sync.
 from __future__ import annotations
 
 import heapq
+from collections import deque
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -56,6 +57,14 @@ import numpy as np
 from apex_tpu.utils import metrics
 
 __all__ = ["PrefixCache"]
+
+#: rolling retirement window for the ``prefix_cache.churn`` gauge
+#: (evictions per retirement, averaged over the last N retirements)
+CHURN_WINDOW = 64
+
+#: bound on the remembered-evicted-path set backing the
+#: ``evicted_reinserted`` counter (best-effort: overflowing resets it)
+_EVICTED_KEYS_CAP = 4096
 
 
 class _Node:
@@ -90,6 +99,15 @@ class PrefixCache:
         self.root = _Node(key=None, page=-1, parent=None)
         self._nodes: set = set()
         self._tick = 0
+        # eviction-churn observability (docs/observability.md): the
+        # paths recently evicted (so a RE-insertion of an evicted path —
+        # the thrash signature — is distinguishable from first-time
+        # growth), evictions accumulated since the last retirement, and
+        # the rolling evictions-per-retirement window behind the
+        # ``prefix_cache.churn`` gauge
+        self._evicted_keys: set = set()
+        self._churn_window: deque = deque(maxlen=CHURN_WINDOW)
+        self._evictions_since_retire = 0
         # label set for the cache's gauges/counters (the engine passes
         # its ``engine`` label so two caches never clobber one family)
         self._metrics_labels = (dict(metrics_labels)
@@ -114,6 +132,16 @@ class PrefixCache:
     def _page_key(self, tokens, j: int):
         ps = self.page_size
         return tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+
+    def _path_hash(self, node: _Node) -> int:
+        """Process-stable identity of a node's full token path (root →
+        node) — how a re-insertion of a previously EVICTED path is
+        recognized (the churn signature; a fresh path is just growth)."""
+        parts = []
+        while node is not None and node.key is not None:
+            parts.append(node.key)
+            node = node.parent
+        return hash(tuple(reversed(parts)))
 
     # --- admission ----------------------------------------------------------
 
@@ -180,6 +208,7 @@ class PrefixCache:
         keep[:m] = True                  # shared pages stay with the cache
         node = matched[-1] if matched else self.root
         self._tick += 1
+        reinserted = 0
         for j in range(m, n_cache):
             key = self._page_key(tokens, j)
             child = node.children.get(key)
@@ -189,6 +218,12 @@ class PrefixCache:
                 node.children[key] = child
                 self._nodes.add(child)
                 keep[j] = True           # ownership transfers to the cache
+                pk = self._path_hash(child)
+                if pk in self._evicted_keys:
+                    # the churn signature: this exact path was evicted
+                    # earlier and is now being recomputed + re-cached
+                    self._evicted_keys.discard(pk)
+                    reinserted += 1
             # else: a twin inserted this run first — our copy is a
             # duplicate and frees (keep[j] stays False); continue the walk
             # under the canonical node so deeper pages chain correctly
@@ -200,6 +235,17 @@ class PrefixCache:
         metrics.counter("prefix_cache.duplicate_pages",
                         labels=self._metrics_labels).inc(
             (n_cache - m) - inserted)
+        if reinserted:
+            metrics.counter("prefix_cache.evicted_reinserted",
+                            labels=self._metrics_labels).inc(reinserted)
+        # churn = evictions per retirement over the rolling window: ~0
+        # in steady state, >= 1 when every admission cycle evicts some
+        # other tenant's pages (the eviction-churn scenario's gauge)
+        self._churn_window.append(self._evictions_since_retire)
+        self._evictions_since_retire = 0
+        metrics.gauge("prefix_cache.churn",
+                      labels=self._metrics_labels).set(
+            sum(self._churn_window) / len(self._churn_window))
         self._observe()
         return keep
 
@@ -223,12 +269,20 @@ class PrefixCache:
                     or victim.refs != 0):
                 continue                 # stale entry (state moved on)
             parent = victim.parent
+            # remember the evicted PATH (victim.parent stays linked, so
+            # the walk still works after the detach below) — bounded:
+            # overflow resets the set, trading a few missed reinsert
+            # counts for O(1) memory
+            if len(self._evicted_keys) >= _EVICTED_KEYS_CAP:
+                self._evicted_keys.clear()
+            self._evicted_keys.add(self._path_hash(victim))
             del parent.children[victim.key]
             self._nodes.remove(victim)
             out.append(victim.page)
             if (parent is not self.root and not parent.children
                     and parent.refs == 0):
                 heapq.heappush(heap, (parent.last_used, id(parent), parent))
+        self._evictions_since_retire += len(out)
         metrics.counter("prefix_cache.evicted_pages",
                         labels=self._metrics_labels).inc(len(out))
         self._observe()

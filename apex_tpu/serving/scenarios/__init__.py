@@ -1,0 +1,62 @@
+"""Scenario engine: trace-driven load generation + multi-tenant
+prefix workloads over the serving stack (ROADMAP open item 5).
+
+The bench layer used to hard-code two synthetic workloads; this package
+makes workloads DECLARATIVE, SEEDED, and REPLAYABLE:
+
+- :mod:`traces` — composable arrival processes (Poisson, bursty
+  Markov-modulated on/off, closed-loop) and length distributions
+  (lognormal / Zipf / uniform / fixed), all driven by one explicit seed;
+  materialized traces round-trip through JSONL.
+- :mod:`tenants` — N tenants with distinct system prompts and
+  priority/deadline/TPOT-SLO profiles contending for one radix prefix
+  cache, plus the adversarial eviction-churn tenant set.
+- :mod:`runner` / :mod:`report` — open-loop replay through
+  :class:`~apex_tpu.serving.frontend.ServingFrontend` into a
+  pinned-schema per-tenant + aggregate SLO report; ``check=`` turns any
+  scenario into a correctness amplifier (greedy token identity vs
+  lock-step, scheduling invariance across chunk sizes).
+- :mod:`library` — the named catalog (``steady-poisson``,
+  ``burst-storm``, ``long-tail-lengths``,
+  ``multi-tenant-shared-prefix``, ``eviction-churn``,
+  ``priority-flood``, ``windowed-llama``, and the two bench workloads).
+
+CLI: ``python -m apex_tpu.serving.scenarios --list`` /
+``--scenario NAME [--scenario NAME ...] --json OUT --seed N [--check]``
+(also installed as ``apex-tpu-scenarios``). ``run_tpu_round.sh`` runs a
+two-scenario smoke per round, banking ``SCENARIOS_<tag>.json`` whose
+``scenario.<name>.*`` SLO fields the perf ledger band-gates.
+
+Docs: docs/scenarios.md (spec format, seeding contract, catalog, report
+schema, extension guide).
+"""
+
+from apex_tpu.serving.scenarios.library import (  # noqa: F401
+    SCENARIOS,
+    scenario_names,
+    scenario_spec,
+)
+from apex_tpu.serving.scenarios.report import (  # noqa: F401
+    AGGREGATE_FIELDS,
+    REPORT_SCHEMA,
+    SCENARIOS_SCHEMA,
+    TENANT_FIELDS,
+    validate_report,
+)
+from apex_tpu.serving.scenarios.runner import (  # noqa: F401
+    EngineSpec,
+    ScenarioResult,
+    ScenarioSpec,
+    build_model,
+    materialize,
+    replay,
+    run_scenario,
+    trace_requests,
+)
+from apex_tpu.serving.scenarios.tenants import Tenant  # noqa: F401
+from apex_tpu.serving.scenarios.traces import (  # noqa: F401
+    Arrival,
+    Lengths,
+    Trace,
+    TraceEvent,
+)

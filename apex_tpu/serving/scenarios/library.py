@@ -1,0 +1,218 @@
+"""The named scenario catalog — one registry, ``--list``-able.
+
+Every entry is a factory ``(seed) -> ScenarioSpec`` registered under a
+stable name; ``scenario_spec(name, seed, **overrides)`` builds the spec
+and applies top-level ``dataclasses.replace`` overrides (how the bench
+and tests scale a scenario up or down without forking its definition).
+Sizes here are deliberately tiny-model/CPU-tier: a scenario is a
+workload SHAPE + SLO harness, reproducible in tier-1 time — on-chip
+throughput numbers stay ``tpu_decode_bench.py``'s business.
+
+The catalog (docs/scenarios.md has the prose):
+
+- ``steady-poisson`` — the baseline: memoryless arrivals, lognormal
+  lengths, one tenant, no SLOs. The sanity row every other scenario is
+  read against.
+- ``burst-storm`` — on/off Markov-modulated arrivals with TTFT
+  deadlines into few slots: queueing spikes, deadline misses, and the
+  policy's EDF ordering under pressure.
+- ``long-tail-lengths`` — Zipf prompt AND output lengths: a few huge
+  requests among many small ones (continuous batching's reason to
+  exist; the step-savings and occupancy counters tell the story).
+- ``multi-tenant-shared-prefix`` — three tenants with distinct system
+  prompts and distinct priority/deadline/TPOT-SLO profiles contending
+  for one radix cache: per-tenant SLO splits + cross-request hit rate.
+- ``eviction-churn`` — the adversary: more cacheable header pages than
+  the pool holds, so admissions evict each other's headers and the tree
+  thrashes (``prefix_cache.churn`` / ``evicted_reinserted`` light up).
+- ``priority-flood`` — a low-priority flood pinning every slot while a
+  high-priority deadline stream arrives: preempt-and-spill under
+  ``preempt_on_priority``, priority-inversion bounded.
+- ``windowed-llama`` — sliding-window Llama on the PAGED path (the band
+  rides the paged kernel, dead pages drop at sync boundaries): long
+  generations at O(window) live pages per slot.
+- ``bench-mixed-length`` / ``bench-shared-prefix`` — the decode bench's
+  two original workloads, now defined here (``tpu_decode_bench.py``
+  materializes these instead of carrying inline generators).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+from apex_tpu.serving.scenarios.runner import EngineSpec, ScenarioSpec
+from apex_tpu.serving.scenarios.tenants import Tenant, churn_tenants
+from apex_tpu.serving.scenarios.traces import Arrival, Lengths
+
+__all__ = ["SCENARIOS", "register", "scenario_names", "scenario_spec"]
+
+SCENARIOS: Dict[str, Callable[[int], ScenarioSpec]] = {}
+
+
+def register(name: str):
+    def deco(fn: Callable[[int], ScenarioSpec]):
+        SCENARIOS[name] = fn
+        return fn
+    return deco
+
+
+def scenario_names() -> List[str]:
+    return sorted(SCENARIOS)
+
+
+def scenario_spec(name: str, seed: int = 0,
+                  **overrides) -> ScenarioSpec:
+    """Build a catalog scenario at ``seed``, with optional top-level
+    field overrides (``n_requests=``, ``engine=``, ``prompt_lens=``,
+    ...)."""
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r}; have "
+                       f"{scenario_names()}")
+    spec = SCENARIOS[name](seed)
+    return dataclasses.replace(spec, **overrides) if overrides else spec
+
+
+@register("steady-poisson")
+def _steady_poisson(seed: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="steady-poisson", seed=seed, n_requests=20,
+        arrival=Arrival(kind="poisson", rate_rps=400.0),
+        prompt_lens=Lengths(kind="lognormal", mean=20.0, sigma=0.5,
+                            lo=4, hi=48),
+        output_lens=Lengths(kind="uniform", lo=4, hi=10),
+        tenants=(Tenant("default"),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=8,
+                          prefix_cache=False),
+        description="memoryless open-loop baseline, one tenant")
+
+
+@register("burst-storm")
+def _burst_storm(seed: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="burst-storm", seed=seed, n_requests=24,
+        arrival=Arrival(kind="bursty", burst_rate_rps=2000.0,
+                        idle_rate_rps=40.0, mean_burst_s=0.015,
+                        mean_idle_s=0.06),
+        prompt_lens=Lengths(kind="lognormal", mean=16.0, sigma=0.5,
+                            lo=4, hi=40),
+        output_lens=Lengths(kind="uniform", lo=4, hi=10),
+        tenants=(Tenant("bursty", deadline_ms=250.0),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=8,
+                          prefix_cache=False),
+        description="on/off MMPP arrivals + TTFT deadlines into 2 slots")
+
+
+@register("long-tail-lengths")
+def _long_tail(seed: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="long-tail-lengths", seed=seed, n_requests=20,
+        arrival=Arrival(kind="poisson", rate_rps=300.0),
+        prompt_lens=Lengths(kind="zipf", zipf_a=1.4, lo=4, hi=80),
+        output_lens=Lengths(kind="zipf", zipf_a=1.6, lo=2, hi=32),
+        tenants=(Tenant("default"),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=8,
+                          prefix_cache=False),
+        description="Zipf prompt+output mix: few huge, many small")
+
+
+@register("multi-tenant-shared-prefix")
+def _multi_tenant(seed: int) -> ScenarioSpec:
+    ps = 8
+    return ScenarioSpec(
+        name="multi-tenant-shared-prefix", seed=seed, n_requests=24,
+        arrival=Arrival(kind="poisson", rate_rps=400.0),
+        prompt_lens=Lengths(kind="lognormal", mean=10.0, sigma=0.5,
+                            lo=2, hi=24),
+        output_lens=Lengths(kind="uniform", lo=4, hi=10),
+        tenants=(
+            Tenant("free", weight=2.0, system_prompt_tokens=2 * ps),
+            Tenant("pro", weight=1.0, system_prompt_tokens=4 * ps,
+                   priority=2, deadline_ms=400.0),
+            Tenant("batch", weight=1.0, system_prompt_tokens=2 * ps,
+                   tpot_slo_ms=500.0),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=ps,
+                          prefix_cache=True),
+        description="3 tenants, distinct headers + SLO profiles, one "
+                    "radix cache")
+
+
+@register("eviction-churn")
+def _eviction_churn(seed: int) -> ScenarioSpec:
+    ps = 8
+    # 8 tenants x 4 header pages = 32 cacheable pages vs a 23-page pool:
+    # the tree cannot hold every header and admissions evict each other
+    return ScenarioSpec(
+        name="eviction-churn", seed=seed, n_requests=32,
+        arrival=Arrival(kind="closed", users=4, think_ms=4.0),
+        prompt_lens=Lengths(kind="uniform", lo=1, hi=8),
+        output_lens=Lengths(kind="uniform", lo=2, hi=6),
+        tenants=churn_tenants(8, 4, ps),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=ps,
+                          prefix_cache=True, num_pages=24),
+        description="adversarial header set > pool capacity: radix "
+                    "thrash")
+
+
+@register("priority-flood")
+def _priority_flood(seed: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="priority-flood", seed=seed, n_requests=24,
+        arrival=Arrival(kind="poisson", rate_rps=600.0),
+        prompt_lens=Lengths(kind="uniform", lo=8, hi=24),
+        output_lens=Lengths(kind="uniform", lo=8, hi=16),
+        tenants=(
+            Tenant("flood", weight=5.0),
+            Tenant("urgent", weight=1.0, priority=5, deadline_ms=60.0),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=8,
+                          prefix_cache=True, preempt_on_priority=True),
+        description="low-priority flood vs high-priority deadline "
+                    "stream: preempt-and-spill")
+
+
+@register("windowed-llama")
+def _windowed_llama(seed: int) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="windowed-llama", seed=seed, n_requests=10,
+        arrival=Arrival(kind="poisson", rate_rps=300.0),
+        prompt_lens=Lengths(kind="uniform", lo=8, hi=32),
+        output_lens=Lengths(kind="uniform", lo=24, hi=40),
+        tenants=(Tenant("default"),),
+        engine=EngineSpec(model="llama-tiny-windowed", num_slots=2,
+                          page_size=8, sync_every=2,
+                          prefix_cache=False),
+        description="sliding-window Llama on the paged path: "
+                    "generations past the window drop dead pages")
+
+
+@register("bench-mixed-length")
+def _bench_mixed_length(seed: int) -> ScenarioSpec:
+    # tpu_decode_bench's original paged workload, catalogued: mixed
+    # prompt/output lengths so continuous batching beats lock-step
+    # padding (the step-savings assert)
+    return ScenarioSpec(
+        name="bench-mixed-length", seed=seed, n_requests=8,
+        arrival=Arrival(kind="poisson", rate_rps=500.0),
+        prompt_lens=Lengths(kind="uniform", lo=8, hi=64),
+        output_lens=Lengths(kind="uniform", lo=8, hi=24),
+        tenants=(Tenant("default"),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=8,
+                          prefix_cache=False),
+        description="the decode bench's mixed-length closed-loop "
+                    "workload")
+
+
+@register("bench-shared-prefix")
+def _bench_shared_prefix(seed: int) -> ScenarioSpec:
+    ps = 8
+    return ScenarioSpec(
+        name="bench-shared-prefix", seed=seed, n_requests=8,
+        arrival=Arrival(kind="poisson", rate_rps=500.0),
+        prompt_lens=Lengths(kind="uniform", lo=4, hi=16),
+        output_lens=Lengths(kind="uniform", lo=6, hi=12),
+        tenants=(Tenant("shared", system_prompt_tokens=4 * ps),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=ps,
+                          prefix_cache=True),
+        description="the decode bench's shared-system-prompt workload")

@@ -1,0 +1,344 @@
+"""Scenario specs + the open-loop replay runner.
+
+A :class:`ScenarioSpec` is a declarative, JSON-round-trippable bundle of
+(arrival process, length distributions, tenant set, engine knobs) plus a
+seed; ``materialize(spec)`` turns it into a reproducible
+:class:`~apex_tpu.serving.scenarios.traces.Trace` (pure function of the
+spec — same seed, byte-identical trace) and ``run_scenario`` replays the
+trace open-loop through a fresh :class:`ServingFrontend`, assembling the
+pinned-schema report (``report.py``).
+
+Replay semantics: requests are submitted when their trace arrival time
+comes due on the host clock (scaled by ``time_scale``), with
+``Request.arrival_time`` pinned to the INTENDED arrival — so queue-wait,
+TTFT, and deadline accounting measure offered load, not how quickly the
+replay loop happened to spin (the standard open-loop load-gen
+convention: falling behind shows up as latency, not as a slower trace).
+The pump is driven synchronously on the caller's thread, exactly the
+``engine.run`` discipline, so replays are single-threaded and the greedy
+outputs depend only on the trace (scheduling invariance — what lets the
+determinism tests pin tokens across runs with different wall-clock
+behavior).
+
+``check=True`` turns a scenario into a correctness amplifier: every
+replayed request's greedy output is re-derived by lock-step
+``generate`` (token identity — the engine/cache/preemption machinery
+re-derives nothing), and the whole trace is re-run as a fixed batch
+through ``engine.run`` at a DIFFERENT ``sync_every`` (scheduling
+invariance — outputs must not depend on arrival pacing or chunk size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.serving.scenarios import report as report_mod
+from apex_tpu.serving.scenarios import tenants as tenants_mod
+from apex_tpu.serving.scenarios.tenants import Tenant
+from apex_tpu.serving.scenarios.traces import (Arrival, Lengths, Trace,
+                                               TraceEvent)
+
+__all__ = ["EngineSpec", "ScenarioSpec", "ScenarioResult", "MODELS",
+           "model_config", "build_model", "materialize",
+           "trace_requests", "replay", "run_scenario"]
+
+#: scenario model registry: tiny CPU-fast configs (the scenario layer is
+#: a workload/SLO harness, not a throughput bench — run_tpu_round's
+#: on-chip numbers come from tpu_decode_bench.py at real sizes).
+#: ``gpt2-small`` exists for the bench's full-size trace materialization
+#: (vocab/position bounds); don't replay it on CPU.
+MODELS = ("gpt2-tiny", "llama-tiny", "llama-tiny-windowed",
+          "gpt2-small")
+
+_MODEL_CACHE: Dict[str, tuple] = {}
+
+
+def model_config(name: str):
+    if name == "gpt2-tiny":
+        from apex_tpu.models.gpt import gpt_tiny_config
+
+        return gpt_tiny_config()
+    if name == "gpt2-small":
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt import gpt2_small_config
+
+        return gpt2_small_config(dtype=jnp.bfloat16)
+    if name == "llama-tiny":
+        from apex_tpu.models.llama import llama_tiny_config
+
+        return llama_tiny_config()
+    if name == "llama-tiny-windowed":
+        from apex_tpu.models.llama import llama_tiny_config
+
+        # window < typical prompt+output so the band (and the engine's
+        # page drops) actually engage
+        return llama_tiny_config(sliding_window=16)
+    raise ValueError(f"unknown scenario model {name!r} "
+                     f"(one of {MODELS})")
+
+
+def build_model(name: str):
+    """``(config, model, variables)`` for a registry model —
+    deterministic init (``PRNGKey(0)``), cached per process so repeated
+    scenario runs share one weight set."""
+    if name not in _MODEL_CACHE:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = model_config(name)
+        if name.startswith("gpt2"):
+            from apex_tpu.models.gpt import GPTModel
+
+            model = GPTModel(cfg)
+        else:
+            from apex_tpu.models.llama import LlamaModel
+
+            model = LlamaModel(cfg)
+        v = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))
+        _MODEL_CACHE[name] = (cfg, model, v)
+    return _MODEL_CACHE[name]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """The engine/frontend half of a scenario: which model serves the
+    trace and how the slots/pool/policy are configured."""
+
+    model: str = "gpt2-tiny"
+    num_slots: int = 3
+    page_size: int = 8
+    sync_every: int = 1
+    prefix_cache: bool = True
+    num_pages: Optional[int] = None      # None = worst-case pool
+    preempt_on_priority: bool = False
+    preempt_margin_ms: float = 50.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One declarative scenario. ``materialize`` consumes everything but
+    ``engine``/``time_scale``; ``replay`` consumes those."""
+
+    name: str
+    seed: int = 0
+    n_requests: int = 24
+    arrival: Arrival = Arrival()
+    prompt_lens: Lengths = Lengths()
+    output_lens: Lengths = Lengths(kind="uniform", lo=4, hi=12)
+    tenants: Tuple[Tenant, ...] = (Tenant("default"),)
+    engine: EngineSpec = EngineSpec()
+    time_scale: float = 1.0              # arrival-time multiplier at replay
+    description: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True,
+                          indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ScenarioSpec":
+        d = json.loads(text)
+        d["arrival"] = Arrival(**d.get("arrival", {}))
+        d["prompt_lens"] = Lengths(**d.get("prompt_lens", {}))
+        d["output_lens"] = Lengths(**d.get("output_lens", {}))
+        d["tenants"] = tuple(Tenant(**t) for t in d.get("tenants", ()))
+        d["engine"] = EngineSpec(**d.get("engine", {}))
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    """One run's artifacts: the pinned-schema ``report`` (serialized),
+    plus the in-memory trace/outputs the tests pin determinism over."""
+
+    spec: ScenarioSpec
+    trace: Trace
+    outputs: List[np.ndarray]
+    stats: dict
+    report: dict
+
+
+def materialize(spec: ScenarioSpec) -> Trace:
+    """Sample the spec into a trace — a pure function of the spec (the
+    PRNG is ``default_rng(spec.seed)`` and nothing else): arrivals,
+    tenant assignment, tenant-header + random-tail prompts, output
+    budgets, all clipped to the model's position table."""
+    cfg = model_config(spec.engine.model)
+    max_pos = cfg.max_position_embeddings
+    rng = np.random.default_rng(spec.seed)
+    n = spec.n_requests
+    arrivals = spec.arrival.sample_ms(n, rng)
+    tails = spec.prompt_lens.sample(n, rng)
+    outs = spec.output_lens.sample(n, rng)
+    t_idx = tenants_mod.assign_tenants(spec.tenants, n, rng)
+    headers = [tenants_mod.system_prompt(t, cfg.vocab_size, spec.seed)
+               for t in spec.tenants]
+    events: List[TraceEvent] = []
+    for name, header in zip((t.name for t in spec.tenants), headers):
+        if header.shape[0] > max_pos - 2:
+            raise ValueError(
+                f"scenario {spec.name!r}: tenant {name!r}'s system "
+                f"prompt ({header.shape[0]} tokens) leaves no room in "
+                f"{spec.engine.model!r}'s position table ({max_pos}) "
+                f"for the >=1 tail + >=1 generated token every request "
+                f"needs")
+    for i in range(n):
+        ten = spec.tenants[int(t_idx[i])]
+        header = headers[int(t_idx[i])]
+        # clip to the position table: header + >=1 tail token + >=1
+        # generated token must all fit (header length validated above)
+        tail_len = int(np.clip(tails[i], 1,
+                               max_pos - 1 - header.shape[0]))
+        tail = rng.integers(0, cfg.vocab_size, tail_len)
+        prompt = np.concatenate([header, tail.astype(np.int32)])
+        max_new = int(np.clip(outs[i], 1, max_pos - prompt.shape[0]))
+        events.append(TraceEvent(
+            request_id=i, arrival_ms=float(arrivals[i]),
+            tenant=ten.name, prompt=[int(t) for t in prompt],
+            max_new_tokens=max_new, priority=ten.priority,
+            deadline_ms=ten.deadline_ms, tpot_slo_ms=ten.tpot_slo_ms))
+    return Trace(scenario=spec.name, seed=spec.seed, events=events)
+
+
+def _event_request(e: TraceEvent, *, arrival_time=None):
+    """The single TraceEvent -> Request mapping (every consumer builds
+    through here, so a new trace-carried field cannot silently reach
+    only one of the replay / fixed-batch paths)."""
+    from apex_tpu.serving.scheduler import Request
+
+    return Request(prompt=np.asarray(e.prompt, np.int32),
+                   max_new_tokens=e.max_new_tokens,
+                   priority=e.priority, deadline_ms=e.deadline_ms,
+                   arrival_time=arrival_time, tpot_slo_ms=e.tpot_slo_ms)
+
+
+def trace_requests(trace: Trace) -> List:
+    """The trace's events as engine ``Request`` objects (arrival times
+    are the REPLAY loop's business — a fixed-list ``engine.run`` over
+    these ignores pacing, which is exactly what the bench's closed-loop
+    throughput sections want)."""
+    return [_event_request(e) for e in trace.events]
+
+
+def _build_engine(spec: ScenarioSpec, model, variables, *,
+                  sync_every: Optional[int] = None):
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    es = spec.engine
+    return PagedDecodeEngine(
+        model, variables, num_slots=es.num_slots,
+        page_size=es.page_size, num_pages=es.num_pages,
+        sync_every=sync_every if sync_every is not None
+        else es.sync_every,
+        prefix_cache=es.prefix_cache)
+
+
+def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):
+    """Open-loop replay of ``trace`` through a fresh frontend; returns
+    ``(outputs, stats, tracer, wall_s)``. ``engine=`` injects a
+    pre-built (e.g. pre-warmed) engine."""
+    from apex_tpu.serving.frontend import ServingFrontend
+    from apex_tpu.serving.policy import PriorityDeadlinePolicy
+
+    if engine is None:
+        _, model, v = build_model(spec.engine.model)
+        engine = _build_engine(spec, model, v)
+    policy = PriorityDeadlinePolicy(
+        preempt_on_priority=spec.engine.preempt_on_priority,
+        preempt_margin_ms=spec.engine.preempt_margin_ms)
+    frontend = ServingFrontend(engine, policy=policy)
+    events = trace.events
+    scale = spec.time_scale
+    handles = {}
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(events):
+        now_s = time.perf_counter() - t0
+        while (i < len(events)
+               and events[i].arrival_ms * scale * 1e-3 <= now_s):
+            e = events[i]
+            req = _event_request(
+                e, arrival_time=t0 + e.arrival_ms * scale * 1e-3)
+            handles[e.request_id] = frontend.submit(
+                req, request_id=e.request_id)
+            i += 1
+        if not frontend.pump() and i < len(events):
+            # idle before the next arrival: nap up to it (bounded so the
+            # loop stays responsive to device completions)
+            gap = (events[i].arrival_ms * scale * 1e-3
+                   - (time.perf_counter() - t0))
+            time.sleep(min(max(gap, 0.0), 0.002))
+    frontend.drain()
+    wall_s = time.perf_counter() - t0
+    outputs = [np.asarray(handles[e.request_id].result(timeout=0),
+                          np.int32) for e in events]
+    return outputs, frontend.stats(), frontend.tracer, wall_s
+
+
+def _check_greedy_identity(spec: ScenarioSpec, trace: Trace,
+                           outputs: List[np.ndarray],
+                           limit: int = 16) -> int:
+    """Token identity vs lock-step ``generate`` for up to ``limit``
+    replayed requests (tiny models — each re-derivation is one eager
+    prefill + scan). Raises AssertionError on the first mismatch."""
+    from apex_tpu.models.generation import generate
+
+    _, model, v = build_model(spec.engine.model)
+    n = min(len(trace.events), limit)
+    for e, out in list(zip(trace.events, outputs))[:n]:
+        prompt = np.asarray(e.prompt, np.int32)
+        ref = np.asarray(generate(model, v, prompt[None],
+                                  max_new_tokens=e.max_new_tokens))
+        ref_gen = ref[0, prompt.shape[0]:]
+        if not np.array_equal(np.asarray(out), ref_gen):
+            raise AssertionError(
+                f"scenario {spec.name!r} request {e.request_id}: "
+                f"replayed greedy output diverges from lock-step "
+                f"generate ({np.asarray(out)[:8]}... vs "
+                f"{ref_gen[:8]}...)")
+    return n
+
+
+def _check_scheduling_invariance(spec: ScenarioSpec, trace: Trace,
+                                 outputs: List[np.ndarray]) -> None:
+    """Re-run the SAME trace as a fixed batch through ``engine.run`` at
+    a different ``sync_every`` — greedy outputs must not depend on
+    arrival pacing, admission order, or chunk size."""
+    _, model, v = build_model(spec.engine.model)
+    alt_sync = spec.engine.sync_every % 3 + 1     # always != sync_every
+    engine = _build_engine(spec, model, v, sync_every=alt_sync)
+    outs2, _ = engine.run(trace_requests(trace))
+    for e, a, b in zip(trace.events, outputs, outs2):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise AssertionError(
+                f"scenario {spec.name!r} request {e.request_id}: "
+                f"greedy output changed under a different schedule "
+                f"(sync_every {spec.engine.sync_every} -> {alt_sync})")
+
+
+def run_scenario(spec: ScenarioSpec, *, check: bool = False,
+                 trace: Optional[Trace] = None) -> ScenarioResult:
+    """Materialize (unless a saved ``trace`` is injected), replay, and
+    report one scenario. ``check=True`` additionally runs the
+    token-identity and scheduling-invariance amplifiers and records
+    their outcome under ``report["checks"]`` (raising on divergence)."""
+    if trace is None:
+        trace = materialize(spec)
+    outputs, stats, tracer, wall_s = replay(spec, trace)
+    checks = None
+    if check:
+        n_checked = _check_greedy_identity(spec, trace, outputs)
+        _check_scheduling_invariance(spec, trace, outputs)
+        checks = {"greedy_identity_requests": n_checked,
+                  "scheduling_invariance": True}
+    rep = report_mod.build_report(spec, trace, outputs, stats, tracer,
+                                  wall_s, checks=checks)
+    report_mod.validate_report(rep)
+    return ScenarioResult(spec=spec, trace=trace, outputs=outputs,
+                          stats=stats, report=rep)

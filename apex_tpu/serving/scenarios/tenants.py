@@ -1,0 +1,91 @@
+"""Multi-tenant workload profiles over one shared prefix cache.
+
+Production serving is rarely one traffic class: N tenants — each with
+its own system prompt, priority tier, and SLOs — contend for one engine
+and ONE radix prefix cache. A :class:`Tenant` bundles the per-class
+knobs; ``materialize`` (``runner.py``) samples each event's tenant by
+``weight`` and prepends the tenant's deterministic system prompt, so a
+tenant's requests share their header pages (cross-request hits) while
+distinct tenants collide only in pool capacity.
+
+The system prompt is a pure function of ``(scenario seed, tenant
+name)`` — two runs, or two tenants that happen to share a name across
+scenarios, regenerate identical headers, which is what makes cached-page
+hits (and the eviction-churn adversary below) reproducible.
+
+``churn_tenants`` builds the adversarial tenant set for the
+``eviction-churn`` scenario: enough tenants, each with a long-enough
+header, that the sum of cacheable header pages exceeds the pool — every
+admission cycle then evicts some other tenant's header and re-inserts
+its own, and the radix tree thrashes. The ``prefix_cache.churn`` gauge
+and ``prefix_cache.evicted_reinserted`` counter are the first-class
+signals of that state (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Tenant", "system_prompt", "assign_tenants", "churn_tenants"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Tenant:
+    """One traffic class: its shared header + scheduling/SLO profile.
+
+    ``weight`` is the tenant's relative traffic share (sampling weight,
+    not a hard quota); ``system_prompt_tokens`` the length of its shared
+    header (0 = no shared prefix); ``priority``/``deadline_ms``/
+    ``tpot_slo_ms`` stamp every request the tenant emits (the
+    ``Request`` fields the policy and SLO accounting consume)."""
+
+    name: str
+    weight: float = 1.0
+    system_prompt_tokens: int = 0
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    tpot_slo_ms: Optional[float] = None
+
+
+def system_prompt(tenant: Tenant, vocab_size: int,
+                  seed: int) -> np.ndarray:
+    """The tenant's deterministic shared header: seeded from
+    ``(seed, sha256(name))`` so it depends on nothing but the scenario
+    seed and the tenant's identity."""
+    if tenant.system_prompt_tokens <= 0:
+        return np.zeros((0,), np.int32)
+    name_key = int.from_bytes(
+        hashlib.sha256(tenant.name.encode()).digest()[:8], "big")
+    rng = np.random.default_rng([seed & 0xFFFFFFFF, name_key])
+    return rng.integers(0, vocab_size,
+                        tenant.system_prompt_tokens).astype(np.int32)
+
+
+def assign_tenants(tenants: Sequence[Tenant], n: int,
+                   rng: np.random.Generator) -> np.ndarray:
+    """Per-event tenant index, sampled by ``weight``."""
+    if not tenants:
+        raise ValueError("need at least one tenant")
+    w = np.asarray([t.weight for t in tenants], np.float64)
+    if (w <= 0).any():
+        raise ValueError("tenant weights must be positive")
+    return rng.choice(len(tenants), size=n, p=w / w.sum())
+
+
+def churn_tenants(n_tenants: int, header_pages: int, page_size: int, *,
+                  deadline_ms: Optional[float] = None,
+                  ) -> Tuple[Tenant, ...]:
+    """The eviction-churn adversary: ``n_tenants`` equal-weight tenants
+    whose headers are each ``header_pages`` full pages. Size the pool so
+    ``n_tenants * header_pages`` exceeds its cacheable capacity and the
+    radix tree must evict one tenant's header to admit another's —
+    steady-state thrash."""
+    return tuple(
+        Tenant(name=f"churn-{i}", weight=1.0,
+               system_prompt_tokens=header_pages * page_size,
+               deadline_ms=deadline_ms)
+        for i in range(n_tenants))

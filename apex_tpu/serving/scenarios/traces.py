@@ -1,0 +1,231 @@
+"""Trace-driven load generation: seeded arrival processes + length mixes.
+
+A *scenario* is only reproducible if its traffic is: every sampler here
+is driven by an explicit ``numpy.random.Generator`` seeded from the
+scenario's ``seed``, so materializing the same spec twice yields a
+byte-identical trace (the determinism contract tests pin). A
+materialized :class:`Trace` is a flat list of :class:`TraceEvent`\\ s —
+arrival time, tenant, prompt tokens, output budget, SLO fields — that
+the runner replays open-loop through :class:`ServingFrontend`; traces
+round-trip through JSONL (``save``/``load``) so a workload can be
+generated once, committed, and replayed forever.
+
+Arrival processes (:class:`Arrival`):
+
+- ``poisson`` — memoryless open-loop arrivals at ``rate_rps`` (the
+  classic load-test baseline; exponential inter-arrival gaps).
+- ``bursty`` — a two-state Markov-modulated Poisson process: the source
+  alternates between a BURST state (``burst_rate_rps``, exponential
+  holding time ``mean_burst_s``) and an IDLE state (``idle_rate_rps``,
+  ``mean_idle_s``) — the on/off traffic that stresses queueing,
+  deadlines, and preemption in a way a flat Poisson stream cannot.
+- ``closed`` — ``users`` concurrent streams, each issuing its next
+  request after an exponential think-time gap (``think_ms``). The trace
+  materializes the think gaps as arrival offsets (zero-service-time
+  approximation, so the trace stays a pure function of the seed); the
+  replay is still open-loop over those times.
+
+Length distributions (:class:`Lengths`): ``lognormal`` (the measured
+shape of real prompt/output mixes), ``zipf`` (long tail — a few huge
+requests among many small ones), ``uniform``, and ``fixed``; all clipped
+to ``[lo, hi]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Arrival", "Lengths", "TraceEvent", "Trace", "TRACE_SCHEMA"]
+
+TRACE_SCHEMA = "apex-tpu/trace/v1"
+
+_ARRIVAL_KINDS = ("poisson", "bursty", "closed")
+_LENGTH_KINDS = ("lognormal", "zipf", "uniform", "fixed")
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One arrival process (see module docstring for the kinds)."""
+
+    kind: str = "poisson"
+    rate_rps: float = 400.0          # poisson: mean arrival rate
+    burst_rate_rps: float = 1600.0   # bursty: rate inside a burst
+    idle_rate_rps: float = 50.0      # bursty: rate between bursts
+    mean_burst_s: float = 0.02       # bursty: mean burst holding time
+    mean_idle_s: float = 0.08        # bursty: mean idle holding time
+    users: int = 4                   # closed: concurrent user streams
+    think_ms: float = 10.0           # closed: mean think-time gap
+
+    def sample_ms(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        """``n`` sorted arrival times in milliseconds from t=0."""
+        if self.kind not in _ARRIVAL_KINDS:
+            raise ValueError(f"unknown arrival kind {self.kind!r} "
+                             f"(one of {_ARRIVAL_KINDS})")
+        rates = {"poisson": ("rate_rps",),
+                 "bursty": ("burst_rate_rps", "idle_rate_rps",
+                            "mean_burst_s", "mean_idle_s"),
+                 "closed": ("think_ms",)}[self.kind]
+        for field in rates:
+            if getattr(self, field) <= 0.0:
+                raise ValueError(f"{self.kind} arrivals need "
+                                 f"{field} > 0, got "
+                                 f"{getattr(self, field)!r}")
+        if self.kind == "closed" and self.users < 1:
+            raise ValueError(f"closed arrivals need users >= 1, got "
+                             f"{self.users!r}")
+        if n < 1:
+            return np.zeros((0,), np.float64)
+        if self.kind == "poisson":
+            gaps = rng.exponential(1.0 / self.rate_rps, n)
+            return np.cumsum(gaps) * 1e3
+        if self.kind == "closed":
+            # each user: staggered start + exponential think gaps
+            per_user = [[] for _ in range(self.users)]
+            starts = rng.uniform(0.0, self.think_ms, self.users)
+            for i in range(n):
+                u = i % self.users
+                prev = per_user[u][-1] if per_user[u] else starts[u]
+                per_user[u].append(prev
+                                   + rng.exponential(self.think_ms))
+            return np.sort(np.concatenate(
+                [np.asarray(x) for x in per_user if x]))[:n]
+        # bursty: two-state MMPP — walk holding periods, fill each with
+        # a Poisson stream at that state's rate until n arrivals land
+        out: List[float] = []
+        t, burst = 0.0, True
+        while len(out) < n:
+            hold = rng.exponential(
+                self.mean_burst_s if burst else self.mean_idle_s)
+            rate = self.burst_rate_rps if burst else self.idle_rate_rps
+            at = t + rng.exponential(1.0 / rate)
+            while at < t + hold and len(out) < n:
+                out.append(at)
+                at += rng.exponential(1.0 / rate)
+            t += hold
+            burst = not burst
+        return np.asarray(out) * 1e3
+
+
+@dataclasses.dataclass(frozen=True)
+class Lengths:
+    """One token-length distribution, clipped to ``[lo, hi]``."""
+
+    kind: str = "lognormal"
+    mean: float = 24.0               # lognormal/fixed: mean tokens
+    sigma: float = 0.6               # lognormal: log-space sigma
+    zipf_a: float = 1.5              # zipf: tail exponent (> 1)
+    lo: int = 4
+    hi: int = 64
+
+    def sample(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        if self.kind not in _LENGTH_KINDS:
+            raise ValueError(f"unknown length kind {self.kind!r} "
+                             f"(one of {_LENGTH_KINDS})")
+        if self.lo < 1 or self.hi < self.lo:
+            raise ValueError(f"need 1 <= lo <= hi, got [{self.lo}, "
+                             f"{self.hi}]")
+        if self.kind == "fixed":
+            vals = np.full((n,), self.mean)
+        elif self.kind == "uniform":
+            vals = rng.integers(self.lo, self.hi + 1, n)
+        elif self.kind == "zipf":
+            # long tail anchored at lo: most requests near lo, a few
+            # reaching hi
+            vals = self.lo + rng.zipf(self.zipf_a, n) - 1
+        else:                        # lognormal with mean ~= self.mean
+            mu = np.log(max(self.mean, 1.0)) - self.sigma ** 2 / 2.0
+            vals = rng.lognormal(mu, self.sigma, n)
+        return np.clip(np.asarray(vals).astype(np.int64),
+                       self.lo, self.hi).astype(np.int32)
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    """One replayable request: everything ``ServingFrontend.submit``
+    needs, in a JSON-stable form (token ids as plain ints)."""
+
+    request_id: int
+    arrival_ms: float
+    tenant: str
+    prompt: List[int]
+    max_new_tokens: int
+    priority: int = 0
+    deadline_ms: Optional[float] = None
+    tpot_slo_ms: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "arrival_ms": round(float(self.arrival_ms), 6),
+            "tenant": self.tenant,
+            "prompt": [int(t) for t in self.prompt],
+            "max_new_tokens": int(self.max_new_tokens),
+            "priority": int(self.priority),
+            "deadline_ms": self.deadline_ms,
+            "tpot_slo_ms": self.tpot_slo_ms,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceEvent":
+        return cls(request_id=d["request_id"],
+                   arrival_ms=d["arrival_ms"], tenant=d["tenant"],
+                   prompt=list(d["prompt"]),
+                   max_new_tokens=d["max_new_tokens"],
+                   priority=d.get("priority", 0),
+                   deadline_ms=d.get("deadline_ms"),
+                   tpot_slo_ms=d.get("tpot_slo_ms"))
+
+
+@dataclasses.dataclass
+class Trace:
+    """A materialized workload: the scenario's events in arrival order,
+    plus the provenance (scenario name + seed) that regenerates it."""
+
+    scenario: str
+    seed: int
+    events: List[TraceEvent] = dataclasses.field(default_factory=list)
+
+    def to_jsonl(self) -> str:
+        """Canonical JSONL: a header line, then one compact sorted-key
+        object per event — the byte representation the determinism
+        contract (and :meth:`sha256`) is defined over."""
+        lines = [json.dumps({"schema": TRACE_SCHEMA,
+                             "scenario": self.scenario,
+                             "seed": self.seed,
+                             "n_events": len(self.events)},
+                            sort_keys=True)]
+        lines += [json.dumps(e.to_dict(), sort_keys=True,
+                             separators=(",", ":"))
+                  for e in self.events]
+        return "\n".join(lines) + "\n"
+
+    def sha256(self) -> str:
+        return hashlib.sha256(self.to_jsonl().encode()).hexdigest()
+
+    def save(self, path) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_jsonl())
+
+    @classmethod
+    def load(cls, path) -> "Trace":
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if not lines:
+            raise ValueError(f"{path}: empty trace file")
+        header = json.loads(lines[0])
+        if header.get("schema") != TRACE_SCHEMA:
+            raise ValueError(f"{path}: not a {TRACE_SCHEMA} trace "
+                             f"(schema={header.get('schema')!r})")
+        events = [TraceEvent.from_dict(json.loads(ln))
+                  for ln in lines[1:]]
+        if len(events) != header.get("n_events"):
+            raise ValueError(
+                f"{path}: truncated trace ({len(events)} events, header "
+                f"says {header.get('n_events')})")
+        return cls(scenario=header.get("scenario", "?"),
+                   seed=header.get("seed", 0), events=events)

@@ -77,7 +77,7 @@ _RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
                  "prefill_tokens_computed", "evicted_pages",
                  "deferred_admissions", "defrag_runs",
                  "preemptions", "resumes", "deadline_misses",
-                 "tpot_slo_misses")
+                 "tpot_slo_misses", "window_dropped_pages")
 
 #: per-request latency histograms (``serving.<name>``, log-bucketed ms)
 _RUN_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "decode_step_ms")
@@ -257,6 +257,24 @@ class PagedDecodeEngine:
         self.rng = validate_sampling(temperature, top_k, top_p, rng)
         self.sync_every = sync_every
         self.axis_name = axis_name
+        # sliding-window models: the paged kernel bands attention to the
+        # window and the frontend drops pages below the band at sync
+        # boundaries (kv_pool.drop_slot_pages) — O(window) live pages per
+        # slot. Dropped pages cannot double as shared cache property, so
+        # the window and the radix prefix cache are mutually exclusive.
+        # CONTRACT: a config EXPOSING ``sliding_window`` promises its
+        # model's paged branch passes ``window=`` to ``paged_attention``
+        # (LlamaConfig does; GPTConfig has no such field) — the drop
+        # below frees pages the band can no longer read, so an unbanded
+        # paged path under this attribute would read freed null pages.
+        self.window = getattr(cfg, "sliding_window", None)
+        if prefix_cache and self.window is not None:
+            raise ValueError(
+                "prefix_cache does not compose with sliding-window "
+                "models: the engine drops a windowed slot's pages once "
+                "they fall below the attention band, and a dropped page "
+                "cannot be shared radix-cache property (decode windowed "
+                "models with prefix_cache=False)")
         if max_pages_per_seq is None:
             max_pages_per_seq = kv_pool.cdiv(cfg.max_position_embeddings,
                                              page_size)
@@ -290,6 +308,8 @@ class PagedDecodeEngine:
                                   donate_argnums=_donate_cache())
         self._defrag_jit = jax.jit(kv_pool.defrag_map,
                                    donate_argnums=_donate_cache())
+        self._drop_jit = jax.jit(kv_pool.drop_slot_pages,
+                                 donate_argnums=_donate_cache())
 
     # --- request-key sampling (scheduling-invariant streams) ----------------
 

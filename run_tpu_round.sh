@@ -77,6 +77,38 @@ fi
 JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
   --costs "COSTS_${TAG}.json"
 
+# SCENARIO smoke (docs/scenarios.md): replay two catalog scenarios on
+# CPU (tiny model — workload/SLO mechanics, not throughput) and bank the
+# pinned-schema report; runs BEFORE the tunnel probe so a dead tunnel
+# still leaves the round's scenario evidence. The per-scenario SLO
+# fields (scenario.<name>.ttft_ms_p95 / tpot_ms_p95 /
+# deadline_miss_rate) band-gate against the trajectory like the other
+# wall-time metrics — check BEFORE append (checking after would compare
+# the round to itself); a regression marks the round failed at exit
+# with the entry still banked.
+if [ ! -f "SCENARIOS_${TAG}.json" ]; then
+  echo "[$(date +%H:%M:%S)] scenario smoke (CPU, tiny model)..."
+  if ! JAX_PLATFORMS=cpu timeout 1200 python -m apex_tpu.serving.scenarios \
+      --scenario steady-poisson --scenario multi-tenant-shared-prefix \
+      --json "SCENARIOS_${TAG}.json" --seed 0; then
+    echo "[$(date +%H:%M:%S)] scenario smoke failed; the workload layer"
+    echo "  is broken — fix before burning a tunnel window"
+    exit 1
+  fi
+fi
+# check + append run even when a leftover artifact skipped the smoke
+# (a round that died between smoke and append must not silently skip
+# the gate on re-run — the empty-trajectory failure mode again)
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check \
+    --costs "COSTS_${TAG}.json" --bench "SCENARIOS_${TAG}.json"; then
+  echo "[$(date +%H:%M:%S)] perf ledger: scenario SLO regression vs the"
+  echo "  trajectory; round marked failed — entry still appended so the"
+  echo "  regression itself is on record"
+  LEDGER_BENCH_RC=1
+fi
+JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
+  --bench "SCENARIOS_${TAG}.json"
+
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"

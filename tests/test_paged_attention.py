@@ -129,3 +129,65 @@ def test_validation_errors(rng):
     with pytest.raises(ValueError):      # non-sublane page size
         paged_attention(good_q, jnp.zeros((P, kv, 12, d)),
                         jnp.zeros((P, kv, 12, d)), bt, lens)
+
+
+def test_windowed_matches_reference_and_rolling_band(rng):
+    """ISSUE 9: `window=` bands the kernel to the exact rolling-cache
+    attention set — kernel vs reference vs the dense window mask, across
+    boundary-page offsets (window straddling a page edge) and lengths
+    shorter than the window."""
+    P, kv, ps, d, mp = 40, 2, 8, 16, 4
+    W = 11                               # deliberately page-misaligned
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    lens = jnp.asarray([5, W, W + 1, 2 * ps, mp * ps, 0], jnp.int32)
+    b = lens.shape[0]
+    q = jnp.asarray(rng.standard_normal((b, 4, 1, d)), jnp.float32)
+    bt = _tables(rng, b, mp, P)
+    out = np.asarray(paged_attention(q, k_pages, v_pages, bt, lens,
+                                     window=W))
+    ref = np.asarray(paged_attention_reference(q, k_pages, v_pages, bt,
+                                               lens, window=W))
+    np.testing.assert_allclose(out, ref, **TOL)
+    assert (out[5] == 0).all()           # idle slot stays exactly zero
+    # against the dense cached band: gather the pages contiguous and run
+    # cached_attention with the same window at offset len-1
+    for i in range(b - 1):
+        t1 = int(lens[i])
+        if t1 < 1:
+            continue
+        kc = jnp.take(k_pages, bt[i], axis=0).transpose(
+            1, 0, 2, 3).reshape(1, kv, mp * ps, d)
+        vc = jnp.take(v_pages, bt[i], axis=0).transpose(
+            1, 0, 2, 3).reshape(1, kv, mp * ps, d)
+        dense = cached_attention(q[i:i + 1], {"k": kc, "v": vc,
+                                              "len": jnp.int32(t1 - 1)},
+                                 window=W)
+        np.testing.assert_allclose(out[i], np.asarray(dense)[0], **TOL)
+
+
+def test_windowed_dropped_pages_leave_the_result_unchanged(rng):
+    """The engine's page-drop contract: nulling a block-table entry whose
+    page sits fully below the band (and even poisoning the null page's
+    contents) must not change the output — dead pages are skipped, not
+    masked-after-read."""
+    P, kv, ps, d, mp = 24, 2, 8, 16, 4
+    W = 10
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    lens = jnp.asarray([4 * ps], jnp.int32)      # band covers pages 2..3
+    q = jnp.asarray(rng.standard_normal((1, 4, 1, d)), jnp.float32)
+    bt = _tables(rng, 1, mp, P)
+    ref = np.asarray(paged_attention(q, k_pages, v_pages, bt, lens,
+                                     window=W))
+    # drop pages 0 and 1 (fully below the band floor 32-1-10=21 ... page
+    # 1 ends at 15 <= 21) and poison the null page
+    bt_dropped = bt.at[0, 0].set(0).at[0, 1].set(0)
+    k_bad = k_pages.at[0].set(1e9)
+    v_bad = v_pages.at[0].set(-1e9)
+    out = np.asarray(paged_attention(q, k_bad, v_bad, bt_dropped, lens,
+                                     window=W))
+    np.testing.assert_array_equal(out, ref)
+    with pytest.raises(ValueError):      # non-positive window
+        paged_attention(q, k_pages, v_pages, bt, lens, window=0)
+    with pytest.raises(ValueError):      # non-static (array) window
+        paged_attention(q, k_pages, v_pages, bt, lens,
+                        window=jnp.int32(W))

@@ -127,6 +127,31 @@ def test_check_band_is_direction_aware():
                         band_pct=5.0)
 
 
+def test_check_rates_gate_on_absolute_tolerance():
+    """[0,1] ratios with small integer denominators (a ~8-deadline
+    scenario quantizes miss_rate in 0.125 steps) use an absolute band —
+    one noise-flipped request must not fail the round."""
+    entries = [_entry({"scenario.x.deadline_miss_rate": 0.125,
+                       "prefix_hit_rate": 0.9}, kind="bench")]
+    # one extra miss (+0.125, a 100% relative jump) stays inside the
+    # absolute tolerance; a wholesale collapse (+0.5) gates
+    assert not ledger.check({"scenario.x.deadline_miss_rate": 0.25},
+                            entries)
+    assert ledger.check({"scenario.x.deadline_miss_rate": 0.625},
+                        entries)
+    # hit_rate is higher-better: small dips pass, a collapse gates
+    assert not ledger.check({"prefix_hit_rate": 0.8}, entries)
+    assert ledger.check({"prefix_hit_rate": 0.5}, entries)
+    # a 0.0 miss-rate baseline is a healthy PERFECT score, not a
+    # dead-round seed — a collapse from it must still gate (the
+    # zero-baseline skip applies only to the relative-band metrics)
+    entries0 = [_entry({"scenario.x.deadline_miss_rate": 0.0},
+                       kind="bench")]
+    assert ledger.check({"scenario.x.deadline_miss_rate": 1.0}, entries0)
+    assert not ledger.check({"scenario.x.deadline_miss_rate": 0.125},
+                            entries0)
+
+
 def test_check_skips_informational_and_unmatched():
     entries = [_entry({"decode_steps": 40.0, "old_metric_ms": 1.0})]
     # unknown-direction counters and metrics missing on one side don't gate

@@ -365,10 +365,17 @@ def test_llama_paged_and_prefix_cache(rng):
         np.testing.assert_array_equal(out, _lockstep(model, v, req))
     assert stats["prefix_hits"] >= len(reqs) - 2
 
+    # sliding-window Llama is paged now (ISSUE 9): the band rides the
+    # paged kernel; the prefix cache is the one combination refused
+    # (dropped-below-window pages can't be shared cache property)
     wmodel = LlamaModel(dataclasses.replace(cfg, sliding_window=PS))
-    with pytest.raises(NotImplementedError):
-        generate(wmodel, v, prompt, max_new_tokens=3, paged=True,
-                 page_size=PS)
+    wout = np.asarray(generate(wmodel, v, prompt, max_new_tokens=3,
+                               paged=True, page_size=PS))
+    wref = np.asarray(generate(wmodel, v, prompt, max_new_tokens=3))
+    np.testing.assert_array_equal(wout, wref)
+    with pytest.raises(ValueError):
+        PagedDecodeEngine(wmodel, v, num_slots=2, page_size=PS,
+                          prefix_cache=True)
 
 
 def test_engine_counters_reach_metrics_registry(rng):

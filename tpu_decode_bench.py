@@ -20,6 +20,12 @@ line {"metric": "gpt2_paged_decode_tokens_per_sec_per_chip", ...} with
 the engine's decode-step count next to the steps lock-step generate would
 have padded to — the Orca/vLLM win this harness exists to document.
 
+The serving workloads are no longer inline generators: the mixed-length
+and shared-system-prompt request sets are the scenario library's
+``bench-mixed-length`` / ``bench-shared-prefix`` catalog entries
+(apex_tpu/serving/scenarios, docs/scenarios.md), materialized from a
+fixed seed — the bench keeps only the measurement loops and asserts.
+
 Third line: the PREFIX-CACHED serving path — a shared-system-prompt
 workload (every request = one common header + a private tail, the
 dominant multi-user pattern) through the engine with
@@ -125,22 +131,35 @@ def main():
     print(json.dumps(rec), flush=True)
 
     # --- paged continuous-batching serving metric ---------------------------
+    # the workload DEFINITION lives in the scenario library
+    # (apex_tpu/serving/scenarios, docs/scenarios.md): the bench
+    # materializes the catalogued ``bench-mixed-length`` trace (seeded —
+    # reproducible request set) and keeps only the measurement loop here
+    import dataclasses as _dc
+
     from apex_tpu.serving import PagedDecodeEngine, Request
+    from apex_tpu.serving.scenarios import (Lengths, materialize,
+                                            scenario_spec,
+                                            trace_requests)
 
     smoke = os.environ.get("APEX_TPU_DECODE_SMOKE") == "1"
-    wl = np.random.default_rng(1)
     if smoke:
-        num_slots, page_size, n_req = 3, 8, 8
-        prompt_lens = wl.integers(8, 65, n_req)          # mixed 8-64
-        new_tokens = wl.integers(8, 25, n_req)
+        ml_spec = scenario_spec("bench-mixed-length", seed=1)
     else:
-        num_slots, page_size, n_req = batch, 16, 3 * batch
-        prompt_lens = wl.integers(32, 129, n_req)
-        new_tokens = wl.integers(32, 129, n_req)
-    requests = [
-        Request(prompt=wl.integers(0, cfg.vocab_size, int(L)).astype(
-            np.int32), max_new_tokens=int(m))
-        for L, m in zip(prompt_lens, new_tokens)]
+        base = scenario_spec("bench-mixed-length", seed=1)
+        ml_spec = _dc.replace(
+            base, n_requests=3 * batch,
+            prompt_lens=Lengths(kind="uniform", lo=32, hi=128),
+            output_lens=Lengths(kind="uniform", lo=32, hi=128),
+            engine=_dc.replace(base.engine, model="gpt2-small",
+                               num_slots=batch, page_size=16))
+    num_slots, page_size = ml_spec.engine.num_slots, \
+        ml_spec.engine.page_size
+    ml_trace = materialize(ml_spec)
+    requests = trace_requests(ml_trace)
+    n_req = len(requests)
+    prompt_lens = [len(e.prompt) for e in ml_trace.events]
+    new_tokens = [e.max_new_tokens for e in ml_trace.events]
 
     engine = PagedDecodeEngine(model, v, num_slots=num_slots,
                                page_size=page_size)
@@ -186,28 +205,36 @@ def main():
     print(json.dumps(prec), flush=True)
 
     # --- shared-prefix (radix) cached serving metric ------------------------
-    # every request: one shared system header + a private tail. Requests
-    # admitted after the first concurrent wave point their block tables at
-    # the header's cached pages and prefill only the tail.
-    wl2 = np.random.default_rng(2)
+    # every request: one shared system header + a private tail (the
+    # catalogued ``bench-shared-prefix`` scenario — one tenant whose
+    # deterministic system prompt every request shares). Requests
+    # admitted after the first concurrent wave point their block tables
+    # at the header's cached pages and prefill only the tail.
+    from apex_tpu.serving.scenarios import Tenant
+
     if smoke:
-        pc_slots, sys_len, n_pc = 2, 4 * page_size, 8      # 32-token header
-        pc_tails = wl2.integers(4, 17, n_pc)
-        pc_new = wl2.integers(6, 13, n_pc)
+        pc_spec = scenario_spec("bench-shared-prefix", seed=2)
     else:
-        pc_slots, sys_len, n_pc = num_slots, 16 * page_size, 3 * batch
-        pc_tails = wl2.integers(16, 65, n_pc)
-        pc_new = wl2.integers(32, 129, n_pc)
-    sys_prompt = wl2.integers(0, cfg.vocab_size, sys_len).astype(np.int32)
-    pc_requests = [
-        Request(prompt=np.concatenate(
-            [sys_prompt,
-             wl2.integers(0, cfg.vocab_size, int(t)).astype(np.int32)]),
-            max_new_tokens=int(m))
-        for t, m in zip(pc_tails, pc_new)]
+        pc_base = scenario_spec("bench-shared-prefix", seed=2)
+        pc_spec = _dc.replace(
+            pc_base, n_requests=3 * batch,
+            prompt_lens=Lengths(kind="uniform", lo=16, hi=64),
+            output_lens=Lengths(kind="uniform", lo=32, hi=128),
+            tenants=(Tenant("shared",
+                            system_prompt_tokens=16 * 16),),
+            engine=_dc.replace(pc_base.engine, model="gpt2-small",
+                               num_slots=num_slots, page_size=16))
+    pc_slots = pc_spec.engine.num_slots
+    sys_len = pc_spec.tenants[0].system_prompt_tokens
+    pc_trace = materialize(pc_spec)
+    pc_requests = trace_requests(pc_trace)
+    n_pc = len(pc_requests)
+    pc_tails = [len(e.prompt) - sys_len for e in pc_trace.events]
+    pc_new = [e.max_new_tokens for e in pc_trace.events]
 
     pc_engine = PagedDecodeEngine(model, v, num_slots=pc_slots,
-                                  page_size=page_size, prefix_cache=True)
+                                  page_size=pc_spec.engine.page_size,
+                                  prefix_cache=True)
     pc_engine.run(pc_requests)          # cold: populate the radix cache
     pc_engine.run(pc_requests)          # warm: compile the hit-depth
     #                                     admission programs the timed
