@@ -43,6 +43,10 @@ class CaseProgram:
     variants: Sequence[tuple] = ()
     max_traces: int = 1
     x64: bool = False
+    #: builder-supplied side facts consumers cannot recover from the
+    #: jaxpr (e.g. the TP cases' sharded/replicated weight-byte split —
+    #: ``obs/costs.py`` prices per-chip HBM from it)
+    meta: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -311,6 +315,78 @@ def _build_llama_windowed_program(kind: str) -> CaseProgram:
                        variants=[args_for(22)], max_traces=1)
 
 
+def _build_tp_engine_program(kind: str) -> CaseProgram:
+    """The TENSOR-PARALLEL serving programs (serving/tp.py,
+    docs/tp_serving.md): the tp=2 engine's shard_map-wrapped admission
+    and ``sync_every``-step decode chunk, traced over a deviceless
+    ``AbstractMesh`` — the shard_map body (local-head paged attention,
+    Megatron collectives, replicated pool bookkeeping) is exactly the
+    dtype-drift and compile-key-cardinality surface this tier exists
+    for, and it must lint on any host with any device count. Same
+    bucketing contract as the single-chip cases (two same-bucket
+    admission variants, ``max_traces=1``, bound through the engine's
+    own ``prompt_bucket``/``_admit_fn``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import prompt_bucket
+    from apex_tpu.serving.tp import (TensorParallelPagedEngine,
+                                     abstract_tp_mesh,
+                                     infer_variable_specs)
+
+    tp = 2
+    cfg = gpt2_small_config(dtype=jnp.bfloat16, tensor_parallel_size=tp)
+    model = GPTModel(cfg)
+    engine = TensorParallelPagedEngine(
+        model, variables=None, mesh=abstract_tp_mesh(tp), num_slots=4,
+        page_size=16, num_pages=33, max_pages_per_seq=16, sync_every=4)
+    dvars, var_specs = infer_variable_specs(model)
+
+    def _bytes(leaf):
+        n = 1
+        for d in leaf.shape:
+            n *= int(d)
+        return n * leaf.dtype.itemsize
+
+    sharded = repl = 0
+    # PartitionSpec is an unregistered type, i.e. a pytree LEAF — the
+    # two leaf lists align one-to-one
+    for leaf, spec in zip(jax.tree.leaves(dvars),
+                          jax.tree.leaves(var_specs)):
+        if any(s is not None for s in spec):
+            sharded += _bytes(leaf)
+        else:
+            repl += _bytes(leaf)
+    meta = {"tp": tp, "sharded_weight_bytes": sharded,
+            "replicated_weight_bytes": repl}
+    i32 = jnp.int32
+    if kind == "decode":
+        args = (engine.cache, dvars,
+                jax.ShapeDtypeStruct((4,), i32),           # tok
+                jax.ShapeDtypeStruct((4,), jnp.bool_),     # done
+                jax.ShapeDtypeStruct((4,), i32),           # n_left
+                jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
+                jax.ShapeDtypeStruct((4,), i32))           # samp_i
+        return CaseProgram(fn=engine._step_fn(), args=args, meta=meta)
+    assert kind == "admit"
+
+    def args_for(s0: int) -> tuple:
+        bucket = prompt_bucket(s0, engine.page_size,
+                               cfg.max_position_embeddings)
+        return (engine.cache, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
+    bucket = prompt_bucket(90, engine.page_size,
+                           cfg.max_position_embeddings)
+    return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(90),
+                       variants=[args_for(93)], max_traces=1, meta=meta)
+
+
 def _build_optimizer_update(kind: str) -> CaseProgram:
     """sgd/novograd fused-update steps over the flat-buffer layout
     (adam/lamb already arrive via ``kernel_cases``)."""
@@ -364,6 +440,12 @@ def analysis_cases(root) -> List[AnalysisCase]:
     cases.append(AnalysisCase(
         "llama_windowed_engine_admit_bucketed", "serving",
         lambda: _build_llama_windowed_program("admit")))
+    cases.append(AnalysisCase(
+        "tp2_engine_decode_chunk", "serving",
+        lambda: _build_tp_engine_program("decode")))
+    cases.append(AnalysisCase(
+        "tp2_engine_admit_bucketed", "serving",
+        lambda: _build_tp_engine_program("admit")))
     cases.append(AnalysisCase(
         "optim_sgd_momentum_buffer", "optimizers",
         lambda: _build_optimizer_update("sgd")))

@@ -413,12 +413,20 @@ def check_broadcast_blowup(ir: CaseIR) -> Iterator[RawFinding]:
          "a callback/effectful primitive runs inside a scan/while body "
          "— host traffic on every iteration of the hot loop")
 def check_effectful_in_scan(ir: CaseIR) -> Iterator[RawFinding]:
+    def host_effects(eqn) -> bool:
+        # named-axis effects are trace bookkeeping for collectives
+        # (psum/all_gather/axis_index under shard_map) — on-device ICI
+        # traffic, not host round-trips; a TP decode scan is SUPPOSED
+        # to all-reduce every step
+        return any("NamedAxis" not in type(e).__name__
+                   for e in eqn.effects)
+
     for eqn, in_loop in _iter_eqns(ir.closed.jaxpr):
         if not in_loop:
             continue
         name = eqn.primitive.name
         if "callback" in name or name == "debug_print" \
-                or (bool(eqn.effects)
+                or (host_effects(eqn)
                     and name not in ("scan", "while", "cond", "pjit")):
             yield RawFinding(
                 eqn,

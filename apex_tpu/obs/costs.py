@@ -51,7 +51,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["ChipProfile", "PROFILES", "EqnCost", "CaseCost",
            "cost_of_jaxpr", "cost_report", "decode_split",
-           "ledger_metrics", "main"]
+           "tp_decode_split", "ledger_metrics", "main"]
 
 GIB = 1024 ** 3
 
@@ -256,6 +256,14 @@ class _Walk:
 
     def _walk_eqn(self, eqn, mult: int) -> None:
         name = eqn.primitive.name
+        if name == "shard_map":
+            # the body's avals are the LOCAL shard shapes, so a sharded
+            # program's flops/bytes price PER CHIP — the per-device
+            # roofline a TP mesh actually runs (docs/tp_serving.md)
+            self.notes.append(
+                "shard_map body priced per chip (local shard shapes)")
+            self.walk(eqn.params["jaxpr"], mult)
+            return
         if name == "scan":
             length = int(eqn.params.get("length", 1))
             self.walk(eqn.params["jaxpr"].jaxpr, mult * length)
@@ -442,6 +450,26 @@ def cost_of_jaxpr(closed, profile: ChipProfile, *,
 # the decode chunk's weight-vs-KV byte split
 # --------------------------------------------------------------------------
 
+def _kv_step_bytes_max(cache):
+    """Worst-case KV pool bytes one decode step reads: per layer, each
+    slot's kernel reads its block-table row — at most
+    ``max_pages_per_seq`` pages — bounded by the pool size (page 0 is
+    the null sink). Returns ``(kv_bytes, pool_pages)``; shared by the
+    single-chip and tensor-parallel splits so the bound can never
+    drift between them."""
+    num_slots, max_pages = cache["block_tables"].shape
+    kv_step = 0
+    pool_pages = None
+    for layer in cache["layers"]:
+        for key in ("k_pages", "v_pages"):
+            pages = layer[key]
+            pool_pages = int(pages.shape[0])
+            page_bytes = _aval_bytes(pages) // pool_pages
+            kv_step += min(pool_pages - 1, num_slots * max_pages) \
+                * page_bytes
+    return kv_step, pool_pages
+
+
 def decode_split(prog) -> dict:
     """The serving decode chunk's per-step HBM traffic, split into the
     weight stream vs the (worst-case) KV page reads — computed from the
@@ -455,18 +483,7 @@ def decode_split(prog) -> dict:
     weight_bytes = sum(_aval_bytes(leaf)
                       for leaf in jax.tree.leaves(dvars))
     num_slots, max_pages = cache["block_tables"].shape
-    kv_step = 0
-    pool_pages = None
-    for layer in cache["layers"]:
-        for key in ("k_pages", "v_pages"):
-            pages = layer[key]
-            pool_pages = int(pages.shape[0])
-            page_bytes = _aval_bytes(pages) // pool_pages
-            # per decode step each slot's kernel reads its block-table
-            # row — at most max_pages_per_seq pages — bounded by the
-            # pool (page 0 is the null sink)
-            kv_step += min(pool_pages - 1, num_slots * max_pages) \
-                * page_bytes
+    kv_step, pool_pages = _kv_step_bytes_max(cache)
     total = weight_bytes + kv_step
     return {
         "weight_bytes_per_step": int(weight_bytes),
@@ -474,6 +491,49 @@ def decode_split(prog) -> dict:
         "weight_fraction": weight_bytes / total if total else 0.0,
         "num_slots": int(num_slots), "max_pages_per_seq": int(max_pages),
         "pool_pages": pool_pages,
+    }
+
+
+def tp_decode_split(prog, profile: ChipProfile,
+                    tp_worlds=(1, 2, 4)) -> dict:
+    """Per-CHIP HBM traffic of the tensor-parallel decode chunk at
+    tp = 1/2/4 — the sharding story as numbers (docs/tp_serving.md):
+    head-sharded weights and K/V pages divide by ``tp``, replicated
+    leaves (norms, biases, position table) do not, so both the per-chip
+    byte stream and the weight fraction are computed, not prose.
+    ``prog`` is the ``tp2_engine_decode_chunk`` CaseProgram; its
+    builder-attached ``meta`` carries the sharded/replicated weight
+    split (``analysis/ir/harness.py`` — the jaxpr alone cannot say
+    which leaf shards). Also prices the mesh-tp per-chip step against
+    ``profile``'s HBM bandwidth (decode is memory-bound) — the banded
+    ledger metric ``tp2.paged_decode.predicted_step_ms``."""
+    meta = prog.meta or {}
+    cache = prog.args[0]
+    num_slots = cache["block_tables"].shape[0]
+    kv_step_total, pool_pages = _kv_step_bytes_max(cache)
+    sharded_w = int(meta["sharded_weight_bytes"])
+    repl_w = int(meta["replicated_weight_bytes"])
+    mesh_tp = int(meta["tp"])
+    per_tp = {}
+    for tp in tp_worlds:
+        w = sharded_w / tp + repl_w
+        kv = kv_step_total / tp
+        total = w + kv
+        per_tp[str(tp)] = {
+            "weight_bytes_per_chip_per_step": int(w),
+            "kv_bytes_per_chip_per_step_max": int(kv),
+            "hbm_bytes_per_chip_per_step": int(total),
+            "weight_fraction": w / total if total else 0.0,
+        }
+    at_mesh = per_tp[str(mesh_tp)]
+    predicted_ms = (at_mesh["hbm_bytes_per_chip_per_step"]
+                    / profile.hbm_bytes_per_sec * 1e3)
+    return {
+        "tp_mesh": mesh_tp,
+        "num_slots": int(num_slots),
+        "pool_pages": pool_pages,
+        "per_tp": per_tp,
+        "predicted_step_ms_per_chip": predicted_ms,
     }
 
 
@@ -498,6 +558,7 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     out_cases: List[dict] = []
     errors: List[dict] = []
     split = None
+    tp_split = None
     for c in cases:
         try:
             ir = build_case_ir(c)
@@ -507,6 +568,9 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             if c.name == "gpt2s_engine_decode_chunk":
                 # per-STEP split, read straight off the abstract args
                 split = decode_split(ir.prog)
+            if c.name == "tp2_engine_decode_chunk":
+                # per-CHIP split of the SHARDED decode chunk
+                tp_split = tp_decode_split(ir.prog, prof)
         except Exception as e:       # noqa: BLE001 — report, don't crash
             errors.append({"case": c.name,
                            "error": f"{type(e).__name__}: {e}"})
@@ -527,7 +591,7 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     return {"schema": 1, "profile": dataclasses.asdict(prof),
             "root": str(root), "cases": out_cases, "totals": totals,
             "by_domain": by_domain, "decode_split": split,
-            "errors": errors}
+            "tp_decode_split": tp_split, "errors": errors}
 
 
 def ledger_metrics(report: dict) -> Dict[str, float]:
@@ -550,6 +614,18 @@ def ledger_metrics(report: dict) -> Dict[str, float]:
         m["cost.decode.kv_bytes_per_step_max"] = \
             float(split["kv_bytes_per_step_max"])
         m["cost.decode.weight_fraction"] = float(split["weight_fraction"])
+    tsplit = report.get("tp_decode_split")
+    if tsplit:
+        for tp, slot in sorted(tsplit["per_tp"].items()):
+            m[f"cost.tp_decode.hbm_bytes_per_chip_per_step_tp{tp}"] = \
+                float(slot["hbm_bytes_per_chip_per_step"])
+            m[f"cost.tp_decode.weight_fraction_tp{tp}"] = \
+                float(slot["weight_fraction"])
+        # deliberately NOT cost.*-prefixed: the per-chip step time is the
+        # tp2 serving headline and gates on the direction-aware ±band
+        # (lower-better "_ms"), not the exact-match ratchet
+        m["tp2.paged_decode.predicted_step_ms"] = \
+            float(tsplit["predicted_step_ms_per_chip"])
     return m
 
 
@@ -597,6 +673,27 @@ def _text_report(report: dict) -> str:
             f"-> weight fraction {split['weight_fraction']:.3f} "
             "(weight-bound decode, docs/serving.md)",
         ]
+    tsplit = report.get("tp_decode_split")
+    if tsplit:
+        lines += [
+            "",
+            "tensor-parallel decode chunk, per-chip HBM/step "
+            f"(slots={tsplit['num_slots']}, mesh tp={tsplit['tp_mesh']}):",
+        ]
+        for tp, slot in sorted(tsplit["per_tp"].items(), key=lambda kv:
+                               int(kv[0])):
+            lines.append(
+                f"  tp={tp}: weights "
+                f"{_fmt_qty(slot['weight_bytes_per_chip_per_step'], 'B')}"
+                f" + KV <= "
+                f"{_fmt_qty(slot['kv_bytes_per_chip_per_step_max'], 'B')}"
+                f" = {_fmt_qty(slot['hbm_bytes_per_chip_per_step'], 'B')}"
+                f"/chip/step, weight fraction "
+                f"{slot['weight_fraction']:.3f}")
+        lines.append(
+            f"  predicted step @ mesh tp: "
+            f"{tsplit['predicted_step_ms_per_chip']:.3f} ms/chip "
+            "(HBM-bound)")
     top = []
     for c in report["cases"]:
         for e in c["top_eqns"]:

@@ -151,6 +151,10 @@ _BENCH_FIELDS = (
     "step_ms", "int8_speedup", "step_savings",
     "gpt2_paged_decode_ttft_ms_p50", "gpt2_paged_decode_ttft_ms_p95",
     "decode_step_ms_p50", "decode_step_ms_p95",
+    "gpt2_tp2_paged_decode_ttft_ms_p50",
+    "gpt2_tp2_paged_decode_ttft_ms_p95",
+    "gpt2_tp2_paged_decode_tpot_ms_p50",
+    "gpt2_tp2_paged_decode_tpot_ms_p95",
     "gpt2_frontend_ttft_ms_p50", "gpt2_frontend_ttft_ms_p95",
     "gpt2_frontend_tpot_ms_p50", "gpt2_frontend_tpot_ms_p95",
     "gpt2_frontend_deadline_miss_rate", "prefix_hit_rate",

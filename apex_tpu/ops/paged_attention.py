@@ -27,6 +27,17 @@ same no-repeat discipline as flash_attention and cached_attention.
 
 Off-TPU the kernel runs through the Pallas interpreter
 (``ops/_dispatch.interpret``), so CPU tests cover the real kernel code.
+
+Tensor parallelism (``serving/tp.py``, docs/tp_serving.md): the kernel
+is TP-native by shape, not by flag. Heads never interact — the grid's
+``kv_head`` axis is embarrassingly parallel — so inside ``shard_map``
+with the pool sharded along its kv-head axis, each chip calls this
+kernel on its LOCAL ``(num_pages, kv_heads/tp, page_size, d)`` shard
+with its local query heads and the REPLICATED block tables / lengths:
+the same ``h % kv == 0`` GQA contract holds locally (both counts divide
+by ``tp`` — GQA groups partition whole), no collective appears here,
+and the single TP all-reduce happens after the attention out-projection
+(the Megatron row-parallel layer), never inside the kernel.
 """
 
 from __future__ import annotations
@@ -146,7 +157,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         per sequence slot.
       k_pages / v_pages: ``(num_pages, kv_heads, page_size, head_dim)``
         shared page pool (``kv_heads`` divides ``heads``; GQA never
-        expands).
+        expands). Inside a tensor-parallel ``shard_map`` region both
+        counts are the LOCAL per-chip head shard (``serving/tp.py``) —
+        the kernel is chip-count-blind.
       block_tables: int32 ``(batch, max_pages)``; entry ``[b, j]`` is the
         physical page holding slot ``b``'s positions
         ``[j*page_size, (j+1)*page_size)``. Entries past a sequence's

@@ -34,6 +34,13 @@ This package replaces that with the two serving-stack staples:
   next jitted decode chunk. ``PagedDecodeEngine.run`` is a thin
   closed-loop wrapper over it (docs/frontend.md).
 
+- **Tensor parallelism** (``tp``): ``TensorParallelPagedEngine`` runs
+  ONE logical engine over a ``tp``-axis mesh — the pool's K/V shard
+  along the kv-head axis (each chip holds ``1/tp`` the pool bytes),
+  block tables and scheduling stay replicated/host-side, and every
+  engine program runs under ``shard_map`` with the models' Megatron TP
+  layers (docs/tp_serving.md).
+
 The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
 that gathers pages via the block table with scalar-prefetch index maps.
 """
@@ -63,4 +70,9 @@ from apex_tpu.serving.scheduler import (  # noqa: F401
     Request,
     generate_paged,
     make_shared_admit,
+)
+from apex_tpu.serving.tp import (  # noqa: F401
+    TensorParallelPagedEngine,
+    shard_model_variables,
+    tp_mesh,
 )

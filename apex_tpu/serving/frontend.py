@@ -49,6 +49,12 @@ engine's (``_admit_fn`` / ``_admit_shared_fn`` / ``_step_fn``), so
 contracts the lint harness binds (``analysis_cases()`` traces
 :meth:`ServingFrontend.admission_program` /
 :meth:`ServingFrontend.decode_program` — shared accessors, not mirrors).
+That program-seam discipline is also what makes tensor parallelism
+transparent here: a :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine`
+hands the pump shard_map-wrapped programs over its mesh, the pump's
+host-side reads (block tables, free counts, harvested tokens) see
+replicated values, and nothing in this module knows the chip count
+(``stats()`` reports it as ``tp_world`` so benches can divide through).
 """
 
 from __future__ import annotations
@@ -978,6 +984,10 @@ class ServingFrontend:
                                      1)),
             "deferred_admissions": int(d["deferred_admissions"]),
             "defrag_runs": int(d["defrag_runs"]),
+            # chips the engine's programs span (serving/tp.py) — 1 for
+            # the single-chip engine; per-chip throughput = aggregate /
+            # tp_world (the pool/weight shards each chip streams)
+            "tp_world": int(getattr(eng, "tp_world", 1)),
             "preemptions": int(d["preemptions"]),
             "resumes": int(d["resumes"]),
             "deadline_misses": int(d["deadline_misses"]),

@@ -51,16 +51,28 @@ a masked ``mode="drop"`` scatter — both jittable at one shape forever
 The lane-alignment discipline mirrors ``ops/flat_buffer.py``: a page tile
 is ``(page_size, head_dim)``, so ``page_size`` must be a sublane multiple
 (8) and should be >= 16 for bf16 pools.
+
+Tensor parallelism (``serving/tp.py``, docs/tp_serving.md): with
+``init_paged_cache(..., mesh=)`` the pool is allocated GLOBALLY at the
+full ``num_kv_heads`` and sharded along the head axis over the mesh's
+``tp`` axis (:func:`cache_specs`) — each chip holds its
+``num_kv_heads/tp`` head group of every page, while block tables / free
+stack / lengths / refcounts stay replicated, so every pure-JAX pool op
+in this module runs unchanged inside ``shard_map`` (none of them index
+the head axis).
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from apex_tpu.amp.policy import resolve_compute_dtype
+from apex_tpu.mesh import MODEL_AXIS
 from apex_tpu.ops._dispatch import cdiv
 from apex_tpu.transformer.utils import divide
 from apex_tpu.utils import metrics
@@ -81,15 +93,49 @@ def pages_for(length, page_size: int):
     return (length + page_size - 1) // page_size
 
 
+def cache_specs(config, axis_name: str = MODEL_AXIS):
+    """PartitionSpec pytree mirroring the paged-cache structure for a
+    tensor-parallel mesh (``serving/tp.py``): the per-layer K/V pools
+    shard along the kv-HEAD axis (dim 1 — each chip holds
+    ``num_kv_heads/tp`` heads of EVERY page, so its pool shard is
+    ``1/tp`` the bytes), while the block tables, free stack, lengths,
+    and refcounts stay replicated (the host admission/retirement logic
+    reads them and is chip-count-blind). The tree is both the
+    ``shard_map`` in/out spec for every engine program and the
+    ``NamedSharding`` layout of the global cache."""
+    kv = PartitionSpec(None, axis_name)
+    rep = PartitionSpec()
+    return {
+        "layers": [{"k_pages": kv, "v_pages": kv}
+                   for _ in range(config.num_layers)],
+        "block_tables": rep, "len": rep, "alloc_pages": rep,
+        "shared_pages": rep, "page_ref": rep, "free_stack": rep,
+        "free_top": rep,
+    }
+
+
 def init_paged_cache(config, num_slots: int, *, num_pages: int,
                      page_size: int = 16,
-                     max_pages_per_seq: Optional[int] = None, dtype=None):
+                     max_pages_per_seq: Optional[int] = None, dtype=None,
+                     mesh=None, axis_name: str = MODEL_AXIS,
+                     abstract: bool = False):
     """Allocate the shared page pool + empty slot state.
 
     ``num_pages`` includes the reserved null page 0, so the usable
     capacity is ``(num_pages - 1) * page_size`` tokens across all
     in-flight sequences. ``max_pages_per_seq`` bounds one sequence's block
-    table (default: enough for ``max_position_embeddings``)."""
+    table (default: enough for ``max_position_embeddings``).
+
+    ``mesh`` (a ``Mesh`` or ``AbstractMesh`` whose ``axis_name`` axis has
+    size ``config.tensor_parallel_size``) allocates the GLOBAL
+    tensor-parallel pool instead: the K/V pools hold ALL
+    ``num_kv_heads`` and are sharded along the head axis per
+    :func:`cache_specs` — each chip's shard is its local head group, so
+    a pool that misses one chip's HBM fits the mesh — and everything
+    else is replicated. ``abstract=True`` (implied by an
+    ``AbstractMesh``) returns ``ShapeDtypeStruct`` leaves instead of
+    materializing — the trace/AOT-compile form (a real ``Mesh`` stamps
+    the NamedShardings on the structs; an ``AbstractMesh`` cannot)."""
     if page_size % 8 != 0:
         raise ValueError(f"page_size must be a sublane multiple (8), got "
                          f"{page_size}")
@@ -97,25 +143,76 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
         raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
     kv_heads = getattr(config, "num_kv_heads", config.num_heads)
     kv_local = divide(kv_heads, config.tensor_parallel_size)
+    kv_dim = kv_local
+    if mesh is not None:
+        tp_world = dict(mesh.shape).get(axis_name)
+        if tp_world is None:
+            raise ValueError(f"mesh has no {axis_name!r} axis (axes: "
+                             f"{tuple(dict(mesh.shape))})")
+        if tp_world != config.tensor_parallel_size:
+            raise ValueError(
+                f"mesh {axis_name!r} axis size {tp_world} != "
+                f"config.tensor_parallel_size="
+                f"{config.tensor_parallel_size} — the model's shard "
+                "shapes and the pool's head sharding would disagree")
+        kv_dim = kv_local * tp_world            # the GLOBAL head count
     d = config.head_dim
     dt = dtype if dtype is not None else resolve_compute_dtype(config.dtype)
     if max_pages_per_seq is None:
         max_pages_per_seq = cdiv(config.max_position_embeddings, page_size)
-    shape = (num_pages, kv_local, page_size, d)
-    layers = [{"k_pages": jnp.zeros(shape, dt), "v_pages": jnp.zeros(shape, dt)}
-              for _ in range(config.num_layers)]
-    return {
-        "layers": layers,
-        "block_tables": jnp.zeros((num_slots, max_pages_per_seq), jnp.int32),
-        "len": jnp.zeros((num_slots,), jnp.int32),
-        "alloc_pages": jnp.zeros((num_slots,), jnp.int32),
-        "shared_pages": jnp.zeros((num_slots,), jnp.int32),
-        "page_ref": jnp.zeros((num_pages,), jnp.int32),
-        # pages 1..num_pages-1 free; popped from the top of the stack
-        "free_stack": jnp.arange(1, num_pages + 1, dtype=jnp.int32
-                                 ) % num_pages,
-        "free_top": jnp.asarray(num_pages - 1, jnp.int32),
-    }
+    shape = (num_pages, kv_dim, page_size, d)
+    if mesh is not None and (abstract or not isinstance(mesh, Mesh)):
+        # trace/AOT form: no buffers, just (sharded) shapes
+        specs = cache_specs(config, axis_name)
+        stamp = isinstance(mesh, Mesh)
+
+        def sds(sh, dt_, spec):
+            sharding = NamedSharding(mesh, spec) if stamp else None
+            return jax.ShapeDtypeStruct(sh, dt_, sharding=sharding)
+
+        kv_spec = specs["layers"][0]["k_pages"]
+        rep = PartitionSpec()
+        return {
+            "layers": [{"k_pages": sds(shape, dt, kv_spec),
+                        "v_pages": sds(shape, dt, kv_spec)}
+                       for _ in range(config.num_layers)],
+            "block_tables": sds((num_slots, max_pages_per_seq), jnp.int32,
+                                rep),
+            "len": sds((num_slots,), jnp.int32, rep),
+            "alloc_pages": sds((num_slots,), jnp.int32, rep),
+            "shared_pages": sds((num_slots,), jnp.int32, rep),
+            "page_ref": sds((num_pages,), jnp.int32, rep),
+            "free_stack": sds((num_pages,), jnp.int32, rep),
+            "free_top": sds((), jnp.int32, rep),
+        }
+    def build():
+        layers = [{"k_pages": jnp.zeros(shape, dt),
+                   "v_pages": jnp.zeros(shape, dt)}
+                  for _ in range(config.num_layers)]
+        return {
+            "layers": layers,
+            "block_tables": jnp.zeros((num_slots, max_pages_per_seq),
+                                      jnp.int32),
+            "len": jnp.zeros((num_slots,), jnp.int32),
+            "alloc_pages": jnp.zeros((num_slots,), jnp.int32),
+            "shared_pages": jnp.zeros((num_slots,), jnp.int32),
+            "page_ref": jnp.zeros((num_pages,), jnp.int32),
+            # pages 1..num_pages-1 free; popped from the top of the stack
+            "free_stack": jnp.arange(1, num_pages + 1, dtype=jnp.int32
+                                     ) % num_pages,
+            "free_top": jnp.asarray(num_pages - 1, jnp.int32),
+        }
+
+    if mesh is None:
+        return build()
+    # allocate ALREADY sharded (jit with out_shardings): materializing
+    # the global pool on one device first would OOM at exactly the
+    # shapes TP exists for (a pool bigger than one chip's HBM)
+    shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                             cache_specs(config, axis_name),
+                             is_leaf=lambda x: isinstance(
+                                 x, PartitionSpec))
+    return jax.jit(build, out_shardings=shardings)()
 
 
 def free_page_count(cache):

@@ -28,6 +28,9 @@ The catalog (docs/scenarios.md has the prose):
 - ``priority-flood`` — a low-priority flood pinning every slot while a
   high-priority deadline stream arrives: preempt-and-spill under
   ``preempt_on_priority``, priority-inversion bounded.
+- ``tp-shared-prefix`` — the multi-tenant radix-cache workload replayed
+  through the tp=2 TENSOR-PARALLEL engine (``serving/tp.py``): hits,
+  SLO splits, and contention must compose with the head-sharded pool.
 - ``windowed-llama`` — sliding-window Llama on the PAGED path (the band
   rides the paged kernel, dead pages drop at sync boundaries): long
   generations at O(window) live pages per slot.
@@ -170,6 +173,34 @@ def _priority_flood(seed: int) -> ScenarioSpec:
                           prefix_cache=True, preempt_on_priority=True),
         description="low-priority flood vs high-priority deadline "
                     "stream: preempt-and-spill")
+
+
+@register("tp-shared-prefix")
+def _tp_shared_prefix(seed: int) -> ScenarioSpec:
+    ps = 8
+    # the multi-tenant radix-cache workload on the TENSOR-PARALLEL
+    # engine (serving/tp.py, docs/tp_serving.md): three tenants with
+    # distinct headers + SLO profiles replayed through a tp=2 mesh —
+    # prefix hits, preemption-free contention, and per-tenant SLO
+    # splits must all compose with the head-sharded pool. Needs >= 2
+    # devices (tests/CI force 8 CPU devices; the CLI raises otherwise).
+    return ScenarioSpec(
+        name="tp-shared-prefix", seed=seed, n_requests=16,
+        arrival=Arrival(kind="poisson", rate_rps=400.0),
+        prompt_lens=Lengths(kind="lognormal", mean=10.0, sigma=0.5,
+                            lo=2, hi=24),
+        output_lens=Lengths(kind="uniform", lo=4, hi=10),
+        tenants=(
+            Tenant("free", weight=2.0, system_prompt_tokens=2 * ps),
+            Tenant("pro", weight=1.0, system_prompt_tokens=4 * ps,
+                   priority=2, deadline_ms=400.0),
+            Tenant("batch", weight=1.0, system_prompt_tokens=2 * ps,
+                   tpot_slo_ms=500.0),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=ps,
+                          prefix_cache=True, tensor_parallel=2),
+        description="multi-tenant shared-prefix replay through the "
+                    "tp=2 tensor-parallel engine")
 
 
 @register("windowed-llama")

@@ -109,7 +109,14 @@ def build_model(name: str):
 @dataclasses.dataclass(frozen=True)
 class EngineSpec:
     """The engine/frontend half of a scenario: which model serves the
-    trace and how the slots/pool/policy are configured."""
+    trace and how the slots/pool/policy are configured.
+
+    ``tensor_parallel > 1`` serves the trace through a
+    :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine` over a
+    ``tp``-device mesh (docs/tp_serving.md) — the registry model's
+    tp=1 weights are sharded on first use, so replays stay
+    token-comparable to the single-chip engine and to lock-step
+    ``generate`` (the ``check=True`` amplifiers bind exactly that)."""
 
     model: str = "gpt2-tiny"
     num_slots: int = 3
@@ -119,6 +126,7 @@ class EngineSpec:
     num_pages: Optional[int] = None      # None = worst-case pool
     preempt_on_priority: bool = False
     preempt_margin_ms: float = 50.0
+    tensor_parallel: int = 1             # >1 = TP mesh engine
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,17 +234,45 @@ def trace_requests(trace: Trace) -> List:
     return [_event_request(e) for e in trace.events]
 
 
+_TP_MODEL_CACHE: Dict[tuple, tuple] = {}
+
+
+def _build_tp_model(name: str, tp: int):
+    """``(config, model, sharded_variables, mesh)`` for a registry model
+    at tensor-parallel degree ``tp`` — the tp=1 cached weights sliced
+    over a fresh ``tp``-device mesh, cached per (name, tp) like
+    ``build_model``."""
+    if (name, tp) not in _TP_MODEL_CACHE:
+        import dataclasses as _dc
+
+        from apex_tpu.serving.tp import shard_model_variables, tp_mesh
+
+        cfg, model, v = build_model(name)
+        cfg_tp = _dc.replace(cfg, tensor_parallel_size=tp)
+        model_tp = type(model)(cfg_tp)
+        mesh = tp_mesh(tp)
+        v_tp, _ = shard_model_variables(model_tp, v, mesh)
+        _TP_MODEL_CACHE[(name, tp)] = (cfg_tp, model_tp, v_tp, mesh)
+    return _TP_MODEL_CACHE[(name, tp)]
+
+
 def _build_engine(spec: ScenarioSpec, model, variables, *,
                   sync_every: Optional[int] = None):
     from apex_tpu.serving.scheduler import PagedDecodeEngine
 
     es = spec.engine
-    return PagedDecodeEngine(
-        model, variables, num_slots=es.num_slots,
-        page_size=es.page_size, num_pages=es.num_pages,
-        sync_every=sync_every if sync_every is not None
-        else es.sync_every,
-        prefix_cache=es.prefix_cache)
+    kw = dict(num_slots=es.num_slots, page_size=es.page_size,
+              num_pages=es.num_pages,
+              sync_every=sync_every if sync_every is not None
+              else es.sync_every,
+              prefix_cache=es.prefix_cache)
+    if es.tensor_parallel > 1:
+        from apex_tpu.serving.tp import TensorParallelPagedEngine
+
+        _, model_tp, v_tp, mesh = _build_tp_model(es.model,
+                                                  es.tensor_parallel)
+        return TensorParallelPagedEngine(model_tp, v_tp, mesh=mesh, **kw)
+    return PagedDecodeEngine(model, variables, **kw)
 
 
 def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):

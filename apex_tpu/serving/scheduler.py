@@ -33,6 +33,13 @@ thin closed-loop wrapper over that frontend — this module owns the
 engine STATE (pool, prefix cache, compiled admit/step programs,
 observability identity) the frontend drives.
 
+Every program is compiled through two overridable seams —
+``_make_cache`` (pool allocation) and ``_compile`` (role-tagged jit) —
+which is how ``serving/tp.py``'s
+:class:`~apex_tpu.serving.tp.TensorParallelPagedEngine` runs the SAME
+scheduler over a tensor-parallel mesh: head-sharded pool, shard_mapped
+programs, replicated scheduling state (docs/tp_serving.md).
+
 ``prefix_cache=True`` adds cross-request KV reuse (RadixAttention, Zheng
 et al. 2023; ``serving/prefix_cache.py``): admission walks a radix tree
 of cached full pages, points the slot's block table at the matched pages
@@ -281,9 +288,8 @@ class PagedDecodeEngine:
         if num_pages is None:
             # worst case: every slot holds a max-length sequence (+ null)
             num_pages = 1 + num_slots * max_pages_per_seq
-        self.cache = kv_pool.init_paged_cache(
-            cfg, num_slots, num_pages=num_pages, page_size=page_size,
-            max_pages_per_seq=max_pages_per_seq)
+        self.cache = self._make_cache(num_slots, num_pages, page_size,
+                                      max_pages_per_seq)
         # observability (docs/observability.md): a bounded postmortem
         # event ring for the engine's lifetime, and the last run's span
         # tracer (fresh per run; run(tracer=...) injects one). Every
@@ -300,16 +306,48 @@ class PagedDecodeEngine:
         self._admit_jit = {}             # prompt bucket -> compiled admit
         self._shared_admit_jit = {}      # (t_start, tail_bucket) -> admit
         self._step_jit = None
-        self._free_jit = jax.jit(kv_pool.free_slot,
-                                 donate_argnums=_donate_cache())
-        self._release_jit = jax.jit(kv_pool.release_slot,
-                                    donate_argnums=_donate_cache())
-        self._evict_jit = jax.jit(kv_pool.evict_pages,
-                                  donate_argnums=_donate_cache())
-        self._defrag_jit = jax.jit(kv_pool.defrag_map,
-                                   donate_argnums=_donate_cache())
-        self._drop_jit = jax.jit(kv_pool.drop_slot_pages,
-                                 donate_argnums=_donate_cache())
+        donate = _donate_cache()
+        self._free_jit = self._compile(
+            kv_pool.free_slot, ("cache", "rep"), ("cache",), donate)
+        self._release_jit = self._compile(
+            kv_pool.release_slot, ("cache", "rep", "rep"), ("cache",),
+            donate)
+        self._evict_jit = self._compile(
+            kv_pool.evict_pages, ("cache", "rep", "rep"), ("cache",),
+            donate)
+        self._defrag_jit = self._compile(
+            kv_pool.defrag_map, ("cache", "rep"), ("cache", "rep"), donate)
+        self._drop_jit = self._compile(
+            kv_pool.drop_slot_pages, ("cache", "rep", "rep"), ("cache",),
+            donate)
+
+    # --- compilation seams (overridden by serving/tp.py) --------------------
+
+    def _make_cache(self, num_slots, num_pages, page_size,
+                    max_pages_per_seq):
+        """Allocate the engine's paged cache. The single-chip engine
+        holds the whole pool on the default device;
+        :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine`
+        overrides this to allocate one GLOBAL pool whose K/V head axis
+        is sharded over its ``tp`` mesh."""
+        return kv_pool.init_paged_cache(
+            self.cfg, num_slots, num_pages=num_pages, page_size=page_size,
+            max_pages_per_seq=max_pages_per_seq)
+
+    def _compile(self, fn, in_roles, out_roles, donate=()):
+        """The single seam every engine program is compiled through.
+
+        ``in_roles`` / ``out_roles`` name each positional argument /
+        result of ``fn``: ``"cache"`` (the paged pool pytree),
+        ``"vars"`` (the model variables), ``"rep"`` (a replicated
+        host-side value — tokens, slot indices, masks, keys). The
+        single-chip engine ignores the roles and plain-jits;
+        :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine` wraps
+        ``fn`` in ``shard_map`` over its mesh with per-role
+        PartitionSpecs, so every program — pool maintenance included —
+        runs SPMD over the same sharded state."""
+        del in_roles, out_roles
+        return jax.jit(fn, donate_argnums=donate)
 
     # --- request-key sampling (scheduling-invariant streams) ----------------
 
@@ -345,7 +383,8 @@ class PagedDecodeEngine:
             tok0 = self._first_token(last, req_key, samp0)[0]
             return cache, tok0
 
-        fn = jax.jit(admit, donate_argnums=_donate_cache())
+        fn = self._compile(admit, ("cache", "vars") + ("rep",) * 6,
+                           ("cache", "rep"), _donate_cache())
         self._admit_jit[bucket] = fn
         return fn
 
@@ -359,8 +398,9 @@ class PagedDecodeEngine:
                                    tail_bucket=tail_bucket,
                                    first_token=self._first_token,
                                    axis_name=self.axis_name)
-            self._shared_admit_jit[key] = jax.jit(
-                fn, donate_argnums=_donate_cache())
+            self._shared_admit_jit[key] = self._compile(
+                fn, ("cache", "vars") + ("rep",) * 7, ("cache", "rep"),
+                _donate_cache())
         return self._shared_admit_jit[key]
 
     # --- pool maintenance ---------------------------------------------------
@@ -440,7 +480,9 @@ class PagedDecodeEngine:
                 None, length=self.sync_every)
             return cache, tok, done, n_left, samp_i, toks
 
-        self._step_jit = jax.jit(step, donate_argnums=_donate_cache())
+        self._step_jit = self._compile(
+            step, ("cache", "vars") + ("rep",) * 5,
+            ("cache",) + ("rep",) * 5, _donate_cache())
         return self._step_jit
 
     # --- the host scheduling loop -------------------------------------------

@@ -88,8 +88,14 @@ JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
 # with the entry still banked.
 if [ ! -f "SCENARIOS_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] scenario smoke (CPU, tiny model)..."
-  if ! JAX_PLATFORMS=cpu timeout 1200 python -m apex_tpu.serving.scenarios \
+  # tp-shared-prefix replays through the tp=2 TensorParallelPagedEngine
+  # (docs/tp_serving.md) — force 8 virtual CPU devices so its 2-device
+  # mesh exists on this box
+  if ! JAX_PLATFORMS=cpu \
+      XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+      timeout 1800 python -m apex_tpu.serving.scenarios \
       --scenario steady-poisson --scenario multi-tenant-shared-prefix \
+      --scenario tp-shared-prefix \
       --json "SCENARIOS_${TAG}.json" --seed 0; then
     echo "[$(date +%H:%M:%S)] scenario smoke failed; the workload layer"
     echo "  is broken — fix before burning a tunnel window"
@@ -112,6 +118,39 @@ JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+# TP-SERVING compile pin (docs/tp_serving.md): the tensor-parallel
+# sharded admit/decode programs must AOT-compile for the deviceless
+# v5e:2x4 topology at a pool shape one chip cannot hold — banked BEFORE the
+# tunnel probe (like the cost entry) so a dead tunnel still keeps the
+# round's TP compile evidence, and gated: a broken TP program fails the
+# round here, on the CI box.
+if [ ! -f "AOT_${TAG}_tp.json" ]; then
+  echo "[$(date +%H:%M:%S)] deviceless TP-serving compile pin..."
+  APEX_TPU_TAG="${TAG}_tp" timeout 2700 python tpu_aot.py \
+    --only tp4_paged_engine_admit tp4_paged_engine_decode_chunk \
+    --skip-autotune --skip-overlap 2> "aot_tp_${TAG}.stderr.log" || true
+  tail -2 "aot_tp_${TAG}.stderr.log"
+fi
+python - "$TAG" <<'EOF' || exit 1
+import json, sys
+tag = sys.argv[1]
+try:
+    doc = json.load(open(f"AOT_{tag}_tp.json"))
+except Exception as e:  # noqa: BLE001
+    raise SystemExit(f"[tp-aot] missing/corrupt AOT_{tag}_tp.json: {e}")
+mc = doc.get("multichip", {})
+bad = [n for n in ("tp4_paged_engine_admit", "tp4_paged_engine_decode_chunk")
+       if not (mc.get(n, {}).get("ok")
+               and mc.get(n, {}).get("under_16gib_budget"))]
+if bad:
+    for n in bad:
+        print(f"[tp-aot] {n}: {json.dumps(mc.get(n, {}))[:400]}")
+    raise SystemExit(f"[tp-aot] TP serving programs failed the deviceless "
+                     f"compile pin: {bad}")
+print("[tp-aot] tp4 admit+decode compile for the v5e topology under the "
+      "per-chip budget")
+EOF
 
 # TUNNEL-INDEPENDENT tier first (VERDICT r4 weak #2: the probe must not
 # gate evidence the tunnel does not actually gate): the offline AOT-Mosaic
@@ -296,11 +335,14 @@ EOF
 fi
 # decode-throughput harvest (beyond reference — no gate dependency beyond
 # the suite's flash/xentropy compiles; cheap: one small-model compile).
-# Emits four metrics: lock-step decode, paged continuous batching,
-# prefix-cached serving (shared-system-prompt workload), and the async
-# serving FRONT-END under an open-loop Poisson arrival stream with
-# priorities/deadlines + a forced preemption/spill/resume burst
-# (gpt2_frontend_* TTFT/TPOT/deadline-miss fields; docs/frontend.md).
+# Emits five metrics: lock-step decode, paged continuous batching, the
+# tp=2 TENSOR-PARALLEL paged engine (gpt2_tp2_paged_decode_* per-chip
+# throughput + TTFT/TPOT fields; skipped->0.0 on a 1-device window;
+# docs/tp_serving.md), prefix-cached serving (shared-system-prompt
+# workload), and the async serving FRONT-END under an open-loop Poisson
+# arrival stream with priorities/deadlines + a forced
+# preemption/spill/resume burst (gpt2_frontend_* TTFT/TPOT/deadline-miss
+# fields; docs/frontend.md).
 # The offline AOT sweep above covers the matching compile evidence via
 # the gpt2s_prefix_cached_admit + paged_attention_gpt2s_decode cases,
 # and the IR lint registry traces the frontend's admission/decode-chunk

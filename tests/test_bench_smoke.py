@@ -56,6 +56,12 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     metrics snapshot artifact lands where APEX_TPU_METRICS_OUT points."""
     env = dict(os.environ)
     env["APEX_TPU_DECODE_SMOKE"] = "1"
+    # the tp=2 section needs >= 2 devices; don't rely on conftest's
+    # env mutation having taken the XLA_FLAGS fallback path
+    if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
     snap_path = tmp_path / "metrics_snapshot.json"
     env["APEX_TPU_METRICS_OUT"] = str(snap_path)
     r = subprocess.run([sys.executable,
@@ -84,6 +90,22 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     assert paged["decode_step_ms_p95"] >= paged["decode_step_ms_p50"]
     assert paged["queue_wait_ms_p50"] >= 0
     assert paged["tpot_ms_p50"] > 0
+
+    # the tensor-parallel paged engine's record (ISSUE 10,
+    # docs/tp_serving.md): the tp=2 run must have actually happened
+    # (conftest forces 8 virtual CPU devices into this subprocess's
+    # env), carry the per-chip headline + TTFT/TPOT percentiles, and —
+    # asserted inside the bench itself — be greedy token-identical to
+    # the single-chip paged engine on the same workload
+    tp = recs["gpt2_tp2_paged_decode_tokens_per_sec_per_chip"]
+    assert "skipped" not in tp, tp
+    assert tp["value"] > 0
+    assert tp["tp_world"] == 2
+    assert tp["gpt2_tp2_paged_decode_ttft_ms_p50"] > 0
+    assert (tp["gpt2_tp2_paged_decode_ttft_ms_p95"]
+            >= tp["gpt2_tp2_paged_decode_ttft_ms_p50"])
+    assert tp["gpt2_tp2_paged_decode_tpot_ms_p50"] > 0
+    assert tp["aggregate_tokens_per_sec"] >= tp["value"]
 
     pc = recs["gpt2_prefix_cached_decode_tokens_per_sec_per_chip"]
     assert pc["ttft_ms_p50"] > 0 and pc["decode_step_ms_p50"] > 0

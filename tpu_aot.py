@@ -536,7 +536,52 @@ MULTICHIP_CASE_NAMES = (
     "tp2_t5_grad_and_cached_decode",
     "ep2_etp2_moe_grad",
     "pp2_tp2_1f1b_pipeline_step",
+    "tp4_paged_engine_admit",
+    "tp4_paged_engine_decode_chunk",
 )
+
+#: the tensor-parallel serving acceptance shape (docs/tp_serving.md):
+#: 384 slots x 32 pages of a GPT at hidden 1024 / 8 heads — head_dim
+#: 128, so page tiles are (32, 128): lane-exact, NO tiled-layout
+#: padding, and the unpadded byte accounting below IS the physical HBM
+#: footprint. 12289 pages x 1.5 MiB = 18.0 GiB UNSHARDED — over one
+#: v5e chip's 16 GiB — sharded tp=4 over the v5e:2x4 topology (4.5 GiB
+#: head shard per chip) the admit+decode programs compile under the
+#: per-chip budget. tests/test_aot_mosaic.py asserts both halves of
+#: that inequality. Two shape lessons are baked in here (both found by
+#: this case's own compile failures): (a) GPT-2 small's head_dim 64
+#: pads 2x in TPU tiled layout — the first 512-slot d=64 attempt OOM'd
+#: at 25.6 GiB from padding alone; lane-align the head dim; (b) the
+#: decode chunk's lax.scan DOUBLE-BUFFERS the pool carry in XLA, so a
+#: chip needs ~2x its pool shard transient — which is why 18 GiB
+#: shards over four chips, not two (2 x 9 GiB + weights > 16 GiB).
+TP_SERVING_SLOTS = 384
+TP_SERVING_PAGE_SIZE = 32
+TP_SERVING_MAX_PAGES_PER_SEQ = 32
+TP_SERVING_TP = 4
+
+
+def tp_serving_config():
+    """The acceptance model: GPT-2-small depth at hidden 1024 / 8 heads
+    (head_dim 128 — lane-exact page tiles), tp=4, bf16."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import gpt2_small_config
+
+    return gpt2_small_config(hidden_size=1024, num_heads=8,
+                             dtype=jnp.bfloat16,
+                             tensor_parallel_size=TP_SERVING_TP)
+
+
+def tp_serving_pool_bytes() -> int:
+    """The UNSHARDED pool's bytes at the TP acceptance shape (what a
+    single chip would have to hold)."""
+    cfg = tp_serving_config()
+    num_pages = 1 + TP_SERVING_SLOTS * TP_SERVING_MAX_PAGES_PER_SEQ
+    kv_heads = getattr(cfg, "num_kv_heads", cfg.num_heads)
+    # k + v, bf16
+    return (num_pages * cfg.num_layers * 2 * kv_heads
+            * TP_SERVING_PAGE_SIZE * cfg.head_dim * 2)
 
 
 def multichip_cases(topo):
@@ -700,8 +745,65 @@ def multichip_cases(topo):
         return mesh, pipe_step, [stacked_s, _sds(mbs.shape, i32),
                                  _sds(labels.shape, i32)]
 
+    def _build_tp_serving(kind):
+        # the tensor-parallel PAGED SERVING programs (serving/tp.py):
+        # the tp=TP_SERVING_TP engine's shard_map admission + decode
+        # chunk with the pool's kv-head axis REALLY sharded over the
+        # topology mesh — per-chip memory_analysis then proves a pool
+        # one chip cannot hold (tp_serving_pool_bytes() > 16 GiB)
+        # compiles under the per-chip budget when sharded
+        from jax.sharding import Mesh, NamedSharding
+
+        from apex_tpu.models.gpt import GPTModel
+        from apex_tpu.serving.scheduler import prompt_bucket
+        from apex_tpu.serving.tp import (TensorParallelPagedEngine,
+                                         infer_variable_specs)
+
+        mesh = Mesh(np.asarray(topo.devices[:TP_SERVING_TP]),
+                    (MODEL_AXIS,))
+        cfg = tp_serving_config()
+        model = GPTModel(cfg)
+        engine = TensorParallelPagedEngine(
+            model, variables=None, mesh=mesh, abstract=True,
+            num_slots=TP_SERVING_SLOTS,
+            page_size=TP_SERVING_PAGE_SIZE,
+            max_pages_per_seq=TP_SERVING_MAX_PAGES_PER_SEQ,
+            sync_every=4)
+        dvars_abs, var_specs = infer_variable_specs(model)
+        dvars = jax.tree.map(
+            lambda s, sp: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+            dvars_abs, var_specs)
+        repl = NamedSharding(mesh, P())
+
+        def rsds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
+
+        n = TP_SERVING_SLOTS
+        # donate the cache (arg 0): in production the pool updates in
+        # place; without it the in/out pool shards double-count and no
+        # 16 GiB chip could ever hold a >8 GiB-sharded program
+        if kind == "decode":
+            args = [engine.cache, dvars, rsds((n,), i32),
+                    rsds((n,), jnp.bool_), rsds((n,), i32),
+                    rsds((n, 2), jnp.uint32), rsds((n,), i32)]
+            return mesh, engine._step_fn(), args, (0,)
+        bucket = prompt_bucket(128, TP_SERVING_PAGE_SIZE,
+                               cfg.max_position_embeddings)
+        args = [engine.cache, dvars, rsds((1, bucket), i32), rsds((), i32),
+                rsds((), i32), rsds((), i32), rsds((2,), jnp.uint32),
+                rsds((), i32)]
+        return mesh, engine._admit_fn(bucket), args, (0,)
+
+    def build_tp_paged_admit():
+        return _build_tp_serving("admit")
+
+    def build_tp_paged_decode():
+        return _build_tp_serving("decode")
+
     builders = (build_cp_ring, build_cp_zigzag, build_tp_megatron,
-                build_tp_t5, build_moe, build_pipeline)
+                build_tp_t5, build_moe, build_pipeline,
+                build_tp_paged_admit, build_tp_paged_decode)
     for name, build in zip(MULTICHIP_CASE_NAMES, builders):
         yield name, build
 
@@ -717,22 +819,40 @@ def multichip_aot(topo, only=None):
         log(f"multichip case {name}...")
         try:
             t0 = time.perf_counter()
-            mesh, fn, structs = build()   # lazy: inside the per-case try
+            built = build()               # lazy: inside the per-case try
+            mesh, fn, structs = built[:3]
+            donate = built[3] if len(built) > 3 else ()
             repl = NamedSharding(mesh, P())
+            # a builder may pre-stamp per-arg shardings (the TP serving
+            # cases shard the pool's head axis); only default-stamp the
+            # unstamped leaves as replicated
             args = jax.tree.map(
-                lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                               sharding=repl),
+                lambda s: s if getattr(s, "sharding", None) is not None
+                else jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                          sharding=repl),
                 tuple(structs))
-            compiled = jax.jit(fn).lower(*args).compile()
+            compiled = jax.jit(fn, donate_argnums=donate
+                               ).lower(*args).compile()
             txt = compiled.as_text()
             ma = compiled.memory_analysis()
+            arg_b = int(ma.argument_size_in_bytes)
+            out_b = int(ma.output_size_in_bytes)
+            tmp_b = int(ma.temp_size_in_bytes)
+            alias_b = int(getattr(ma, "alias_size_in_bytes", 0))
+            peak = arg_b + out_b + tmp_b - alias_b   # PER-CHIP bytes
             out[name] = {
                 "ok": True,
                 "tpu_custom_call_sites": txt.count("tpu_custom_call"),
                 "collective_permutes": txt.count("collective-permute"),
                 "all_to_alls": txt.count("all-to-all"),
                 "all_reduces": txt.count("all-reduce"),
-                "temp_bytes": int(ma.temp_size_in_bytes),
+                "argument_bytes": arg_b,
+                "output_bytes": out_b,
+                "temp_bytes": tmp_b,
+                "alias_bytes": alias_b,
+                "peak_estimate_bytes": peak,
+                "peak_estimate_gib": round(peak / 1024 ** 3, 3),
+                "under_16gib_budget": peak < HBM_BUDGET,
                 "giant_copy_flags": hlo_red_flags(txt),
                 "compile_s": round(time.perf_counter() - t0, 1),
             }

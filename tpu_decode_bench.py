@@ -26,6 +26,15 @@ and shared-system-prompt request sets are the scenario library's
 (apex_tpu/serving/scenarios, docs/scenarios.md), materialized from a
 fixed seed — the bench keeps only the measurement loops and asserts.
 
+Between the paged and prefix-cached lines: the TENSOR-PARALLEL paged
+engine (serving/tp.py, docs/tp_serving.md) — the same mixed-length
+workload through a tp=2 ``TensorParallelPagedEngine`` (head-sharded
+pool + Megatron weight shards over a 2-device mesh), emitting
+{"metric": "gpt2_tp2_paged_decode_tokens_per_sec_per_chip", ...} with
+TTFT/TPOT percentiles; the smoke run asserts greedy token identity
+against the single-chip engine. On a 1-device window the record lands
+with value 0.0 (zero baselines never gate in the perf ledger).
+
 Third line: the PREFIX-CACHED serving path — a shared-system-prompt
 workload (every request = one common header + a private tail, the
 dominant multi-user pattern) through the engine with
@@ -203,6 +212,75 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(prec), flush=True)
+
+    # --- tensor-parallel paged serving metric -------------------------------
+    # the SAME mixed-length workload through a tp=2
+    # TensorParallelPagedEngine (serving/tp.py, docs/tp_serving.md): the
+    # pool's kv heads and the Megatron weight shards split over a
+    # 2-device mesh, the scheduler/block tables stay replicated, and
+    # greedy outputs must be token-identical to the single-chip engine
+    # above (asserted in smoke). The headline divides by tp — per-CHIP
+    # throughput, comparable against the single-chip paged number
+    # (aggregate bandwidth scales with the mesh; per-chip should hold
+    # roughly steady once the model is big enough to stream).
+    if len(jax.devices()) >= 2:
+        from apex_tpu.serving.tp import (TensorParallelPagedEngine,
+                                         shard_model_variables, tp_mesh)
+
+        tp = 2
+        tp_cfg = dataclasses.replace(cfg, tensor_parallel_size=tp)
+        tp_model = GPTModel(tp_cfg)
+        tp_m = tp_mesh(tp)
+        tp_vars, _ = shard_model_variables(tp_model, v, tp_m)
+        tp_engine = TensorParallelPagedEngine(
+            tp_model, tp_vars, mesh=tp_m, num_slots=num_slots,
+            page_size=page_size)
+        tp_engine.run(requests)                          # compile + warm
+        t0 = time.perf_counter()
+        tp_outs, tp_stats = tp_engine.run(requests)
+        tp_elapsed = time.perf_counter() - t0
+        tp_tokens = int(sum(o.shape[0] for o in tp_outs))
+        if smoke:
+            for i, (a, b) in enumerate(zip(outs, tp_outs)):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    raise SystemExit(
+                        f"tp=2 greedy decode diverged from the "
+                        f"single-chip engine on request {i}: "
+                        f"{np.asarray(a)[:8]}... vs {np.asarray(b)[:8]}...")
+        tp_rec = {
+            "metric": "gpt2_tp2_paged_decode_tokens_per_sec_per_chip",
+            "value": round(tp_tokens / max(tp_elapsed, 1e-9) / tp, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "tp_world": tp_stats["tp_world"],
+            "requests": n_req, "num_slots": num_slots,
+            "page_size": page_size,
+            "generated_tokens": tp_tokens,
+            "decode_steps": tp_stats["decode_steps"],
+            "aggregate_tokens_per_sec": round(
+                tp_tokens / max(tp_elapsed, 1e-9), 1),
+            "gpt2_tp2_paged_decode_ttft_ms_p50": round(
+                tp_stats["ttft_ms_p50"], 3),
+            "gpt2_tp2_paged_decode_ttft_ms_p95": round(
+                tp_stats["ttft_ms_p95"], 3),
+            "gpt2_tp2_paged_decode_tpot_ms_p50": round(
+                tp_stats["tpot_ms_p50"], 3),
+            "gpt2_tp2_paged_decode_tpot_ms_p95": round(
+                tp_stats["tpot_ms_p95"], 3),
+            "decode_step_ms_p50": round(
+                tp_stats["decode_step_ms_p50"], 3),
+            "device": dev.device_kind, "platform": dev.platform,
+        }
+        print(json.dumps(tp_rec), flush=True)
+    else:
+        # a 1-device window cannot run the tp=2 engine; emit the record
+        # with a dead value (zero baselines never gate in the ledger)
+        print(json.dumps({
+            "metric": "gpt2_tp2_paged_decode_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "skipped": "needs >= 2 devices",
+            "device": dev.device_kind, "platform": dev.platform,
+        }), flush=True)
 
     # --- shared-prefix (radix) cached serving metric ------------------------
     # every request: one shared system header + a private tail (the
