@@ -7,8 +7,13 @@ function can run on, by rooting a BFS at every statically visible
 thread entry point:
 
 - ``threading.Thread(target=f, ...)`` / ``threading.Timer(t, f)`` —
-  the root is named by the ctor's literal ``name=`` when present (the
-  pump thread's ``"serving-frontend-pump"``), else the target's name;
+  the root is named by the ctor's literal ``name=`` when present, else
+  the target's name. The serving stack's threads all register this way:
+  every replica frontend's ``"serving-frontend-pump"`` and the replica
+  router's ``"serving-router-supervisor"`` (whose tick — failure
+  detection, token forwarding, failover — colors the whole
+  ``ReplicaRouter`` call chain; ``tests/test_conc_lint.py`` pins both
+  colorings and the router's GuardedBy map);
 - ``<executor|pool>.submit(f, ...)`` — worker-pool dispatch (the
   receiver must *look like* an executor so the serving front-end's
   ``submit(request)`` ingest API never becomes a false root);

@@ -51,14 +51,14 @@ LEDGER_NAME = "PERF_LEDGER.jsonl"
 #: substrings classifying a wall-time metric's good direction; anything
 #: matching neither is recorded but not gated (informational counters)
 _HIGHER_BETTER = ("tokens_per_sec", "_per_sec", "hit_rate", "step_savings",
-                  "speedup")
+                  "speedup", "recovered_rate")
 _LOWER_BETTER = ("_ms", "misses", "miss_rate", "bubble")
 
 #: [0, 1] ratios with small integer denominators (one request flipping a
 #: ~8-deadline scenario moves miss_rate by 0.125 — a relative ±20 % band
 #: would flag scheduling noise as a regression): gate on ABSOLUTE
 #: worsening beyond this instead
-_RATE_SUFFIXES = ("miss_rate", "hit_rate")
+_RATE_SUFFIXES = ("miss_rate", "hit_rate", "recovered_rate")
 _RATE_ABS_TOL = 0.25
 
 
@@ -146,6 +146,15 @@ def head_cost_metrics(root, *, costs_json: Optional[str] = None,
 #: (``_ms`` relative band / ``miss_rate`` absolute ±``_RATE_ABS_TOL``)
 _SCENARIO_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "deadline_miss_rate")
 
+#: per-scenario ROUTER fields (the replicated-serving chaos/A-B tier,
+#: docs/router.md): extracted from a report's ``router`` block as
+#: ``scenario.<name>.<field>``. ``failover_recovered_rate`` and the
+#: hit-rate pair gate on the absolute rate band; the delta is the
+#: affinity-beats-round-robin proof (higher-better, rate band)
+_SCENARIO_ROUTER_FIELDS = ("failover_recovered_rate",
+                           "affinity_hit_rate", "round_robin_hit_rate",
+                           "affinity_delta_hit_rate")
+
 #: numeric bench-record fields worth tracking besides the headline value
 _BENCH_FIELDS = (
     "step_ms", "int8_speedup", "step_savings",
@@ -170,6 +179,11 @@ def _scenario_metrics(doc: dict) -> Dict[str, float]:
         agg = rep.get("aggregate", {}) if isinstance(rep, dict) else {}
         for field in _SCENARIO_FIELDS:
             v = agg.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"scenario.{name}.{field}"] = float(v)
+        router = rep.get("router", {}) if isinstance(rep, dict) else {}
+        for field in _SCENARIO_ROUTER_FIELDS:
+            v = router.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"scenario.{name}.{field}"] = float(v)
     return out

@@ -41,11 +41,28 @@ This package replaces that with the two serving-stack staples:
   engine program runs under ``shard_map`` with the models' Megatron TP
   layers (docs/tp_serving.md).
 
+- **Data-parallel replication** (``router`` + ``faults``): N
+  frontend+engine replicas (each optionally TP) behind one
+  :class:`ReplicaRouter` — queue-depth load balancing, rendezvous-hash
+  prefix-affinity routing, overload shedding with retry-after,
+  graceful drain, and supervised failure recovery (a dead replica's
+  in-flight requests resume on survivors with their generated tokens
+  folded into the prompt; exhausted recovery fails handles with a
+  terminal :class:`ServingError`, never a hang). ``faults`` makes the
+  failures seeded, replayable scenario inputs (docs/router.md).
+
 The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
 that gathers pages via the block table with scalar-prefetch index maps.
 """
 
+from apex_tpu.serving.faults import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
 from apex_tpu.serving.frontend import (  # noqa: F401
+    ServingError,
     ServingFrontend,
     StreamHandle,
 )
@@ -64,6 +81,12 @@ from apex_tpu.serving.kv_pool import (  # noqa: F401
     release_slot,
 )
 from apex_tpu.serving.policy import PriorityDeadlinePolicy  # noqa: F401
+from apex_tpu.serving.router import (  # noqa: F401
+    OverloadError,
+    ReplicaRouter,
+    RouterHandle,
+    RouterPolicy,
+)
 from apex_tpu.serving.prefix_cache import PrefixCache  # noqa: F401
 from apex_tpu.serving.scheduler import (  # noqa: F401
     PagedDecodeEngine,

@@ -1,0 +1,222 @@
+"""Deterministic, seeded fault injection for the serving stack.
+
+Chaos engineering only earns its keep when the chaos is *replayable*: a
+failure scenario that cannot be re-run bit-for-bit cannot pin a recovery
+contract. This module makes injected failures first-class scenario
+inputs:
+
+- a :class:`FaultSpec` names ONE fault — which replica, which kind, and
+  a deterministic trigger index (a pump-iteration count for
+  ``kill_replica``/``pump_stall``, a submission count for
+  ``admission_reject``) — and is JSON-round-trippable, so chaos
+  scenarios carry their fault plans inside their
+  :class:`~apex_tpu.serving.scenarios.runner.ScenarioSpec` exactly like
+  arrival processes carry their rates;
+- a :class:`FaultPlan` bundles specs (``FaultPlan.random(seed, ...)``
+  samples one from a ``default_rng(seed)`` — seeded chaos, same seed =
+  same kills);
+- a :class:`FaultInjector` delivers one replica's faults through the
+  frontend's **first-class seams** (``ServingFrontend(fault_hook=...)``:
+  ``on_pump`` at the top of every pump iteration, ``on_submit`` before a
+  submission lands) rather than monkeypatching — the injected kill takes
+  the *real* pump-death path (`_fail_all`, terminal
+  :class:`~apex_tpu.serving.frontend.ServingError` on every handle), so
+  a chaos test exercises exactly the machinery a production fault would.
+
+Fault kinds:
+
+- ``kill_replica`` — the pump raises :class:`InjectedFault` at its
+  ``at``-th iteration: the engine is dead mid-decode, every live handle
+  on it fails terminally, and the router's supervisor must re-home its
+  in-flight requests.
+- ``pump_stall`` — the pump sleeps ``delay_ms`` for ``count``
+  iterations starting at ``at``: a wedged-but-alive engine (GC pause,
+  host contention) — latency, not death; nothing may hang or leak.
+- ``admission_reject`` — ``count`` submissions starting at the
+  ``at``-th raise :class:`~apex_tpu.serving.frontend.ServingError`
+  from ``submit()``: an overloaded/refusing replica; the router retries
+  elsewhere.
+- ``slow_consumer`` — the router's token forwarding for every request
+  delays ``delay_ms`` per tick (``consume_delay_s``): a slow client;
+  streams must stay ordered and the pump unblocked (handles buffer,
+  pages never pin on consumption).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from apex_tpu.serving.frontend import ServingError
+
+__all__ = ["FAULT_KINDS", "FaultSpec", "FaultPlan", "FaultInjector",
+           "InjectedFault"]
+
+FAULT_KINDS = ("kill_replica", "pump_stall", "admission_reject",
+               "slow_consumer")
+
+
+class InjectedFault(ServingError):
+    """The exception an injected ``kill_replica`` raises inside the
+    pump — a :class:`ServingError` subclass, so handle failure and
+    router failover treat it exactly like a real engine death."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic fault.
+
+    ``at`` is the trigger index in the fault kind's own counter —
+    pump iterations (0-based) for ``kill_replica``/``pump_stall``,
+    submissions for ``admission_reject``; ignored by ``slow_consumer``
+    (which applies from the first token). ``count`` bounds repeating
+    faults (stalled iterations / rejected submissions); ``delay_ms``
+    is the stall or per-tick consumer delay."""
+
+    kind: str
+    replica: int = 0
+    at: int = 0
+    count: int = 1
+    delay_ms: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {FAULT_KINDS})")
+        if self.replica < 0 or self.at < 0:
+            raise ValueError("replica and at must be >= 0")
+        if self.count < 1:
+            raise ValueError("count must be >= 1")
+        if self.delay_ms < 0:
+            raise ValueError("delay_ms must be >= 0")
+        if self.kind in ("pump_stall", "slow_consumer") \
+                and self.delay_ms == 0:
+            raise ValueError(f"{self.kind} needs delay_ms > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An ordered bundle of faults for one chaos run."""
+
+    specs: Tuple[FaultSpec, ...] = ()
+
+    def for_replica(self, replica: int) -> Tuple[FaultSpec, ...]:
+        return tuple(s for s in self.specs if s.replica == replica)
+
+    def injector(self, replica: int) -> Optional["FaultInjector"]:
+        """The replica's frontend hook, or None when this plan holds
+        nothing for it (no hook = zero per-iteration overhead)."""
+        specs = self.for_replica(replica)
+        return FaultInjector(specs) if specs else None
+
+    # -- JSON round-trip (rides inside ScenarioSpec) -------------------------
+
+    def to_json(self) -> str:
+        return json.dumps([dataclasses.asdict(s) for s in self.specs],
+                          sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls(specs=tuple(FaultSpec(**d) for d in json.loads(text)))
+
+    @classmethod
+    def random(cls, seed: int, n_replicas: int, *, n_faults: int = 1,
+               kinds: Sequence[str] = ("kill_replica",),
+               max_at: int = 8, delay_ms: float = 20.0) -> "FaultPlan":
+        """Sample a plan from ``default_rng(seed)`` — same seed, same
+        faults, byte-identical ``to_json()``."""
+        import numpy as np
+
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        rng = np.random.default_rng(seed)
+        specs: List[FaultSpec] = []
+        for _ in range(n_faults):
+            kind = kinds[int(rng.integers(0, len(kinds)))]
+            specs.append(FaultSpec(
+                kind=kind,
+                replica=int(rng.integers(0, n_replicas)),
+                at=int(rng.integers(0, max_at + 1)),
+                count=int(rng.integers(1, 4)),
+                delay_ms=delay_ms if kind in ("pump_stall",
+                                              "slow_consumer") else 0.0))
+        return cls(specs=tuple(specs))
+
+
+class FaultInjector:
+    """One replica's fault delivery, plugged into
+    ``ServingFrontend(fault_hook=...)``.
+
+    Thread-safe: ``on_submit`` runs on submitter threads, ``on_pump``
+    on the pump thread, ``consume_delay_s`` on the router's tick —
+    the trigger counters share one lock. The sleeps happen OUTSIDE the
+    lock (a stall must wedge only its own pump, never a submitter)."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self.specs = tuple(specs)
+        self._lock = threading.Lock()
+        self._pumps = 0
+        self._submits = 0
+        self._rejected = 0
+        self.fired: List[str] = []       # kinds delivered, in order
+
+    def _record(self, kind: str) -> None:
+        self.fired.append(kind)
+
+    # -- frontend seams ------------------------------------------------------
+
+    def on_pump(self, frontend) -> None:
+        """Top of every pump iteration: kill (raise) or stall (sleep)."""
+        stall_s = 0.0
+        kill: Optional[FaultSpec] = None
+        with self._lock:
+            idx = self._pumps
+            self._pumps += 1
+            for spec in self.specs:
+                if spec.kind == "kill_replica" and idx >= spec.at:
+                    kill = spec
+                    break
+                if spec.kind == "pump_stall" \
+                        and spec.at <= idx < spec.at + spec.count:
+                    stall_s += spec.delay_ms * 1e-3
+                    self._record("pump_stall")
+            if kill is not None:
+                self._record("kill_replica")
+        if kill is not None:
+            raise InjectedFault(
+                f"replica killed by fault injection at pump "
+                f"iteration {idx} (spec at={kill.at})")
+        if stall_s:
+            time.sleep(stall_s)
+
+    def on_submit(self, frontend, request) -> None:
+        """Before a submission lands: reject ``count`` submissions
+        starting at the ``at``-th."""
+        reject = False
+        with self._lock:
+            idx = self._submits
+            self._submits += 1
+            for spec in self.specs:
+                if spec.kind == "admission_reject" and idx >= spec.at \
+                        and self._rejected < spec.count:
+                    self._rejected += 1
+                    self._record("admission_reject")
+                    reject = True
+                    break
+        if reject:
+            raise ServingError(
+                f"submission {idx} rejected by fault injection")
+
+    # -- router seam ---------------------------------------------------------
+
+    def consume_delay_s(self, request_id) -> float:
+        """Per-tick token-forwarding delay for ``request_id`` (the
+        slow-consumer fault; 0.0 when none is planned)."""
+        del request_id
+        for spec in self.specs:
+            if spec.kind == "slow_consumer":
+                return spec.delay_ms * 1e-3
+        return 0.0

@@ -80,10 +80,20 @@ from apex_tpu.serving.scheduler import (_RUN_COUNTERS, _RUN_HISTOGRAMS,
                                         prompt_bucket)
 from apex_tpu.utils import metrics
 
-__all__ = ["ServingFrontend", "StreamHandle"]
+__all__ = ["ServingError", "ServingFrontend", "StreamHandle"]
 
 #: sentinel closing a handle's token stream
 _END = object()
+
+
+class ServingError(RuntimeError):
+    """Terminal serving failure delivered to a :class:`StreamHandle`:
+    the pump died (engine fault, injected kill, scheduler deadlock), the
+    frontend refused the request (draining, fault-injected admission
+    reject), or — at the router layer (``serving/router.py``) — every
+    failover attempt was exhausted. A handle that fails raises this from
+    ``result()`` AND from iteration/``get()``, so a streaming consumer
+    can never block forever on a dead engine."""
 
 #: pump pipeline timing series (run-local percentiles in ``stats()``;
 #: cumulative distributions in the engine-labeled histograms):
@@ -127,6 +137,13 @@ class StreamHandle:
         self._q.put(_END)
 
     def _fail(self, exc: BaseException) -> None:
+        # terminal errors surface as ServingError everywhere (result,
+        # get, iteration) with the original failure chained as the cause
+        if not isinstance(exc, ServingError):
+            wrapped = ServingError(
+                f"request {self.request_id!r} failed: {exc!r}")
+            wrapped.__cause__ = exc
+            exc = wrapped
         self._error = exc
         self._done.set()
         self._q.put(_END)
@@ -152,12 +169,23 @@ class StreamHandle:
         with self._lock:
             return list(self._tokens)
 
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The terminal :class:`ServingError`, if the request failed
+        (readable once ``done``; ``result()``/iteration re-raise it)."""
+        return self._error
+
     def get(self, timeout: Optional[float] = None) -> Optional[int]:
         """Next token, or None once the stream has terminated. Raises
-        ``queue.Empty`` on timeout."""
+        ``queue.Empty`` on timeout and the terminal
+        :class:`ServingError` if the request failed — a consumer
+        blocked on a stream whose engine died is woken and raised at,
+        never left hanging."""
         tok = self._q.get(timeout=timeout)
         if tok is _END:
             self._q.put(_END)            # keep the stream terminated
+            if self._error is not None:
+                raise self._error
             return None
         return tok
 
@@ -261,8 +289,15 @@ class ServingFrontend:
 
     def __init__(self, engine, *, policy: Optional[PriorityDeadlinePolicy]
                  = None, tracer: Optional[SpanTracer] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, fault_hook=None):
         self.engine = engine
+        # fault-injection seam (serving/faults.py): an object with
+        # ``on_pump(frontend)`` (start of every pump iteration — may
+        # raise to kill the pump, or sleep to stall it) and
+        # ``on_submit(frontend, request)`` (may raise ServingError to
+        # reject the submission). First-class so chaos scenarios hook
+        # the real seams instead of monkeypatching; None = no faults.
+        self.fault_hook = fault_hook
         self.policy = policy if policy is not None \
             else PriorityDeadlinePolicy()
         self.clock = clock
@@ -323,6 +358,7 @@ class ServingFrontend:
         self._stop_evt = threading.Event()
         self._work_evt = threading.Event()
         self._failure: Optional[BaseException] = None
+        self._accepting = True           # cleared by shutdown()
 
     # --- ingest -------------------------------------------------------------
 
@@ -342,8 +378,13 @@ class ServingFrontend:
         # tpu-lint: disable=conc-unguarded-shared-field -- benign race
         failure = self._failure
         if failure is not None:
-            raise RuntimeError("frontend pump has failed") from failure
+            raise ServingError("frontend pump has failed") from failure
         self.engine._validate_request(request)
+        if self.fault_hook is not None:
+            # admission-reject faults raise HERE, before any state is
+            # touched — the submitter (or the router's retry path) sees
+            # a clean ServingError and nothing dangles
+            self.fault_hook.on_submit(self, request)
         seq = next(self._submit_seq)
         idx = request_id if request_id is not None else seq
         now = self.clock()
@@ -367,8 +408,10 @@ class ServingFrontend:
             # drain (and is failed with the rest) or raises here — a
             # handle can never be left dangling un-finished
             if self._failure is not None:
-                raise RuntimeError("frontend pump has failed") \
+                raise ServingError("frontend pump has failed") \
                     from self._failure
+            if not self._accepting:
+                raise ServingError("frontend is shutting down")
             self._ingest.append(entry)
             depth = len(self._ingest) + len(self._pending)
             # peak tracking is a read-modify-write; two racing submits
@@ -439,7 +482,43 @@ class ServingFrontend:
         retire/stream/spill — and run admission/preemption. Returns True
         while work remains. Raises ``RuntimeError`` on scheduler
         deadlock (a queued request that cannot be admitted even with
-        every slot vacant and every evictable page evicted)."""
+        every slot vacant and every evictable page evicted).
+
+        Any exception out of the pump — an engine fault, a deadlock, an
+        injected kill — is TERMINAL: the failure is published (later
+        ``submit`` calls raise it) and every live handle fails with a
+        :class:`ServingError` before the exception propagates, so a
+        consumer blocked on ``result()``/iteration is woken within one
+        boundary instead of hanging forever (the pump-death contract;
+        same path for the synchronous and background drivers)."""
+        try:
+            if self.fault_hook is not None:
+                self.fault_hook.on_pump(self)
+            return self._pump_impl()
+        except BaseException as exc:          # noqa: BLE001 — terminal
+            self._fail_all(exc)
+            raise
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Publish the pump's terminal failure and fail every live
+        handle (ingest + pending + active). Idempotent — the first
+        failure wins; the ingest queue is claimed atomically with the
+        publication so ``submit`` can never leave a handle dangling."""
+        with self._ingest_lock:
+            if self._failure is not None:
+                return
+            self._failure = exc
+            victims = list(self._ingest)
+            self._ingest.clear()
+        victims += list(self._pending) + list(self._active.values())
+        self._pending.clear()
+        self._active.clear()
+        self._inflight = None
+        for entry in victims:
+            entry.handle._fail(exc)
+
+    # tpu-lint: host-boundary -- body of pump() (see above)
+    def _pump_impl(self) -> bool:
         eng = self.engine
         t_iter0 = self.clock()
         self._wait_s = 0.0
@@ -515,15 +594,10 @@ class ServingFrontend:
                         self._occ.set(0)
                         self._work_evt.wait(timeout=0.01)
             except BaseException as exc:          # noqa: BLE001
-                with self._ingest_lock:
-                    # publish the failure and claim the ingest queue
-                    # atomically — submit() re-checks under this lock
-                    self._failure = exc
-                    victims = list(self._ingest)
-                    self._ingest.clear()
-                victims += list(self._pending) + list(self._active.values())
-                for entry in victims:
-                    entry.handle._fail(exc)
+                # pump() already published the failure and failed every
+                # live handle; this covers an exception in the loop
+                # bookkeeping itself (idempotent either way)
+                self._fail_all(exc)
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="serving-frontend-pump")
@@ -531,13 +605,113 @@ class ServingFrontend:
 
     def stop(self, timeout: Optional[float] = 30.0) -> None:
         """Stop the background pump thread (in-flight device work is
-        left to complete; pending requests stay queued)."""
+        left to complete; pending requests stay queued). For a clean
+        end-of-life under load — queued + active + mid-stream requests
+        resolved, zero leaked pages, zero dangling threads — use
+        :meth:`shutdown` instead."""
         if self._thread is None:
             return
         self._stop_evt.set()
         self._work_evt.set()
         self._thread.join(timeout)
         self._thread = None
+
+    def _has_work(self) -> bool:
+        return bool(self.queue_depth or self._active or self._inflight)
+
+    def _cancel_live(self) -> None:
+        """Cancel every live handle (ingest snapshot under the lock;
+        pending/active are pump-confined lists — ``list()`` snapshots
+        are safe to iterate from any thread)."""
+        with self._ingest_lock:
+            victims = [e.handle for e in self._ingest]
+        victims += [e.handle for e in list(self._pending)]
+        victims += [e.handle for e in list(self._active.values())]
+        for handle in victims:
+            handle.cancel()
+
+    def shutdown(self, deadline_s: float = 30.0, *,
+                 mode: str = "drain") -> None:
+        """Graceful end-of-life under load: stop accepting (later
+        ``submit`` raises :class:`ServingError`), then resolve every
+        queued + active + mid-stream request deterministically —
+        ``mode="drain"`` finishes them (falling back to cancellation
+        once ``deadline_s`` expires), ``mode="cancel"`` cancels them
+        up front (each stream terminates at its next sync boundary with
+        the already-streamed tokens as its truncated output). Either
+        way every handle reaches ``done``, every non-cached pool page
+        returns to the free stack, and the background thread (if any)
+        is joined — zero dangling threads. A pump failure during the
+        wind-down has already failed the handles; shutdown still stops
+        the thread and returns."""
+        if mode not in ("drain", "cancel"):
+            raise ValueError(f"shutdown mode must be 'drain' or "
+                             f"'cancel', got {mode!r}")
+        with self._ingest_lock:
+            self._accepting = False
+        deadline = self.clock() + deadline_s
+        if mode == "cancel":
+            self._cancel_live()
+        if self._thread is not None:
+            # the background pump drives itself; wait for quiescence,
+            # cancelling the stragglers once the deadline passes
+            cancelled = mode == "cancel"
+            while (self._has_work() and self.pump_alive
+                   and self.failure is None):
+                if self.clock() >= deadline:
+                    if cancelled:
+                        break
+                    self._cancel_live()
+                    cancelled = True
+                    deadline = self.clock() + max(deadline_s, 1.0)
+                time.sleep(0.002)
+            self.stop()
+        # we own the pump now (or always did): drive what remains.
+        # Draining is deadline-bounded; once everything is cancelled the
+        # loop is bounded by a pump budget instead of wall time (cancels
+        # resolve within ~two boundaries, and an injected test clock
+        # never advances), so shutdown always terminates
+        cancelled = mode == "cancel"
+        budget: Optional[int] = None
+        try:
+            while self._has_work():
+                if not cancelled and self.clock() >= deadline:
+                    self._cancel_live()
+                    cancelled = True
+                if cancelled:
+                    if budget is None:
+                        budget = 4 * self.engine.num_slots + 16
+                    budget -= 1
+                    if budget < 0:
+                        break
+                if not self.pump():
+                    break
+        except Exception:                # noqa: BLE001 — handles already
+            pass                         # failed by pump(); stop cleanly
+        leftovers = []
+        with self._ingest_lock:
+            leftovers += list(self._ingest)
+            self._ingest.clear()
+        leftovers += list(self._pending) + list(self._active.items())
+        self._pending.clear()
+        if self._active and self.failure is None:
+            # release the stragglers' pages before failing them — the
+            # zero-leak contract holds even when the deadline expired
+            # with slots still decoding
+            for slot, entry in list(self._active.items()):
+                self._release_pages(slot, entry)
+                self._done = self._done.at[slot].set(True)
+            self._active.clear()
+        exc = ServingError(f"frontend shutdown ({mode}) deadline "
+                           f"expired with requests unresolved")
+        for item in leftovers:
+            entry = item[1] if isinstance(item, tuple) else item
+            entry.handle._fail(exc)
+        self._occ.set(0)
+        self._qdepth.set(0)
+        if self.failure is None:
+            kv_pool.observe_pool(self.engine.cache,
+                                 labels=self.engine.obs_labels)
 
     # --- device chunk dispatch/harvest --------------------------------------
 
@@ -964,6 +1138,14 @@ class ServingFrontend:
 
     # --- run-scoped stats ---------------------------------------------------
 
+    def counter_deltas(self) -> Dict[str, float]:
+        """This frontend's ``serving.*`` counter deltas since
+        construction — the raw numbers ``stats()`` derives its view
+        from, WITHOUT recording anything (safe to poll; the router's
+        aggregate stats read replicas through this)."""
+        return {name: c.value - self._c0[name]
+                for name, c in self._C.items()}
+
     def stats(self) -> dict:
         """The engine-stats dict for this frontend's lifetime so far —
         counter deltas since construction plus run-local latency
@@ -971,7 +1153,7 @@ class ServingFrontend:
         returned, grown by the frontend counters). Records every numeric
         stat as a ``serving.<name>`` raw series — call once per run."""
         eng = self.engine
-        d = {name: c.value - self._c0[name] for name, c in self._C.items()}
+        d = self.counter_deltas()
         with self._ingest_lock:      # peak is written under this lock
             peak_queue_depth = self.peak_queue_depth
         stats = {
@@ -1017,6 +1199,10 @@ class ServingFrontend:
         stats["jit.compiles"] = compiles - self._jit_totals0[0]
         stats["jit.trace_cache_misses"] = \
             trace_misses - self._jit_totals0[1]
+        # storm-many recompiles of one program within this frontend's
+        # lifetime (the preemption-storm scenario pins this at 0: the
+        # resume compile-key set must stay bounded)
+        stats["compile_storms"] = len(self._storm_seen)
         # run-local latency percentiles (the global histograms hold the
         # engine-lifetime distributions; these are exact per run)
         for name, vals in self._per_run.items():

@@ -1,0 +1,900 @@
+"""Data-parallel replica serving: one router over N frontend+engine
+replicas — load balancing, prefix-affinity routing, and failure
+rebalancing.
+
+This is the layer ROADMAP item 3 names (apex's ``apex.parallel`` DDP
+stratum re-expressed for serving): the engine scales *up* with tensor
+parallelism (``serving/tp.py``) and *out* with replication — N
+:class:`~apex_tpu.serving.frontend.ServingFrontend` + engine replicas
+(each optionally TP) behind one :class:`ReplicaRouter` that owns three
+decisions:
+
+- **Load balance** — replicas expose the queue-depth/active-slot
+  signals ``/healthz`` already serves; the router sheds to the
+  least-loaded replica when the preferred one is
+  ``spill_queue_depth`` deeper than the best, and refuses with
+  :class:`OverloadError` (``retry_after_s``) when EVERY live replica
+  exceeds ``shed_queue_depth`` — overload is an explicit, retryable
+  answer, never an unbounded queue.
+- **Prefix affinity** — the request's system-prompt/tenant header (its
+  leading ``affinity_tokens`` prompt tokens, or an explicit
+  ``affinity_key=``) rendezvous-hashes to one replica, so one tenant's
+  traffic lands where its radix cache already holds the header pages:
+  the aggregate prefix hit-rate strictly beats round-robin on
+  multi-tenant workloads (the scenario engine's A/B pins it).
+  Rendezvous (highest-random-weight) hashing makes failure rebalancing
+  minimal: a dead replica's keys spread over the survivors; every other
+  key stays put.
+- **Failure recovery** — a supervisor (the synchronous ``pump()``
+  tick, or a background thread in ``start()`` mode) watches each
+  replica's ``pump_alive``/``failure`` signals. A dead replica is
+  marked unroutable and its in-flight requests re-submit to survivors
+  with capped exponential backoff: the generated-so-far tokens fold
+  into the resume prompt (the PR-6 preemption/resume idea, cross-
+  replica — a survivor whose radix cache holds the prefix re-prefills
+  only the tail; a cold cache pays a full re-prefill; greedy tokens are
+  identical either way), and a request that exhausts ``retry_limit``
+  failovers — or has no survivor left — fails terminally with
+  :class:`~apex_tpu.serving.frontend.ServingError`. **No
+  :class:`RouterHandle` ever hangs**: every submitted request either
+  completes somewhere or raises.
+
+The caller streams from a :class:`RouterHandle` (the same
+:class:`~apex_tpu.serving.frontend.StreamHandle` surface) and never
+learns which replica — or how many — served it; already-streamed tokens
+are never re-delivered across a failover.
+
+Graceful drain is first-class: :meth:`ReplicaRouter.drain_replica`
+takes one replica out of rotation, lets its actives finish inside a
+deadline, then *migrates* the stragglers (cancel-at-boundary + resume
+elsewhere — the planned twin of failover); :meth:`ReplicaRouter.
+shutdown` does the same for the whole router.
+
+Fault injection (``serving/faults.py``) hooks the replicas' frontend
+seams, so every failure mode here — kill, stall, reject, slow consumer
+— is a seeded, replayable ``library.py`` chaos scenario
+(docs/router.md, docs/scenarios.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from apex_tpu.obs.events import EventLog
+from apex_tpu.serving.frontend import ServingError, StreamHandle
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.utils import metrics
+
+__all__ = ["OverloadError", "ReplicaRouter", "RouterHandle",
+           "RouterPolicy"]
+
+#: per-process router ids, the ``router`` label on router instruments
+_ROUTER_IDS = itertools.count()
+
+#: router counters in the instrument registry (``router.<name>``)
+_ROUTER_COUNTERS = ("routed", "failovers", "retries", "shed_requests",
+                    "rejected_submits", "migrations", "replica_deaths")
+
+
+class OverloadError(ServingError):
+    """Every live replica is over the shed bound: the submission is
+    refused, not queued. ``retry_after_s`` is the client's back-off
+    hint (HTTP 429 semantics for the thread-level API)."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+@dataclasses.dataclass
+class RouterPolicy:
+    """The router's knobs, in one swappable object (the
+    ``PriorityDeadlinePolicy`` pattern one layer up).
+
+    ``routing`` — ``"affinity"`` (rendezvous-hash the prefix header,
+    spill on load imbalance) or ``"round_robin"`` (ignore content; the
+    A/B baseline). ``affinity_tokens`` bounds the hashed header.
+    ``spill_queue_depth`` — spill off the affinity target once its
+    queue is this much deeper than the least-loaded live replica's.
+    ``shed_queue_depth`` — refuse (:class:`OverloadError`, with
+    ``retry_after_s``) when every live replica's queue is at least
+    this deep. ``retry_limit`` — failover/reject attempts per request
+    before terminal failure. ``backoff_base_ms``/``backoff_cap_ms`` —
+    capped exponential resubmission backoff (base·2^(attempt-1))."""
+
+    routing: str = "affinity"
+    affinity_tokens: int = 64
+    spill_queue_depth: int = 8
+    shed_queue_depth: int = 64
+    retry_after_s: float = 0.5
+    retry_limit: int = 3
+    backoff_base_ms: float = 5.0
+    backoff_cap_ms: float = 1000.0
+
+    def __post_init__(self):
+        if self.routing not in ("affinity", "round_robin"):
+            raise ValueError(f"routing must be 'affinity' or "
+                             f"'round_robin', got {self.routing!r}")
+        if self.retry_limit < 0 or self.affinity_tokens < 1:
+            raise ValueError("retry_limit >= 0 and affinity_tokens >= 1 "
+                             "required")
+
+
+class RouterHandle(StreamHandle):
+    """The caller's stream across failovers: one queue, tokens in
+    generation order with no re-delivery, ``result()``/iteration
+    raising :class:`ServingError` when recovery is exhausted.
+    ``failovers`` counts the replica deaths this request survived."""
+
+    def __init__(self, request_id):
+        super().__init__(request_id)
+        self.failovers = 0
+
+
+class _Replica:
+    """One frontend+engine replica's routing state (all mutable fields
+    guarded by the router's lock)."""
+
+    __slots__ = ("index", "frontend", "alive", "draining", "started",
+                 "routed", "dead_reason")
+
+    def __init__(self, index, frontend):
+        self.index = index
+        self.frontend = frontend
+        self.alive = True
+        self.draining = False
+        self.started = False
+        self.routed = 0
+        self.dead_reason: Optional[BaseException] = None
+
+
+class _RouterEntry:
+    """One live request's routing state (router-lock guarded)."""
+
+    __slots__ = ("idx", "request", "handle", "affinity", "arrival",
+                 "replica", "sub", "seg_sent", "delivered", "retries",
+                 "not_before", "exclude", "migrate", "done")
+
+    def __init__(self, idx, request, handle, affinity, arrival):
+        self.idx = idx
+        self.request = request
+        self.handle = handle
+        self.affinity = affinity
+        self.arrival = arrival
+        self.replica: Optional[int] = None
+        self.sub: Optional[StreamHandle] = None
+        self.seg_sent = 0                # current segment tokens forwarded
+        self.delivered: List[int] = []   # tokens pushed to the handle
+        self.retries = 0
+        self.not_before = arrival
+        self.exclude: Set[int] = set()   # replicas that just refused it
+        self.migrate = False             # drain-migration in progress
+        self.done = False
+
+
+class _Record:
+    """Per-request postmortem record, kept after completion (the
+    lifecycle/report source; router-lock guarded)."""
+
+    __slots__ = ("idx", "arrival_t", "first_t", "done_t",
+                 "first_replica", "n_tokens", "failovers", "failed")
+
+    def __init__(self, idx, arrival_t):
+        self.idx = idx
+        self.arrival_t = arrival_t
+        self.first_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.first_replica: Optional[int] = None
+        self.n_tokens = 0
+        self.failovers = 0
+        self.failed = False
+
+
+def _rendezvous(key: str, replica: int) -> int:
+    """Highest-random-weight score of (affinity key, replica) —
+    process-independent (hashlib, not ``hash``)."""
+    digest = hashlib.sha256(f"{key}|{replica}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class ReplicaRouter:
+    """N serving replicas behind one submit surface; see the module
+    docstring for the three decisions it owns.
+
+    Drive it synchronously (``pump()`` per boundary / ``drain()`` —
+    deterministic, what the scenario engine and chaos tests use) or
+    start the whole stack (``start()``: every replica's background pump
+    plus one supervisor thread ticking the router). All replicas must
+    share model/tokenizer semantics — the router validates requests
+    against replica 0's engine and treats the replica set as
+    interchangeable."""
+
+    def __init__(self, frontends, *, policy: Optional[RouterPolicy] = None,
+                 clock=time.perf_counter):
+        if not frontends:
+            raise ValueError("need at least one replica frontend")
+        self.policy = policy if policy is not None else RouterPolicy()
+        self.clock = clock
+        self.replicas = [_Replica(i, fe) for i, fe in enumerate(frontends)]
+        self.eos_token_id = frontends[0].engine.eos_token_id
+        self.events = EventLog(capacity=4096)
+        self._lock = threading.Lock()
+        self._entries: Dict[object, _RouterEntry] = {}
+        self._queued: List[_RouterEntry] = []
+        self._records: Dict[object, _Record] = {}
+        self._accepting = True
+        self._seq = itertools.count()
+        self._rr_next = 0
+        self._sup_thread: Optional[threading.Thread] = None
+        self._sup_stop_evt = threading.Event()
+        labels = {"router": str(next(_ROUTER_IDS))}
+        self.obs_labels = labels
+        self._C = {name: metrics.counter(f"router.{name}", labels=labels)
+                   for name in _ROUTER_COUNTERS}
+        self._c0 = {name: c.value for name, c in self._C.items()}
+        self._alive_gauge = metrics.gauge("router.replicas_alive",
+                                          labels=labels)
+        self._depth_gauges = {
+            rep.index: metrics.gauge(
+                "router.replica_queue_depth",
+                labels={**labels, "replica": str(rep.index)})
+            for rep in self.replicas}
+        self._alive_gauge.set(len(self.replicas))
+
+    # --- ingest -------------------------------------------------------------
+
+    def _affinity_key(self, request: Request) -> str:
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        head = prompt[:self.policy.affinity_tokens]
+        return hashlib.sha256(head.tobytes()).hexdigest()
+
+    def submit(self, request: Request, *, request_id=None,
+               affinity_key: Optional[str] = None) -> RouterHandle:
+        """Route one request; returns its cross-replica streaming handle
+        immediately. Thread-safe. Raises ``ValueError`` on a request no
+        engine could serve, :class:`OverloadError` when every live
+        replica is over the shed bound, and :class:`ServingError` when
+        the router is draining or no replica is alive. ``affinity_key``
+        overrides the hashed prompt header (e.g. a tenant id)."""
+        self.replicas[0].frontend.engine._validate_request(request)
+        now = self.clock()
+        with self._lock:
+            if not self._accepting:
+                raise ServingError("router is draining")
+            live = [rep for rep in self.replicas
+                    if rep.alive and not rep.draining]
+            if not live:
+                raise ServingError("no live replicas")
+            if all(rep.frontend.queue_depth >= self.policy.shed_queue_depth
+                   for rep in live):
+                self._C["shed_requests"].inc()
+                self.events.emit("shed",
+                                 queue_depths=[rep.frontend.queue_depth
+                                               for rep in live])
+                raise OverloadError(
+                    f"all {len(live)} live replicas at or over the shed "
+                    f"bound ({self.policy.shed_queue_depth} queued)",
+                    retry_after_s=self.policy.retry_after_s)
+            idx = request_id if request_id is not None else next(self._seq)
+            if idx in self._records:
+                raise ValueError(f"duplicate request_id {idx!r}")
+            handle = RouterHandle(idx)
+            key = affinity_key if affinity_key is not None \
+                else self._affinity_key(request)
+            entry = _RouterEntry(idx, request, handle, key, now)
+            self._entries[idx] = entry
+            self._records[idx] = _Record(idx, now)
+        self._place(entry, now)
+        return handle
+
+    # --- routing ------------------------------------------------------------
+
+    def _pick_locked(self, entry: _RouterEntry) -> Optional[_Replica]:
+        live = [rep for rep in self.replicas
+                if rep.alive and not rep.draining]
+        if not live:
+            return None
+        candidates = [rep for rep in live
+                      if rep.index not in entry.exclude]
+        if not candidates:
+            # everything has refused it once — retry anywhere rather
+            # than starve (the retry_limit bounds the total attempts)
+            entry.exclude.clear()
+            candidates = live
+        if self.policy.routing == "round_robin":
+            rep = candidates[self._rr_next % len(candidates)]
+            self._rr_next += 1
+            return rep
+        ranked = sorted(candidates,
+                        key=lambda r: _rendezvous(entry.affinity, r.index),
+                        reverse=True)
+        preferred = ranked[0]
+        depths = {rep.index: rep.frontend.queue_depth
+                  for rep in candidates}
+        least = min(candidates, key=lambda r: (depths[r.index], r.index))
+        if (depths[preferred.index] - depths[least.index]
+                > self.policy.spill_queue_depth):
+            return least                 # load beats affinity
+        return preferred
+
+    def _resume_request(self, entry: _RouterEntry) -> Request:
+        """The re-submission after a failover/migration: generated
+        tokens fold into the prompt (the preemption-resume idea, cross-
+        replica — a survivor with the prefix cached re-prefills only
+        the tail, a cold one re-prefills everything; greedy tokens are
+        identical either way), the budget shrinks by what was already
+        delivered. The TTFT deadline is not re-armed — first token was
+        already delivered or the miss already counted — but the TPOT
+        SLO survives: it is a per-token target, and the resumed
+        segment (the one a failover just slowed down) must keep
+        counting against it."""
+        base = self.request_prompt(entry)
+        return Request(
+            prompt=np.concatenate(
+                [base, np.asarray(entry.delivered, np.int32)]),
+            max_new_tokens=entry.request.max_new_tokens
+            - len(entry.delivered),
+            priority=entry.request.priority,
+            arrival_time=entry.arrival,
+            tpot_slo_ms=entry.request.tpot_slo_ms)
+
+    @staticmethod
+    def request_prompt(entry) -> np.ndarray:
+        return np.asarray(entry.request.prompt, np.int32).reshape(-1)
+
+    def _place(self, entry: _RouterEntry, now: float) -> None:
+        """Try to submit ``entry`` to a replica. The replica pick and
+        all entry bookkeeping run under the router lock; the frontend
+        ``submit`` call itself runs OUTSIDE it (it takes the replica's
+        own ingest lock and does tracer/event work — holding the router
+        lock across it would serialize routing behind replica ingest).
+        On refusal the entry re-queues with backoff; with retries
+        exhausted or no live replica it fails terminally."""
+        with self._lock:
+            if entry.done:
+                return
+            rep = self._pick_locked(entry)
+            if rep is None:
+                self._fail_entry_locked(
+                    entry, ServingError(
+                        f"request {entry.idx!r}: no live replicas to "
+                        f"place it on"))
+                return
+            req = entry.request if not entry.delivered \
+                else self._resume_request(entry)
+        try:
+            sub = rep.frontend.submit(req, request_id=entry.idx)
+        except ServingError as exc:
+            # refused (fault-injected reject, replica racing to death,
+            # replica draining): exclude it this round and back off
+            with self._lock:
+                if entry.done:
+                    return
+                entry.exclude.add(rep.index)
+                self._C["rejected_submits"].inc()
+                self.events.emit("reject", request=entry.idx,
+                                 replica=rep.index, error=repr(exc))
+                entry.retries += 1
+                if entry.retries > self.policy.retry_limit:
+                    self._fail_entry_locked(entry, ServingError(
+                        f"request {entry.idx!r} failed after "
+                        f"{entry.retries} placement attempts"),
+                        cause=exc)
+                    return
+                entry.not_before = now + self._backoff_s(entry.retries)
+                self._queued.append(entry)
+            return
+        with self._lock:
+            if entry.done:
+                # terminally failed while we were inside the replica's
+                # submit (supervision crash, shutdown leftovers): don't
+                # install the sub — cancel it so the replica retires
+                # the orphan at its next boundary instead of decoding
+                # a request nobody will ever read
+                sub.cancel()
+                return
+            entry.replica = rep.index
+            entry.sub = sub
+            entry.seg_sent = 0
+            entry.exclude.clear()
+            rep.routed += 1
+            self._C["routed"].inc()
+            self.events.emit("route", request=entry.idx,
+                             replica=rep.index,
+                             resumed_at=len(entry.delivered))
+
+    def _backoff_s(self, attempt: int) -> float:
+        p = self.policy
+        return min(p.backoff_base_ms * 2.0 ** max(attempt - 1, 0),
+                   p.backoff_cap_ms) * 1e-3
+
+    # --- the supervisor tick ------------------------------------------------
+
+    def pump(self) -> bool:
+        """One synchronous router iteration: pump every live replica's
+        frontend one boundary, then run the supervision tick (failure
+        detection, token forwarding, failover resubmission, routing of
+        backoff-expired requests). Returns True while work remains.
+        Only for routers that were NOT ``start()``-ed — the background
+        supervisor owns the tick there."""
+        with self._lock:
+            if self._sup_thread is not None:
+                raise RuntimeError(
+                    "router is running its background supervisor; "
+                    "pump() is the synchronous driver")
+            live = [rep.frontend for rep in self.replicas if rep.alive]
+        for fe in live:
+            try:
+                fe.pump()
+            except Exception:            # noqa: BLE001 — recorded as
+                pass                     # fe.failure; the tick migrates
+        self._tick()
+        with self._lock:
+            return bool(self._entries)
+
+    # tpu-lint: host-boundary -- drives the replica pumps (host loop)
+    def drain(self) -> None:
+        """Pump until every submitted request has resolved (completed,
+        migrated-and-completed, or terminally failed)."""
+        while self.pump():
+            pass
+
+    def _tick(self) -> None:
+        """The supervision pass — shared by ``pump()`` and the
+        background supervisor thread. Any exception escaping it is
+        TERMINAL for the router (the frontend pump's contract, one
+        layer up): every outstanding handle fails with a
+        :class:`ServingError` before the exception propagates, so a
+        supervisor crash can never strand a consumer — the no-hung-
+        handles guarantee survives bugs in the tick itself."""
+        try:
+            self._tick_impl()
+        except Exception as exc:         # noqa: BLE001 — terminal
+            err = ServingError(f"router supervision failed: {exc!r}")
+            err.__cause__ = exc
+            self.events.emit("supervisor_failed", error=repr(exc))
+            with self._lock:
+                for entry in list(self._entries.values()):
+                    self._fail_entry_locked(entry, err)
+                self._queued.clear()
+            raise
+
+    def _tick_impl(self) -> None:
+        to_stop = []
+        with self._lock:
+            for rep in self.replicas:
+                if rep.alive and rep.frontend.failure is not None:
+                    self._mark_dead_locked(rep)
+                    if rep.started:
+                        to_stop.append(rep.frontend)
+            entries = list(self._entries.values())
+        for fe in to_stop:
+            fe.stop()
+        for entry in entries:
+            with self._lock:
+                delay = self._consume_delay_locked(entry)
+            if delay:
+                time.sleep(delay)        # the slow-consumer fault
+            with self._lock:
+                self._service_locked(entry, self.clock())
+        self._route_due(self.clock())
+        with self._lock:
+            n_alive = sum(1 for rep in self.replicas if rep.alive)
+            self._alive_gauge.set(n_alive)
+            for rep in self.replicas:
+                self._depth_gauges[rep.index].set(
+                    rep.frontend.queue_depth if rep.alive else 0)
+
+    def _consume_delay_locked(self, entry: _RouterEntry) -> float:
+        if entry.done or entry.replica is None:
+            return 0.0
+        hook = self.replicas[entry.replica].frontend.fault_hook
+        if hook is None:
+            return 0.0
+        return hook.consume_delay_s(entry.idx)
+
+    def _mark_dead_locked(self, rep: _Replica) -> None:
+        rep.alive = False
+        rep.dead_reason = rep.frontend.failure
+        self._C["replica_deaths"].inc()
+        self.events.emit("replica_dead", replica=rep.index,
+                         error=repr(rep.dead_reason))
+
+    def _forward_locked(self, entry: _RouterEntry, sub, now: float) -> None:
+        toks = sub.tokens_so_far()
+        new = toks[entry.seg_sent:]
+        if new:
+            rec = self._records[entry.idx]
+            if rec.first_t is None:
+                rec.first_t = now
+                rec.first_replica = entry.replica
+            for t in new:
+                entry.delivered.append(t)
+                entry.handle._push(t)
+            rec.n_tokens = len(entry.delivered)
+            entry.seg_sent = len(toks)
+
+    def _service_locked(self, entry: _RouterEntry, now: float) -> None:
+        """Forward new tokens, detect terminal sub states, fail over."""
+        if entry.done:
+            return
+        sub = entry.sub
+        if sub is None:
+            return                       # queued — _route_due's business
+        if entry.handle.cancelled and not sub.cancelled:
+            sub.cancel()
+        self._forward_locked(entry, sub, now)
+        if not sub.done:
+            return
+        # re-read AFTER observing done: with background replica pumps,
+        # tokens pushed between the snapshot above and the replica's
+        # _finish/_fail would otherwise be dropped from the delivered
+        # record right as we finalize (the handle orders every push
+        # before its done flag, so this second read is complete)
+        self._forward_locked(entry, sub, now)
+        if sub.error is not None:        # the replica died under it
+            self._failover_locked(entry, sub.error, now)
+            return
+        if entry.migrate and not entry.handle.cancelled \
+                and not self._complete(entry):
+            # drain migration: the replica cancelled it at a boundary;
+            # resume the remainder elsewhere (tokens preserved)
+            entry.migrate = False
+            entry.sub = None
+            entry.replica = None
+            self._C["migrations"].inc()
+            self.events.emit("migrate", request=entry.idx,
+                             delivered=len(entry.delivered))
+            entry.not_before = now
+            self._queued.append(entry)
+            return
+        self._finish_locked(entry)
+
+    def _complete(self, entry: _RouterEntry) -> bool:
+        if len(entry.delivered) >= entry.request.max_new_tokens:
+            return True
+        eos = self.eos_token_id
+        return (eos is not None and entry.delivered
+                and entry.delivered[-1] == eos)
+
+    def _finish_locked(self, entry: _RouterEntry) -> None:
+        entry.done = True
+        self._entries.pop(entry.idx, None)
+        rec = self._records[entry.idx]
+        rec.done_t = self.clock()
+        rec.n_tokens = len(entry.delivered)
+        rec.failovers = entry.handle.failovers
+        entry.handle._finish(np.asarray(entry.delivered, np.int32))
+
+    def _fail_entry_locked(self, entry: _RouterEntry,
+                           exc: ServingError, *, cause=None) -> None:
+        if cause is not None:
+            exc.__cause__ = cause
+        entry.done = True
+        self._entries.pop(entry.idx, None)
+        rec = self._records[entry.idx]
+        rec.done_t = self.clock()
+        rec.failovers = entry.handle.failovers
+        rec.failed = True
+        self.events.emit("request_failed", request=entry.idx,
+                         error=str(exc))
+        entry.handle._fail(exc)
+
+    def _failover_locked(self, entry: _RouterEntry,
+                         error: BaseException, now: float) -> None:
+        """The dead replica's handle failed terminally; re-home the
+        request on a survivor with capped exponential backoff, or fail
+        it after ``retry_limit`` attempts."""
+        dead = entry.replica
+        entry.sub = None
+        entry.replica = None
+        entry.handle.failovers += 1
+        entry.retries += 1
+        self._C["failovers"].inc()
+        self._C["retries"].inc()
+        self.events.emit("failover", request=entry.idx, replica=dead,
+                         delivered=len(entry.delivered),
+                         attempt=entry.retries)
+        if entry.handle.cancelled or self._complete(entry):
+            # nothing left to recover — the stream already has its
+            # tokens (cancel truncates; a complete request just ends)
+            self._finish_locked(entry)
+            return
+        if entry.retries > self.policy.retry_limit:
+            self._fail_entry_locked(entry, ServingError(
+                f"request {entry.idx!r} failed after {entry.retries} "
+                f"failover attempts"), cause=error)
+            return
+        entry.not_before = now + self._backoff_s(entry.retries)
+        self._queued.append(entry)
+
+    def _route_due(self, now: float) -> None:
+        """Place queued entries whose backoff expired (each placement
+        re-queues itself on failure); cancelled waiters finish with
+        their delivered tokens."""
+        due: List[_RouterEntry] = []
+        with self._lock:
+            still: List[_RouterEntry] = []
+            for entry in self._queued:
+                if entry.done:
+                    continue
+                if entry.handle.cancelled:
+                    self._finish_locked(entry)
+                    continue
+                if now < entry.not_before:
+                    still.append(entry)
+                    continue
+                due.append(entry)
+            self._queued[:] = still
+        for entry in due:
+            self._place(entry, now)
+
+    # --- background mode ----------------------------------------------------
+
+    def start(self, supervise_interval_s: float = 0.002) -> None:
+        """Start every replica's background pump and the router's
+        supervisor thread (failure watch + forwarding at
+        ``supervise_interval_s``)."""
+        with self._lock:
+            if self._sup_thread is not None:
+                raise RuntimeError("router already started")
+            reps = list(self.replicas)
+        for rep in reps:
+            rep.frontend.start()
+        with self._lock:
+            for rep in reps:
+                rep.started = True
+        self._sup_stop_evt.clear()
+
+        def supervise():
+            while not self._sup_stop_evt.is_set():
+                self._tick()
+                self._sup_stop_evt.wait(supervise_interval_s)
+
+        thread = threading.Thread(target=supervise, daemon=True,
+                                  name="serving-router-supervisor")
+        with self._lock:
+            self._sup_thread = thread
+        thread.start()
+
+    def stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop the supervisor and every replica pump (in-flight work is
+        left as-is; use :meth:`shutdown` for a clean end-of-life)."""
+        with self._lock:
+            thread, self._sup_thread = self._sup_thread, None
+            reps = [rep for rep in self.replicas if rep.started]
+            for rep in reps:
+                rep.started = False
+        self._sup_stop_evt.set()
+        if thread is not None:
+            thread.join(timeout)
+        for rep in reps:
+            rep.frontend.stop()
+
+    # --- graceful drain -----------------------------------------------------
+
+    def _cancel_all_locked(self) -> None:
+        for entry in self._entries.values():
+            entry.handle.cancel()
+
+    def shutdown(self, deadline_s: float = 30.0, *,
+                 mode: str = "drain") -> None:
+        """Router-wide graceful drain: stop accepting, resolve every
+        in-flight request (finishing under ``mode="drain"``, cancelling
+        under ``mode="cancel"`` or once the deadline expires), then
+        stop the supervisor and shut every replica frontend down. Every
+        handle reaches ``done``; unresolvable stragglers fail with
+        :class:`ServingError`."""
+        if mode not in ("drain", "cancel"):
+            raise ValueError(f"shutdown mode must be 'drain' or "
+                             f"'cancel', got {mode!r}")
+        with self._lock:
+            self._accepting = False
+            threaded = self._sup_thread is not None
+            if mode == "cancel":
+                self._cancel_all_locked()
+        deadline = self.clock() + deadline_s
+        cancelled = mode == "cancel"
+        budget: Optional[int] = None
+        while True:
+            with self._lock:
+                work = bool(self._entries)
+            if not work:
+                break
+            if not cancelled and self.clock() >= deadline:
+                with self._lock:
+                    self._cancel_all_locked()
+                cancelled = True
+                deadline = self.clock() + max(deadline_s, 2.0)
+            if cancelled:
+                if budget is None:
+                    budget = 64 * len(self.replicas) + 64
+                budget -= 1
+                if budget < 0 or self.clock() >= deadline:
+                    break
+            if threaded:
+                time.sleep(0.002)
+            else:
+                self.pump()
+        self.stop()
+        with self._lock:
+            leftovers = list(self._entries.values())
+            for entry in leftovers:
+                self._fail_entry_locked(entry, ServingError(
+                    f"router shutdown ({mode}) deadline expired"))
+            self._queued.clear()
+        for rep in self.replicas:
+            # replicas get their own clean end-of-life (releases any
+            # straggler pages; a failed replica skips straight through)
+            rep.frontend.shutdown(deadline_s=2.0, mode="cancel")
+
+    def drain_replica(self, index: int, deadline_s: float = 10.0, *,
+                      migrate: bool = False) -> None:
+        """Take one replica out of rotation: no new routes land on it,
+        its active requests finish inside ``deadline_s`` — or are
+        MIGRATED (cancelled at a sync boundary and resumed on a
+        survivor, tokens preserved) once the deadline passes, or
+        immediately with ``migrate=True``. The replica ends not-alive
+        (out of the live set) with its pump stopped."""
+        with self._lock:
+            rep = self.replicas[index]
+            if not rep.alive:
+                return
+            rep.draining = True
+            self.events.emit("replica_drain", replica=index)
+            threaded = self._sup_thread is not None
+        deadline = self.clock() if migrate else self.clock() + deadline_s
+        migrated = False
+        budget: Optional[int] = None
+        while True:
+            with self._lock:
+                mine = [e for e in self._entries.values()
+                        if e.replica == index]
+                if not mine:
+                    break
+                if not migrated and self.clock() >= deadline:
+                    for entry in mine:
+                        if entry.sub is not None:
+                            entry.migrate = True
+                            entry.sub.cancel()
+                    migrated = True
+            if migrated:
+                if budget is None:
+                    budget = 64 * len(self.replicas) + 64
+                budget -= 1
+                if budget < 0:
+                    break
+            if threaded:
+                time.sleep(0.002)
+            else:
+                self.pump()
+        stop_it = False
+        with self._lock:
+            rep.draining = False
+            rep.alive = False
+            stop_it = rep.started
+            rep.started = False
+            self.events.emit("replica_drained", replica=index)
+        if stop_it:
+            rep.frontend.stop()
+
+    # --- report adapters (the scenario engine's tracer surface) -------------
+
+    def lifecycle(self, request_id) -> Dict[str, object]:
+        """Cross-replica lifecycle summary (the report builder's
+        contract): TTFT/TPOT from the router's own forwarding
+        timestamps — correct across failovers, where no single
+        replica's tracer sees the whole request — plus queue-wait from
+        the first serving replica's tracer when it survives."""
+        with self._lock:
+            rec = self._records.get(request_id)
+            if rec is None:
+                return {"request_id": request_id}
+            arrival, first_t = rec.arrival_t, rec.first_t
+            done_t, n = rec.done_t, rec.n_tokens
+            first_replica = rec.first_replica
+        out: Dict[str, object] = {"request_id": request_id}
+        if first_t is not None:
+            out["ttft_ms"] = (first_t - arrival) * 1e3
+        if done_t is not None and first_t is not None and n > 1:
+            out["tpot_ms"] = (done_t - first_t) * 1e3 / (n - 1)
+        if n:
+            out["new_tokens"] = n
+        if first_replica is not None:
+            sub_life = self.replicas[first_replica].frontend.tracer \
+                .lifecycle(request_id)
+            if "queue_wait_ms" in sub_life:
+                out["queue_wait_ms"] = sub_life["queue_wait_ms"]
+        return out
+
+    def spans(self, request_id) -> list:
+        """Every replica tracer's spans for ``request_id``, in replica
+        order (deadline-miss instants survive the replica)."""
+        out = []
+        for rep in self.replicas:
+            out.extend(rep.frontend.tracer.spans(request_id))
+        return out
+
+    # --- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Router-lifetime stats: routing/failover counters, recovery
+        rate, and the replica-aggregated engine counters the scenario
+        report embeds. ``failover_recovered_rate`` is the fraction of
+        failover-surviving requests that completed (1.0 when nothing
+        ever failed over — vacuous recovery is still recovery)."""
+        with self._lock:
+            d = {name: c.value - self._c0[name]
+                 for name, c in self._C.items()}
+            reps = [(rep.index, rep.alive, rep.routed, rep.frontend)
+                    for rep in self.replicas]
+            recs = list(self._records.values())
+        per_replica = []
+        agg: Dict[str, float] = {}
+        for index, alive, routed, fe in reps:
+            fd = fe.counter_deltas()
+            per_replica.append({
+                "replica": index, "alive": alive, "routed": routed,
+                "admitted": int(fd["admitted"]),
+                "retired": int(fd["retired"]),
+                "prefix_hits": int(fd["prefix_hits"]),
+                "preemptions": int(fd["preemptions"]),
+                "queue_depth": fe.queue_depth if alive else 0,
+            })
+            for name, val in fd.items():
+                agg[name] = agg.get(name, 0.0) + val
+        failover_reqs = [r for r in recs if r.failovers > 0]
+        recovered = [r for r in failover_reqs
+                     if r.done_t is not None and not r.failed]
+        stats = {
+            "replicas": len(reps),
+            "replicas_alive": sum(1 for _, alive, _, _ in reps if alive),
+            "requests": len(recs),
+            "completed": sum(1 for r in recs
+                             if r.done_t is not None and not r.failed),
+            "failed": sum(1 for r in recs if r.failed),
+            "routed": int(d["routed"]),
+            "failovers": int(d["failovers"]),
+            "retries": int(d["retries"]),
+            "shed_requests": int(d["shed_requests"]),
+            "rejected_submits": int(d["rejected_submits"]),
+            "migrations": int(d["migrations"]),
+            "replica_deaths": int(d["replica_deaths"]),
+            "failover_requests": len(failover_reqs),
+            "failover_recovered": len(recovered),
+            "failover_recovered_rate":
+                len(recovered) / len(failover_reqs)
+                if failover_reqs else 1.0,
+            # replica-aggregated engine counters (the report's fields)
+            "admitted": int(agg.get("admitted", 0)),
+            "retired": int(agg.get("retired", 0)),
+            "preemptions": int(agg.get("preemptions", 0)),
+            "resumes": int(agg.get("resumes", 0)),
+            "deadline_misses": int(agg.get("deadline_misses", 0)),
+            "tpot_slo_misses": int(agg.get("tpot_slo_misses", 0)),
+            "evicted_pages": int(agg.get("evicted_pages", 0)),
+            "window_dropped_pages": int(agg.get("window_dropped_pages",
+                                                0)),
+            "prefix_hits": int(agg.get("prefix_hits", 0)),
+            "prefix_hit_rate": (agg.get("prefix_hits", 0)
+                                / max(agg.get("admitted", 0), 1)),
+            "prefill_tokens_total": int(agg.get("prefill_tokens_total",
+                                                0)),
+            "prefill_tokens_computed":
+                int(agg.get("prefill_tokens_computed", 0)),
+            "prefill_tokens_skipped":
+                int(agg.get("prefill_tokens_total", 0)
+                    - agg.get("prefill_tokens_computed", 0)),
+            "per_replica": per_replica,
+        }
+        for name, val in stats.items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                metrics.record(f"router.{name}", val)
+        return stats

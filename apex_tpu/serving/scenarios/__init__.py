@@ -19,7 +19,11 @@ makes workloads DECLARATIVE, SEEDED, and REPLAYABLE:
 - :mod:`library` — the named catalog (``steady-poisson``,
   ``burst-storm``, ``long-tail-lengths``,
   ``multi-tenant-shared-prefix``, ``eviction-churn``,
-  ``priority-flood``, ``windowed-llama``, and the two bench workloads).
+  ``priority-flood``, ``windowed-llama``, the two bench workloads, the
+  ``preemption-storm`` adversary, and the replicated-serving tier:
+  ``chaos-replica-kill`` / ``chaos-pump-stall`` (seeded fault injection
+  through ``serving/faults.py``) and ``router-affinity-ab`` (the
+  affinity-vs-round-robin hit-rate A/B over ``serving/router.py``)).
 
 CLI: ``python -m apex_tpu.serving.scenarios --list`` /
 ``--scenario NAME [--scenario NAME ...] --json OUT --seed N [--check]``
@@ -39,6 +43,7 @@ from apex_tpu.serving.scenarios.library import (  # noqa: F401
 from apex_tpu.serving.scenarios.report import (  # noqa: F401
     AGGREGATE_FIELDS,
     REPORT_SCHEMA,
+    ROUTER_FIELDS,
     SCENARIOS_SCHEMA,
     TENANT_FIELDS,
     validate_report,

@@ -37,6 +37,21 @@ The catalog (docs/scenarios.md has the prose):
 - ``bench-mixed-length`` / ``bench-shared-prefix`` — the decode bench's
   two original workloads, now defined here (``tpu_decode_bench.py``
   materializes these instead of carrying inline generators).
+- ``preemption-storm`` — the ROADMAP-5 adversary: a rapid
+  high-priority deadline stream over one slot forces repeated
+  preempt/resume cycles on a long-running bulk request; the recompile
+  watcher pins the resume compile-key set (no ``compile_storm`` event,
+  bounded ``jit.compiles``).
+- ``chaos-replica-kill`` — replicated serving (``serving/router.py``)
+  with a seeded mid-decode replica kill (``serving/faults.py``): every
+  in-flight request must re-home to the survivor token-identically
+  (the greedy-identity amplifier proves recovery corrupts nothing).
+- ``chaos-pump-stall`` — a wedged-but-alive replica (injected pump
+  stalls): latency, not death — nothing may hang, fail over, or leak.
+- ``router-affinity-ab`` — the multi-tenant workload over 2 replicas,
+  replayed under affinity routing AND round-robin on the same trace:
+  the aggregate prefix hit-rate delta is the banked proof affinity
+  routing earns its keep.
 """
 
 from __future__ import annotations
@@ -44,6 +59,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, List
 
+from apex_tpu.serving.faults import FaultSpec
 from apex_tpu.serving.scenarios.runner import EngineSpec, ScenarioSpec
 from apex_tpu.serving.scenarios.tenants import Tenant, churn_tenants
 from apex_tpu.serving.scenarios.traces import Arrival, Lengths
@@ -216,6 +232,107 @@ def _windowed_llama(seed: int) -> ScenarioSpec:
                           prefix_cache=False),
         description="sliding-window Llama on the paged path: "
                     "generations past the window drop dead pages")
+
+
+@register("preemption-storm")
+def _preemption_storm(seed: int) -> ScenarioSpec:
+    ps = 16
+    # ONE slot, a long-running bulk stream, and a rapid deadline-armed
+    # urgent stream: every urgent arrival preempts the bulk victim,
+    # which resumes (spill -> cache-hit re-admission) when the urgent
+    # request retires — many preempt/resume cycles per replay. The
+    # page size is deliberately LARGE and the urgent bursts short, so
+    # the victim's written length crosses few page boundaries and the
+    # resume compile-key set (t_start values) stays small — the
+    # recompile-watcher pin (no compile_storm, bounded jit.compiles)
+    # binds exactly that design rule (docs/frontend.md Limits).
+    # arrivals are PACED against the tiny model's CPU decode step
+    # (~5-15 ms): a bulk long-runner must actually be decoding when the
+    # next urgent request lands, or priority ordering alone would serve
+    # the queue and nothing would ever preempt
+    return ScenarioSpec(
+        name="preemption-storm", seed=seed, n_requests=16,
+        arrival=Arrival(kind="poisson", rate_rps=5.0),
+        prompt_lens=Lengths(kind="uniform", lo=8, hi=14),
+        output_lens=Lengths(kind="uniform", lo=24, hi=32),
+        tenants=(
+            Tenant("bulk", weight=1.0, output_tokens=40),
+            Tenant("urgent", weight=2.0, priority=5,
+                   deadline_ms=10000.0, output_tokens=2),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=1, page_size=ps,
+                          prefix_cache=True, preempt_on_priority=True),
+        description="repeated preempt/resume cycles on one slot; the "
+                    "resume compile-key set must stay bounded")
+
+
+@register("chaos-replica-kill")
+def _chaos_replica_kill(seed: int) -> ScenarioSpec:
+    ps = 8
+    # 2 replicas, one killed mid-decode at its 3rd pump iteration:
+    # every request it held (active, pending, mid-stream) must re-home
+    # to the survivor with its generated-so-far tokens folded into the
+    # resume prompt — greedy outputs identical to an unfailed run (the
+    # check amplifier), zero hung handles, zero leaked pages
+    return ScenarioSpec(
+        name="chaos-replica-kill", seed=seed, n_requests=12,
+        arrival=Arrival(kind="poisson", rate_rps=600.0),
+        prompt_lens=Lengths(kind="uniform", lo=6, hi=20),
+        output_lens=Lengths(kind="uniform", lo=6, hi=12),
+        tenants=(
+            Tenant("alpha", weight=1.0, system_prompt_tokens=2 * ps),
+            Tenant("beta", weight=1.0, system_prompt_tokens=2 * ps),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=ps,
+                          prefix_cache=True, replicas=2),
+        faults=(FaultSpec(kind="kill_replica", replica=0, at=3),),
+        description="seeded mid-decode replica kill: recovery must be "
+                    "token-exact on the survivor")
+
+
+@register("chaos-pump-stall")
+def _chaos_pump_stall(seed: int) -> ScenarioSpec:
+    # a wedged-but-alive replica: the pump sleeps 20 ms for 4
+    # iterations — pure latency; nothing may die, fail over, or leak
+    return ScenarioSpec(
+        name="chaos-pump-stall", seed=seed, n_requests=10,
+        arrival=Arrival(kind="poisson", rate_rps=600.0),
+        prompt_lens=Lengths(kind="uniform", lo=6, hi=16),
+        output_lens=Lengths(kind="uniform", lo=4, hi=8),
+        tenants=(Tenant("default"),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=8,
+                          prefix_cache=False, replicas=2),
+        faults=(FaultSpec(kind="pump_stall", replica=1, at=2, count=4,
+                          delay_ms=20.0),),
+        description="injected pump stalls on one replica: latency, "
+                    "not death")
+
+
+@register("router-affinity-ab")
+def _router_affinity_ab(seed: int) -> ScenarioSpec:
+    ps = 8
+    # the multi-tenant radix-cache workload over TWO replicas, banked
+    # both ways: affinity routing (tenant header -> one replica, its
+    # cache warm) vs round-robin (headers smeared over both caches).
+    # The aggregate hit-rate delta is the ledger-banked proof
+    return ScenarioSpec(
+        name="router-affinity-ab", seed=seed, n_requests=24,
+        arrival=Arrival(kind="poisson", rate_rps=500.0),
+        prompt_lens=Lengths(kind="lognormal", mean=10.0, sigma=0.5,
+                            lo=2, hi=24),
+        output_lens=Lengths(kind="uniform", lo=4, hi=8),
+        tenants=(
+            Tenant("free", weight=1.0, system_prompt_tokens=2 * ps),
+            Tenant("pro", weight=1.0, system_prompt_tokens=4 * ps,
+                   priority=2),
+            Tenant("batch", weight=1.0, system_prompt_tokens=3 * ps),
+            Tenant("edge", weight=1.0, system_prompt_tokens=2 * ps),
+        ),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=ps,
+                          prefix_cache=True, replicas=2,
+                          compare_round_robin=True),
+        description="affinity vs round-robin hit-rate A/B over 2 "
+                    "replicas, same trace")
 
 
 @register("bench-mixed-length")

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["REPORT_SCHEMA", "SCENARIOS_SCHEMA", "AGGREGATE_FIELDS",
-           "TENANT_FIELDS", "build_report", "validate_report"]
+           "TENANT_FIELDS", "ROUTER_FIELDS", "build_report",
+           "validate_report"]
 
 REPORT_SCHEMA = "apex-tpu/scenario-report/v1"
 #: the multi-scenario CLI document wrapping one report per scenario
@@ -46,6 +47,16 @@ TENANT_FIELDS = (
     "ttft_ms_p50", "ttft_ms_p95", "tpot_ms_p50", "tpot_ms_p95",
     "queue_wait_ms_p50", "queue_wait_ms_p95",
     "deadline_requests", "deadline_misses", "deadline_miss_rate",
+)
+
+#: pinned ``router`` block keys (present on replicated scenarios only;
+#: the A/B keys ``round_robin_hit_rate``/``affinity_delta_hit_rate``
+#: appear additionally under ``compare_round_robin``)
+ROUTER_FIELDS = (
+    "replicas", "replicas_alive", "routing",
+    "failovers", "failover_requests", "failover_recovered",
+    "failover_recovered_rate", "shed_requests", "migrations",
+    "replica_deaths", "affinity_hit_rate",
 )
 
 
@@ -75,8 +86,13 @@ def _latency_block(lifes: List[dict], missed: Dict[int, bool],
 
 
 def build_report(spec, trace, outputs, stats: dict, tracer,
-                 wall_s: float, checks: Optional[dict] = None) -> dict:
-    """Assemble the pinned-schema report for one replayed scenario."""
+                 wall_s: float, checks: Optional[dict] = None,
+                 router: Optional[dict] = None) -> dict:
+    """Assemble the pinned-schema report for one replayed scenario.
+    ``router`` is the replicated-scenario block (``ROUTER_FIELDS``) —
+    failover/recovery facts and the affinity A/B; ``tracer`` may be the
+    router's cross-replica lifecycle adapter (same ``lifecycle``/
+    ``spans`` surface as a :class:`~apex_tpu.obs.spans.SpanTracer`)."""
     events = trace.events
     lifes = [tracer.lifecycle(e.request_id) for e in events]
     # per-request deadline facts: carried by the trace (who had one) and
@@ -127,6 +143,8 @@ def build_report(spec, trace, outputs, stats: dict, tracer,
         "per_tenant": per_tenant,
         "engine": {k: v for k, v in sorted(stats.items())},
     }
+    if router is not None:
+        report["router"] = dict(router)
     if checks is not None:
         report["checks"] = dict(checks)
     return report
@@ -153,3 +171,8 @@ def validate_report(report: dict) -> None:
         if t_missing:
             raise ValueError(f"tenant {name!r} block missing "
                              f"{t_missing}")
+    if "router" in report:
+        r_missing = [f for f in ROUTER_FIELDS
+                     if f not in report["router"]]
+        if r_missing:
+            raise ValueError(f"router block missing {r_missing}")

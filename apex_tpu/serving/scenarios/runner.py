@@ -116,7 +116,19 @@ class EngineSpec:
     ``tp``-device mesh (docs/tp_serving.md) — the registry model's
     tp=1 weights are sharded on first use, so replays stay
     token-comparable to the single-chip engine and to lock-step
-    ``generate`` (the ``check=True`` amplifiers bind exactly that)."""
+    ``generate`` (the ``check=True`` amplifiers bind exactly that).
+
+    ``replicas > 1`` serves the trace through a
+    :class:`~apex_tpu.serving.router.ReplicaRouter` over that many
+    frontend+engine replicas (docs/router.md): ``routing`` picks the
+    router policy (``"affinity"`` keys on the trace event's TENANT —
+    the system-prompt unit — so one tenant's requests land where its
+    header pages are cached; ``"round_robin"`` is the A/B baseline),
+    and ``compare_round_robin=True`` re-replays the same trace through
+    a fresh round-robin router so the report's ``router`` block can
+    bank both hit rates and their delta. ``ScenarioSpec.faults``
+    injects deterministic chaos into the replicas
+    (``serving/faults.py``)."""
 
     model: str = "gpt2-tiny"
     num_slots: int = 3
@@ -127,6 +139,9 @@ class EngineSpec:
     preempt_on_priority: bool = False
     preempt_margin_ms: float = 50.0
     tensor_parallel: int = 1             # >1 = TP mesh engine
+    replicas: int = 1                    # >1 = ReplicaRouter DP serving
+    routing: str = "affinity"            # router policy (replicas > 1)
+    compare_round_robin: bool = False    # bank the affinity-vs-RR A/B
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +159,10 @@ class ScenarioSpec:
     engine: EngineSpec = EngineSpec()
     time_scale: float = 1.0              # arrival-time multiplier at replay
     description: str = ""
+    #: deterministic chaos plan (``serving/faults.py``) delivered into
+    #: the replica frontends at replay — only meaningful with
+    #: ``engine.replicas > 1`` (a single frontend has no survivor)
+    faults: Tuple = ()
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), sort_keys=True,
@@ -151,12 +170,15 @@ class ScenarioSpec:
 
     @classmethod
     def from_json(cls, text: str) -> "ScenarioSpec":
+        from apex_tpu.serving.faults import FaultSpec
+
         d = json.loads(text)
         d["arrival"] = Arrival(**d.get("arrival", {}))
         d["prompt_lens"] = Lengths(**d.get("prompt_lens", {}))
         d["output_lens"] = Lengths(**d.get("output_lens", {}))
         d["tenants"] = tuple(Tenant(**t) for t in d.get("tenants", ()))
         d["engine"] = EngineSpec(**d.get("engine", {}))
+        d["faults"] = tuple(FaultSpec(**f) for f in d.get("faults", ()))
         return cls(**d)
 
 
@@ -205,7 +227,10 @@ def materialize(spec: ScenarioSpec) -> Trace:
                                max_pos - 1 - header.shape[0]))
         tail = rng.integers(0, cfg.vocab_size, tail_len)
         prompt = np.concatenate([header, tail.astype(np.int32)])
-        max_new = int(np.clip(outs[i], 1, max_pos - prompt.shape[0]))
+        # a tenant with a pinned output budget overrides the sampled one
+        want_out = ten.output_tokens if ten.output_tokens is not None \
+            else outs[i]
+        max_new = int(np.clip(want_out, 1, max_pos - prompt.shape[0]))
         events.append(TraceEvent(
             request_id=i, arrival_ms=float(arrivals[i]),
             tenant=ten.name, prompt=[int(t) for t in prompt],
@@ -275,13 +300,84 @@ def _build_engine(spec: ScenarioSpec, model, variables, *,
     return PagedDecodeEngine(model, variables, **kw)
 
 
+def _build_router(spec: ScenarioSpec, model, variables, *,
+                  routing: Optional[str] = None, faults=None):
+    """N fresh frontend+engine replicas behind one
+    :class:`~apex_tpu.serving.router.ReplicaRouter`, with the spec's
+    fault plan (or an override) injected through the frontends' fault
+    hooks."""
+    from apex_tpu.serving.faults import FaultPlan
+    from apex_tpu.serving.frontend import ServingFrontend
+    from apex_tpu.serving.policy import PriorityDeadlinePolicy
+    from apex_tpu.serving.router import ReplicaRouter, RouterPolicy
+
+    es = spec.engine
+    plan = FaultPlan(specs=tuple(spec.faults if faults is None
+                                 else faults))
+    frontends = []
+    for i in range(es.replicas):
+        engine = _build_engine(spec, model, variables)
+        policy = PriorityDeadlinePolicy(
+            preempt_on_priority=es.preempt_on_priority,
+            preempt_margin_ms=es.preempt_margin_ms)
+        frontends.append(ServingFrontend(engine, policy=policy,
+                                         fault_hook=plan.injector(i)))
+    return ReplicaRouter(
+        frontends,
+        policy=RouterPolicy(routing=routing if routing is not None
+                            else es.routing,
+                            backoff_base_ms=2.0))
+
+
+def _replay_router(spec: ScenarioSpec, trace: Trace, router):
+    """Open-loop replay through a :class:`ReplicaRouter` (the
+    ``engine.replicas > 1`` path): affinity keys on the trace event's
+    TENANT (the system-prompt unit), the router's synchronous ``pump``
+    drives every replica. Raises if any request failed terminally —
+    catalog chaos scenarios are sized to always recover; non-recovery
+    coverage lives in tests/test_router.py."""
+    events = trace.events
+    scale = spec.time_scale
+    handles = {}
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(events):
+        now_s = time.perf_counter() - t0
+        while (i < len(events)
+               and events[i].arrival_ms * scale * 1e-3 <= now_s):
+            e = events[i]
+            req = _event_request(
+                e, arrival_time=t0 + e.arrival_ms * scale * 1e-3)
+            handles[e.request_id] = router.submit(
+                req, request_id=e.request_id, affinity_key=e.tenant)
+            i += 1
+        if not router.pump() and i < len(events):
+            gap = (events[i].arrival_ms * scale * 1e-3
+                   - (time.perf_counter() - t0))
+            time.sleep(min(max(gap, 0.0), 0.002))
+    router.drain()
+    wall_s = time.perf_counter() - t0
+    outputs = [np.asarray(handles[e.request_id].result(timeout=0),
+                          np.int32) for e in events]
+    return outputs, wall_s
+
+
 def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):
     """Open-loop replay of ``trace`` through a fresh frontend; returns
     ``(outputs, stats, tracer, wall_s)``. ``engine=`` injects a
-    pre-built (e.g. pre-warmed) engine."""
+    pre-built (e.g. pre-warmed) engine. With ``engine.replicas > 1``
+    the trace replays through a fresh :class:`ReplicaRouter` instead —
+    ``stats`` is then the router's stats dict (aggregated engine
+    counters included) and ``tracer`` the router's cross-replica
+    lifecycle adapter."""
     from apex_tpu.serving.frontend import ServingFrontend
     from apex_tpu.serving.policy import PriorityDeadlinePolicy
 
+    if spec.engine.replicas > 1 and engine is None:
+        _, model, v = build_model(spec.engine.model)
+        router = _build_router(spec, model, v)
+        outputs, wall_s = _replay_router(spec, trace, router)
+        return outputs, router.stats(), router, wall_s
     if engine is None:
         _, model, v = build_model(spec.engine.model)
         engine = _build_engine(spec, model, v)
@@ -358,12 +454,50 @@ def _check_scheduling_invariance(spec: ScenarioSpec, trace: Trace,
                 f"(sync_every {spec.engine.sync_every} -> {alt_sync})")
 
 
+def _router_block(spec: ScenarioSpec, trace: Trace,
+                  stats: dict) -> dict:
+    """The report's ``router`` block for a replicated scenario:
+    supervision/failover facts plus — with ``compare_round_robin`` —
+    the affinity-vs-round-robin hit-rate A/B (the same trace re-played
+    through a fresh round-robin router, faults stripped so the baseline
+    measures routing, not luck-of-the-kill)."""
+    block = {
+        "replicas": int(stats.get("replicas", 0)),
+        "replicas_alive": int(stats.get("replicas_alive", 0)),
+        "routing": spec.engine.routing,
+        "failovers": int(stats.get("failovers", 0)),
+        "failover_requests": int(stats.get("failover_requests", 0)),
+        "failover_recovered": int(stats.get("failover_recovered", 0)),
+        "failover_recovered_rate":
+            round(float(stats.get("failover_recovered_rate", 1.0)), 4),
+        "shed_requests": int(stats.get("shed_requests", 0)),
+        "migrations": int(stats.get("migrations", 0)),
+        "replica_deaths": int(stats.get("replica_deaths", 0)),
+        "affinity_hit_rate":
+            round(float(stats.get("prefix_hit_rate", 0.0)), 4),
+    }
+    if spec.engine.compare_round_robin:
+        _, model, v = build_model(spec.engine.model)
+        rr_router = _build_router(spec, model, v,
+                                  routing="round_robin", faults=())
+        _replay_router(spec, trace, rr_router)
+        rr_stats = rr_router.stats()
+        rr_rate = round(float(rr_stats.get("prefix_hit_rate", 0.0)), 4)
+        block["round_robin_hit_rate"] = rr_rate
+        block["affinity_delta_hit_rate"] = round(
+            block["affinity_hit_rate"] - rr_rate, 4)
+    return block
+
+
 def run_scenario(spec: ScenarioSpec, *, check: bool = False,
                  trace: Optional[Trace] = None) -> ScenarioResult:
     """Materialize (unless a saved ``trace`` is injected), replay, and
     report one scenario. ``check=True`` additionally runs the
     token-identity and scheduling-invariance amplifiers and records
-    their outcome under ``report["checks"]`` (raising on divergence)."""
+    their outcome under ``report["checks"]`` (raising on divergence).
+    Replicated scenarios (``engine.replicas > 1``) add the ``router``
+    block — failover/recovery facts and, with
+    ``compare_round_robin``, the affinity-vs-round-robin hit-rate A/B."""
     if trace is None:
         trace = materialize(spec)
     outputs, stats, tracer, wall_s = replay(spec, trace)
@@ -373,8 +507,11 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
         _check_scheduling_invariance(spec, trace, outputs)
         checks = {"greedy_identity_requests": n_checked,
                   "scheduling_invariance": True}
+    router_block = _router_block(spec, trace, stats) \
+        if spec.engine.replicas > 1 else None
     rep = report_mod.build_report(spec, trace, outputs, stats, tracer,
-                                  wall_s, checks=checks)
+                                  wall_s, checks=checks,
+                                  router=router_block)
     report_mod.validate_report(rep)
     return ScenarioResult(spec=spec, trace=trace, outputs=outputs,
                           stats=stats, report=rep)

@@ -41,7 +41,11 @@ class Tenant:
     not a hard quota); ``system_prompt_tokens`` the length of its shared
     header (0 = no shared prefix); ``priority``/``deadline_ms``/
     ``tpot_slo_ms`` stamp every request the tenant emits (the
-    ``Request`` fields the policy and SLO accounting consume)."""
+    ``Request`` fields the policy and SLO accounting consume).
+    ``output_tokens`` pins the tenant's requests to a FIXED output
+    budget instead of the scenario's sampled ``output_lens`` — how
+    adversaries pit a short-request tenant against a long-running one
+    (the ``preemption-storm`` scenario's urgent-vs-bulk shape)."""
 
     name: str
     weight: float = 1.0
@@ -49,6 +53,7 @@ class Tenant:
     priority: int = 0
     deadline_ms: Optional[float] = None
     tpot_slo_ms: Optional[float] = None
+    output_tokens: Optional[int] = None
 
 
 def system_prompt(tenant: Tenant, vocab_size: int,

@@ -115,6 +115,33 @@ fi
 JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
   --bench "SCENARIOS_${TAG}.json"
 
+# CHAOS smoke (docs/router.md): replicated serving through an injected
+# mid-decode replica kill + the affinity-vs-round-robin A/B, on CPU
+# before the tunnel probe. --check is on: the greedy-identity amplifier
+# proves the failover corrupted no tokens. The banked router fields
+# (scenario.<name>.failover_recovered_rate, affinity_hit_rate /
+# round_robin_hit_rate / affinity_delta_hit_rate) band-gate against
+# the trajectory like the other rates (absolute ±0.25).
+if [ ! -f "CHAOS_${TAG}.json" ]; then
+  echo "[$(date +%H:%M:%S)] chaos smoke (replica kill + affinity A/B, CPU)..."
+  if ! JAX_PLATFORMS=cpu timeout 1800 python -m apex_tpu.serving.scenarios \
+      --scenario chaos-replica-kill --scenario router-affinity-ab \
+      --check --json "CHAOS_${TAG}.json" --seed 0; then
+    echo "[$(date +%H:%M:%S)] chaos smoke failed; replica failover is"
+    echo "  broken — fix before burning a tunnel window"
+    exit 1
+  fi
+fi
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check \
+    --costs "COSTS_${TAG}.json" --bench "CHAOS_${TAG}.json"; then
+  echo "[$(date +%H:%M:%S)] perf ledger: chaos/router regression vs the"
+  echo "  trajectory; round marked failed — entry still appended so the"
+  echo "  regression itself is on record"
+  LEDGER_BENCH_RC=1
+fi
+JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
+  --bench "CHAOS_${TAG}.json"
+
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"

@@ -361,6 +361,41 @@ def test_guardedby_inference_on_live_frontend():
         "CompileWatcher._lock"
 
 
+def test_router_supervisor_thread_coloring():
+    """ISSUE 11: the replica router's supervisor thread is discovered
+    by its literal name and colors the whole supervision chain —
+    failure detection, token forwarding, failover resubmission — so the
+    field rule sees every router-state access as multi-thread."""
+    model, _ = build_model(_surface_sources())
+    colored = {k.qualname for k, v in model.colors.items()
+               if "serving-router-supervisor" in v}
+    for fn in ("ReplicaRouter._tick", "ReplicaRouter._service_locked",
+               "ReplicaRouter._failover_locked", "ReplicaRouter._place",
+               "ReplicaRouter._route_due",
+               "ReplicaRouter._mark_dead_locked"):
+        assert fn in colored, sorted(colored)
+
+
+def test_router_guardedby_map_pinned():
+    """ISSUE 11: the router's lock discipline is a CHECKED contract —
+    the inference must recover exactly the intended GuardedBy map for
+    the router's shared state and the fault injector's trigger
+    counters (and the frontend's new shutdown flag)."""
+    model, _ = build_model(_surface_sources())
+    guards = {(f[1], f[2]): lock.display()
+              for f, (lock, _, _) in model.inferred_guards().items()}
+    for field in ("_entries", "_queued", "_records", "_accepting",
+                  "_rr_next", "_sup_thread"):
+        assert guards[("ReplicaRouter", field)] == \
+            "ReplicaRouter._lock", (field, guards.get(
+                ("ReplicaRouter", field)))
+    for field in ("_pumps", "_submits", "_rejected", "fired"):
+        assert guards[("FaultInjector", field)] == \
+            "FaultInjector._lock"
+    assert guards[("ServingFrontend", "_accepting")] == \
+        "ServingFrontend._ingest_lock"
+
+
 def test_docs_thread_safety_contract_matches_inference():
     """docs/frontend.md's contract table rows are cross-checked against
     the inferred GuardedBy map — the doc cannot drift from the code."""

@@ -22,8 +22,9 @@ import pytest
 
 from apex_tpu.models.generation import generate
 from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
-from apex_tpu.serving import (PagedDecodeEngine, PriorityDeadlinePolicy,
-                              Request, free_page_count)
+from apex_tpu.serving import (FaultPlan, FaultSpec, PagedDecodeEngine,
+                              PriorityDeadlinePolicy, Request,
+                              ServingError, free_page_count)
 from apex_tpu.serving.frontend import ServingFrontend
 from apex_tpu.utils import metrics
 
@@ -523,6 +524,157 @@ def test_deadlock_still_raises_and_fails_handles(rng):
                       max_new_tokens=10))
     with pytest.raises(RuntimeError, match="deadlock"):
         fe.drain()
+
+
+# --------------------------------------------------------------------------
+# pump death (ISSUE 11 satellite: a dead engine must never hang a handle)
+# --------------------------------------------------------------------------
+
+def _killed_frontend(model, v, *, at=2, start=True):
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8)
+    plan = FaultPlan(specs=(FaultSpec(kind="kill_replica", at=at),))
+    fe = ServingFrontend(engine, fault_hook=plan.injector(0))
+    if start:
+        fe.start()
+    return fe
+
+
+def test_pump_death_mid_decode_raises_serving_error_bounded(rng):
+    """ISSUE 11 satellite (the pump-death hang): an engine that dies
+    mid-decode must surface a terminal ServingError from result() AND
+    from blocked iteration within a bounded time — before this PR the
+    synchronous pump path left handles un-finished and iteration ended
+    silently instead of raising."""
+    import queue as queue_mod
+
+    cfg, model, v = _model()
+    fe = _killed_frontend(model, v, at=2)
+    try:
+        prompt = rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32)
+        h = fe.submit(Request(prompt=prompt, max_new_tokens=40))
+        # consumer 1: blocked in result() on another thread
+        res: dict = {}
+
+        def consume_result():
+            try:
+                res["out"] = h.result(timeout=300)
+            except BaseException as exc:     # noqa: BLE001
+                res["exc"] = exc
+
+        import threading
+        t = threading.Thread(target=consume_result, daemon=True)
+        t.start()
+        t.join(timeout=300)
+        assert not t.is_alive(), "result() hung on a dead engine"
+        assert isinstance(res.get("exc"), ServingError)
+        # consumer 2: blocked iteration raises too (never silent-ends)
+        with pytest.raises(ServingError):
+            for _ in h:
+                pass
+        with pytest.raises(ServingError):
+            while h.get(timeout=10) is not None:
+                pass
+        assert h.error is not None
+        # the frontend is terminally failed: late submits raise, the
+        # failure is observable (the /healthz surface)
+        assert fe.failure is not None
+        with pytest.raises(ServingError, match="pump has failed"):
+            fe.submit(Request(prompt=prompt, max_new_tokens=4))
+        del queue_mod
+    finally:
+        fe.stop()
+
+
+def test_pump_death_sync_path_fails_handles(rng):
+    """The SYNCHRONOUS pump driver takes the same terminal path: the
+    exception propagates to the driving caller AND every live handle
+    (active + pending) fails — nothing dangles for a streaming
+    consumer on another thread to block on."""
+    cfg, model, v = _model()
+    fe = _killed_frontend(model, v, at=3, start=False)
+    prompts = [rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32)
+               for _ in range(4)]
+    handles = [fe.submit(Request(prompt=p, max_new_tokens=30))
+               for p in prompts]        # 2 active + 2 pending (2 slots)
+    from apex_tpu.serving.faults import InjectedFault
+
+    with pytest.raises(InjectedFault):
+        fe.drain()
+    for h in handles:
+        assert h.done
+        with pytest.raises(ServingError):
+            h.result(timeout=0)
+
+
+# --------------------------------------------------------------------------
+# shutdown under load (ISSUE 11 satellite: stop() must not strand work)
+# --------------------------------------------------------------------------
+
+def _loaded_frontend(model, v, cfg, rng, *, n=6, max_new=16):
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                               prefix_cache=True)
+    fe = ServingFrontend(engine)
+    handles = [fe.submit(Request(
+        prompt=rng.integers(0, cfg.vocab_size, (10,)).astype(np.int32),
+        max_new_tokens=max_new), request_id=i) for i in range(n)]
+    return fe, handles
+
+
+@pytest.mark.parametrize("mode", ["drain", "cancel"])
+def test_shutdown_under_load_resolves_deterministically(rng, mode):
+    """shutdown() with queued + active + mid-stream requests: every
+    handle reaches done (full output under drain, truncated under
+    cancel), zero pool-page leaks, zero dangling threads, and late
+    submits raise."""
+    import threading
+
+    cfg, model, v = _model()
+    fe, handles = _loaded_frontend(model, v, cfg, rng)
+    fe.start()
+    try:
+        handles[0].get(timeout=120)      # at least one token streamed
+        fe.shutdown(deadline_s=300.0, mode=mode)
+    finally:
+        fe.stop()
+    for h in handles:
+        assert h.done
+        out = h.result(timeout=0)        # never raises: resolved, not
+        if mode == "drain":              # stranded
+            assert out.shape[0] == 16
+        else:
+            assert out.shape[0] <= 16
+    with pytest.raises(ServingError, match="shutting down"):
+        fe.submit(Request(prompt=np.zeros((4,), np.int32),
+                          max_new_tokens=2))
+    # zero dangling threads, zero leaked pages
+    assert not fe.pump_alive
+    assert "serving-frontend-pump" not in {
+        t.name for t in threading.enumerate()}
+    engine = fe.engine
+    usable = engine.cache["free_stack"].shape[0] - 1
+    assert int(free_page_count(engine.cache)) == \
+        usable - len(engine.prefix)
+    assert int(np.asarray(engine.cache["page_ref"]).sum()) == 0
+
+
+def test_shutdown_sync_and_deadline_expiry(rng):
+    """A synchronously driven frontend shuts down the same way, and an
+    already-expired drain deadline degrades to cancellation — bounded,
+    never an infinite pump loop."""
+    cfg, model, v = _model()
+    fe, handles = _loaded_frontend(model, v, cfg, rng, n=4, max_new=24)
+    for _ in range(3):
+        fe.pump()
+    fe.shutdown(deadline_s=0.0, mode="drain")   # expires immediately
+    for h in handles:
+        assert h.done
+        assert h.result(timeout=0).shape[0] <= 24   # truncated is fine
+    engine = fe.engine
+    usable = engine.cache["free_stack"].shape[0] - 1
+    assert int(free_page_count(engine.cache)) == \
+        usable - len(engine.prefix)
+    with pytest.raises(ValueError, match="mode"):
+        fe.shutdown(mode="nope")
 
 
 # --------------------------------------------------------------------------

@@ -280,6 +280,189 @@ def test_windowed_scenario_runs_and_recovers_the_pool():
     assert r.report["model"] == "llama-tiny-windowed"
 
 
+# --- ISSUE 11: chaos / router scenarios + the preemption-storm adversary -----
+
+
+def test_chaos_replica_kill_scenario_recovers_token_exact():
+    """ISSUE 11 acceptance: the catalogued mid-decode replica kill
+    completes every request — the greedy-identity amplifier proves the
+    failover corrupted nothing — with the failure facts in the pinned
+    router block and both rates banked for the ledger. (Tier-1 runs an
+    n=8 override of the catalog entry; CI's chaos smoke replays the
+    full-size entry per round.)"""
+    from apex_tpu.serving.scenarios.runner import _check_greedy_identity
+
+    spec = scenario_spec("chaos-replica-kill", seed=0, n_requests=8)
+    r = run_scenario(spec)
+    rb = r.report["router"]
+    assert rb["replicas"] == 2 and rb["replicas_alive"] == 1
+    assert rb["replica_deaths"] == 1
+    assert rb["failover_requests"] >= 1
+    assert rb["failover_recovered_rate"] == 1.0
+    # the greedy-identity amplifier, directly: every replayed output
+    # (failed-over ones included) must equal lock-step generate. (The
+    # scheduling-invariance half of --check runs in CI's chaos smoke
+    # and the slow-tier A/B test — it re-replays the whole trace on a
+    # fresh engine, which tier-1's budget doesn't need twice.)
+    assert _check_greedy_identity(spec, r.trace, r.outputs) == 8
+    validate_report(r.report)
+
+
+@pytest.mark.slow
+def test_chaos_pump_stall_scenario_is_latency_only():
+    """(slow tier: the latency-not-death contract is already pinned in
+    tier-1 by tests/test_router.py::test_pump_stall_is_latency_not_death;
+    this adds the catalogued-scenario + amplifier form.)"""
+    r = run_scenario(scenario_spec("chaos-pump-stall", seed=0),
+                     check=True)
+    rb = r.report["router"]
+    assert rb["replica_deaths"] == 0 and rb["failovers"] == 0
+    assert rb["replicas_alive"] == 2
+    assert r.report["checks"]["greedy_identity_requests"] == 10
+
+
+@pytest.mark.slow
+def test_router_affinity_ab_beats_round_robin():
+    """ISSUE 11 acceptance: the multi-tenant workload's aggregate
+    prefix hit-rate under affinity routing strictly beats round-robin
+    on the same trace (both numbers + the delta land in the report for
+    the ledger to bank). (Slow tier: the deterministic tier-1 twin is
+    tests/test_router.py::
+    test_affinity_hit_rate_beats_round_robin_deterministic; CI's chaos
+    smoke replays this full entry per round and the ledger gates it.)"""
+    r = run_scenario(scenario_spec("router-affinity-ab", seed=0))
+    rb = r.report["router"]
+    assert rb["routing"] == "affinity"
+    assert rb["affinity_hit_rate"] > rb["round_robin_hit_rate"]
+    assert rb["affinity_delta_hit_rate"] == pytest.approx(
+        rb["affinity_hit_rate"] - rb["round_robin_hit_rate"], abs=1e-3)
+
+
+def test_tenant_output_tokens_override():
+    """A tenant with a pinned output budget overrides the sampled
+    output length (the preemption-storm's urgent-vs-bulk shape)."""
+    spec = ScenarioSpec(
+        name="pin", seed=0, n_requests=12,
+        output_lens=Lengths(kind="uniform", lo=20, hi=30),
+        tenants=(Tenant("short", output_tokens=2),))
+    trace = materialize(spec)
+    assert all(e.max_new_tokens == 2 for e in trace.events)
+
+
+@pytest.mark.slow
+def test_preemption_storm_scenario_no_compile_storm():
+    """The catalogued storm replays clean: whatever preempt/resume
+    cycles the pacing produced, the resume compile-key set stayed
+    bounded — no compile_storm event, a bounded jit.compiles delta
+    (the deterministic cycle-count pin is the frontend-driven test
+    below)."""
+    r = run_scenario(scenario_spec("preemption-storm", seed=0))
+    eng = r.report["engine"]
+    assert eng["compile_storms"] == 0
+    assert eng["jit.compiles"] <= 24
+    assert eng["deadline_misses"] == 0
+    validate_report(r.report)
+
+
+def test_preemption_storm_deterministic_cycles_bounded_compiles(rng):
+    """ISSUE 11 satellite (ROADMAP 5's named gap), deterministically: a
+    bulk long-runner on ONE slot is preempted by six consecutive urgent
+    arrivals — six full preempt/spill/resume cycles — and the recompile
+    watcher pins the resume compile-key set: zero compile_storm events
+    and a bounded jit.compiles delta (page-quantized resume t_starts
+    reuse their shared-admit programs instead of growing one compile
+    per cycle), with the bulk output still token-identical to an
+    undisturbed run."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.generation import generate
+    from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+    from apex_tpu.serving import (PagedDecodeEngine,
+                                  PriorityDeadlinePolicy, Request)
+    from apex_tpu.serving.frontend import ServingFrontend
+
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=16,
+                               prefix_cache=True)
+    fe = ServingFrontend(engine, policy=PriorityDeadlinePolicy(
+        preempt_on_priority=True))
+    bulk_prompt = rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+    h_bulk = fe.submit(Request(prompt=bulk_prompt, max_new_tokens=36),
+                       request_id=0)
+    while fe.queue_depth:
+        fe.pump()
+    n_cycles = 6
+    for k in range(n_cycles):
+        fe.pump()                        # let the victim make progress
+        h = fe.submit(Request(
+            prompt=rng.integers(0, cfg.vocab_size, (10,)
+                                ).astype(np.int32),
+            max_new_tokens=2, priority=5), request_id=1 + k)
+        while not h.done:                # urgent runs to completion
+            fe.pump()
+    fe.drain()
+    stats = fe.stats()
+    assert stats["preemptions"] >= n_cycles - 1
+    assert stats["resumes"] >= n_cycles - 1
+    # the recompile-watcher pin: no program recompiled storm-many
+    # times, and the whole storm cost a bounded number of compiles
+    assert stats["compile_storms"] == 0
+    ring = engine.events.tail()
+    assert not any(e["kind"] == "compile_storm" for e in ring)
+    assert stats["jit.compiles"] <= 20, stats["jit.compiles"]
+    ref = np.asarray(generate(model, v, bulk_prompt[None],
+                              max_new_tokens=36))[0, 12:]
+    np.testing.assert_array_equal(h_bulk.result(timeout=0), ref)
+
+
+def test_chaos_specs_roundtrip_with_faults():
+    """A chaos spec's fault plan survives the JSON round-trip (the
+    replayability contract: same spec file, same kills)."""
+    spec = scenario_spec("chaos-replica-kill", seed=3)
+    back = ScenarioSpec.from_json(spec.to_json())
+    assert back == spec
+    assert back.faults[0].kind == "kill_replica"
+    assert back.engine.replicas == 2
+
+
+def test_ledger_extracts_router_fields(tmp_path):
+    """CHAOS_<tag>.json (a scenarios/v1 document of router scenarios)
+    yields the band-gated scenario.<name>.failover_recovered_rate and
+    hit-rate A/B metrics."""
+    import json as json_mod
+
+    from apex_tpu.obs.ledger import bench_metrics_from_file
+
+    doc = {"schema": "apex-tpu/scenarios/v1", "seed": 0,
+           "scenarios": {"chaos-replica-kill": {
+               "aggregate": {"ttft_ms_p95": 12.5, "tpot_ms_p95": 3.0,
+                             "deadline_miss_rate": 0.0},
+               "router": {"failover_recovered_rate": 1.0,
+                          "affinity_hit_rate": 0.6,
+                          "round_robin_hit_rate": 0.45,
+                          "affinity_delta_hit_rate": 0.15}}}}
+    path = tmp_path / "CHAOS_test.json"
+    path.write_text(json_mod.dumps(doc))
+    m, meta = bench_metrics_from_file(path)
+    assert m["scenario.chaos-replica-kill.failover_recovered_rate"] \
+        == 1.0
+    assert m["scenario.chaos-replica-kill.affinity_hit_rate"] == 0.6
+    assert m["scenario.chaos-replica-kill.affinity_delta_hit_rate"] \
+        == pytest.approx(0.15)
+    # direction classes: recovered/hit rates gate on the absolute rate
+    # band as higher-better
+    from apex_tpu.obs.ledger import check as ledger_check
+    entries = [{"metrics": m, "tag": "base", "git_rev": "x"}]
+    worse = dict(m)
+    worse["scenario.chaos-replica-kill.failover_recovered_rate"] = 0.5
+    regs = ledger_check(worse, entries)
+    assert any("failover_recovered_rate" in r.metric for r in regs)
+    assert not ledger_check(dict(m), entries)
+
+
 # --- CLI + ledger integration ------------------------------------------------
 
 
