@@ -174,6 +174,113 @@ def _build_engine_chunk() -> CaseProgram:
     return CaseProgram(fn=engine._step_fn(), args=args)
 
 
+def _weight_bytes(tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        n = 1
+        for d in leaf.shape:
+            n *= int(d)
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+def _build_spec_engine_program() -> CaseProgram:
+    """The IN-ENGINE speculative decode chunk (ISSUE 13): the jitted
+    ``sync_every``-round scan where each round runs ``draft_len``
+    single-token draft steps over the DRAFT pool and verifies the block
+    in ONE ``s = draft_len + 1`` paged target step. The draft is a
+    1-layer gpt2s-dims model — the shape regime where the round's
+    weight stream (W_target + k * W_draft) amortized over >= 2 accepted
+    tokens beats the non-speculative per-token stream, which
+    ``obs/costs.py`` prices from this case's ``meta``. The two variants
+    pin that per-slot decode state (tok/done/n_left) is TRACED, never a
+    compile key: concrete values and abstract structs must stage ONE
+    program."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    dcfg = _dc.replace(cfg, num_layers=1)
+    draft = GPTModel(dcfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, sync_every=4,
+                               draft_model=draft, draft_variables=None,
+                               draft_len=1)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dcache_abs = jax.tree.map(sds, engine.draft_cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    ddvars = jax.eval_shape(lambda: draft.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    args = (cache_abs, dcache_abs, dvars, ddvars,
+            jax.ShapeDtypeStruct((4,), i32),        # tok (pending)
+            jax.ShapeDtypeStruct((4,), jnp.bool_),  # done
+            jax.ShapeDtypeStruct((4,), i32))        # n_left
+    variant = (cache_abs, dcache_abs, dvars, ddvars,
+               np.zeros((4,), np.int32), np.zeros((4,), bool),
+               np.full((4,), 7, np.int32))
+    meta = {"draft_len": engine.draft_len, "k": engine.draft_len + 1,
+            "sync_every": engine.sync_every,
+            "target_weight_bytes": _weight_bytes(dvars),
+            "draft_weight_bytes": _weight_bytes(ddvars)}
+    return CaseProgram(fn=engine._spec_step_fn(), args=args,
+                       variants=[variant], max_traces=1, meta=meta)
+
+
+def _build_prefill_chunk_program() -> CaseProgram:
+    """The chunked-prefill step (ISSUE 13): one 16-token prompt chunk
+    of one slot through the paged s>1 path. The two variants trace the
+    program at concrete ``valid`` counts 5 and 7 — the chunk's true
+    token count is a TRACED operand, so every prompt length shares ONE
+    staged program per engine (the compile-key contract that lets the
+    frontend interleave prefill chunks between decode chunks without
+    recompiling)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, prefill_chunk=16)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    args = (cache_abs, dvars,
+            jax.ShapeDtypeStruct((1, 16), i32),     # chunk ids
+            jax.ShapeDtypeStruct((), i32),          # slot
+            jax.ShapeDtypeStruct((), i32),          # valid
+            jax.ShapeDtypeStruct((2,), jnp.uint32),  # req_key
+            jax.ShapeDtypeStruct((), i32))          # samp0
+
+    def variant_for(valid: int) -> tuple:
+        return (cache_abs, dvars,
+                np.zeros((1, 16), np.int32), np.int32(0),
+                np.int32(valid), np.zeros((2,), np.uint32), np.int32(0))
+
+    return CaseProgram(fn=engine._prefill_chunk_fn(), args=args,
+                       variants=[variant_for(5), variant_for(7)],
+                       max_traces=1)
+
+
 def _build_admit_bucketed() -> CaseProgram:
     """The engine's prompt-admission program, traced at two prompt
     lengths that land in the SAME bucket under the ENGINE'S OWN
@@ -428,6 +535,10 @@ def analysis_cases(root) -> List[AnalysisCase]:
                               _build_engine_chunk))
     cases.append(AnalysisCase("gpt2s_engine_admit_bucketed", "serving",
                               _build_admit_bucketed))
+    cases.append(AnalysisCase("gpt2s_engine_spec_step_chunk", "serving",
+                              _build_spec_engine_program))
+    cases.append(AnalysisCase("gpt2s_engine_prefill_chunk", "serving",
+                              _build_prefill_chunk_program))
     cases.append(AnalysisCase(
         "gpt2s_frontend_decode_chunk", "serving",
         lambda: _build_frontend_program("decode")))

@@ -171,24 +171,29 @@ def update_layer_cache(lc, k_chunk, v_chunk):
 
 
 def update_paged_layer_cache(lc, k_chunk, v_chunk):
-    """Write a single-token ``(slots, kv, 1, d)`` K/V chunk into the page
-    pool at each slot's current length: slot ``b``'s token lands in page
-    ``block_tables[b, len_b // page_size]`` at offset ``len_b % page_size``.
-    Distinct slots own distinct pages, so the scatter indices never
-    collide; an idle slot (block table row all null-page) writes into the
-    reserved page 0, which no live sequence ever reads."""
+    """Write an ``(slots, kv, s, d)`` K/V chunk into the page pool at each
+    slot's current length: slot ``b``'s chunk position ``i`` lands in page
+    ``block_tables[b, (len_b + i) // page_size]`` at offset
+    ``(len_b + i) % page_size``. Distinct slots own distinct pages and a
+    slot's ``s`` positions are distinct ``(page, offset)`` pairs (callers
+    keep ``s <= page_size``, the paged kernel's own bound), so the scatter
+    indices never collide; an idle slot (block table row all null-page)
+    writes into the reserved page 0, which no live sequence ever reads."""
     ps = lc["k_pages"].shape[2]
     max_pages = lc["block_tables"].shape[1]
+    s = k_chunk.shape[2]
     t = lc["len"]                                            # (slots,)
+    pos = t[:, None] + jnp.arange(s, dtype=t.dtype)[None, :]  # (slots, s)
     page = jnp.take_along_axis(
-        lc["block_tables"], jnp.clip(t // ps, 0, max_pages - 1)[:, None],
-        axis=1)[:, 0]
-    off = t % ps
+        lc["block_tables"], jnp.clip(pos // ps, 0, max_pages - 1), axis=1)
+    off = pos % ps
     out = dict(lc)
+    # advanced-index dims lead: [page, :, off, :] scatters (slots, s)
+    # index pairs over (kv, d) tiles — values arrive position-major
     out["k_pages"] = lc["k_pages"].at[page, :, off, :].set(
-        k_chunk[:, :, 0, :].astype(lc["k_pages"].dtype))
+        k_chunk.transpose(0, 2, 1, 3).astype(lc["k_pages"].dtype))
     out["v_pages"] = lc["v_pages"].at[page, :, off, :].set(
-        v_chunk[:, :, 0, :].astype(lc["v_pages"].dtype))
+        v_chunk.transpose(0, 2, 1, 3).astype(lc["v_pages"].dtype))
     return out
 
 

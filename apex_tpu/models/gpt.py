@@ -148,17 +148,18 @@ class ParallelDecoderBlock(nn.Module):
             return t.reshape(b, s, h_local, d).transpose(0, 2, 1, 3)
 
         if cache is not None and is_paged(cache):
-            # paged serving decode (apex_tpu/serving): write this token's
-            # K/V into the slot's current page, then gather-attend over
-            # the block table with the Pallas paged kernel. Prefill never
-            # comes through here (the scheduler prefills via the
-            # contiguous flash path and scatters into pages).
+            # paged serving decode (apex_tpu/serving): write this step's
+            # s-token K/V block into the slot's current pages, then
+            # gather-attend over the block table with the Pallas paged
+            # kernel (s=1 plain decode, s=k speculative verify, s-sized
+            # interleaved-prefill chunks). Monolithic prefill still rides
+            # the contiguous flash path and scatters into pages.
             from apex_tpu.ops.paged_attention import paged_attention
 
             cache = update_paged_layer_cache(cache, to_bhsd(k), to_bhsd(v))
             ctx = paged_attention(to_bhsd(q), cache["k_pages"],
                                   cache["v_pages"], cache["block_tables"],
-                                  cache["len"] + 1)
+                                  cache["len"] + s)
         elif cache is not None:
             # incremental decoding: append this chunk's K/V into the static
             # per-layer cache; a trace-time-provable prefill (static len 0)
@@ -248,18 +249,15 @@ class GPTModel(nn.Module):
                     "parallelism; decode on a dp/tp mesh instead")
 
             if is_paged(cache):
-                # paged serving decode: one token per SLOT, each at its own
-                # absolute position — gather per-slot position rows (the
-                # scheduler guards the position cap; idle slots sit at 0)
-                if s != 1:
-                    raise ValueError(
-                        "paged decode takes single-token steps only "
-                        "(prefill rides the contiguous flash path and is "
-                        "scattered into pages by the scheduler)")
-                pos_s = jnp.take(
-                    pos, jnp.clip(cache["len"], 0,
-                                  cfg.max_position_embeddings - 1),
-                    axis=0)[:, None, :]                      # (b, 1, e)
+                # paged serving decode: an s-token block per SLOT, each
+                # slot at its own absolute positions [len, len+s) —
+                # gather per-slot position rows (the scheduler guards
+                # the position cap; idle slots sit at 0)
+                idx = jnp.clip(
+                    cache["len"][:, None]
+                    + jnp.arange(s, dtype=jnp.int32)[None, :],
+                    0, cfg.max_position_embeddings - 1)      # (b, s)
+                pos_s = jnp.take(pos, idx, axis=0)           # (b, s, e)
             else:
                 t0 = check_chunk_bounds(cache, s,
                                         cfg.max_position_embeddings)

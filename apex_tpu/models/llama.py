@@ -196,13 +196,14 @@ class LlamaDecoderBlock(nn.Module):
 
             cache = update_paged_layer_cache(cache, k, v)
             # sliding_window bands the paged kernel to the exact
-            # rolling-cache attention set; the serving engine additionally
-            # DROPS pages that fall fully below the band from the block
-            # table (kv_pool.drop_slot_pages) — O(window) live pages per
-            # slot for arbitrarily long generation, the paged analog of
-            # the rolling ring buffer
+            # rolling-cache attention set (per query position for s>1);
+            # the serving engine additionally DROPS pages that fall fully
+            # below the band from the block table
+            # (kv_pool.drop_slot_pages) — O(window) live pages per slot
+            # for arbitrarily long generation, the paged analog of the
+            # rolling ring buffer
             ctx = paged_attention(q, cache["k_pages"], cache["v_pages"],
-                                  cache["block_tables"], cache["len"] + 1,
+                                  cache["block_tables"], cache["len"] + s,
                                   window=cfg.sliding_window)
         elif cache is not None:
             # incremental decoding: append K/V at the cache offset; a
@@ -301,27 +302,25 @@ class LlamaModel(nn.Module):
                     "parallelism; decode on a dp/tp mesh instead")
 
             if is_paged(cache):
-                # paged serving decode: one token per SLOT, each at its
-                # own absolute position — per-slot RoPE tables gather by
-                # the length vector (the paged analog of gpt.py's
-                # per-slot position-embedding gather; the scheduler
-                # guards the position cap, idle slots sit at 0)
-                if s != 1:
-                    raise ValueError(
-                        "paged decode takes single-token steps only "
-                        "(prefill rides the contiguous flash path and is "
-                        "scattered into pages by the scheduler)")
+                # paged serving decode: an s-token block per SLOT, each
+                # slot at its own absolute positions [len, len+s) —
+                # per-slot RoPE tables gather by the length vector (the
+                # paged analog of gpt.py's per-slot position-embedding
+                # gather; the scheduler guards the position cap, idle
+                # slots sit at 0)
                 if cfg.rolling_cache:
                     raise NotImplementedError(
                         "rolling_cache (ring buffer) does not compose "
                         "with the paged pool — pages already bound HBM")
-                pos = jnp.clip(cache["len"], 0,
-                               cfg.max_position_embeddings - 1)  # (slots,)
-                cos, sin = _rope_freqs(cfg, pos)
-                # rope layout [sq=1, b, np=1, hn]: per-slot tables ride
+                pos = jnp.clip(
+                    cache["len"][:, None]
+                    + jnp.arange(s, dtype=jnp.int32)[None, :],
+                    0, cfg.max_position_embeddings - 1)     # (slots, s)
+                cos, sin = _rope_freqs(cfg, pos.reshape(-1))
+                # rope layout [sq, b, np=1, hn]: per-slot tables ride
                 # the batch axis and broadcast over heads
-                cos_ = cos[None, :, None, :]
-                sin_ = sin[None, :, None, :]
+                cos_ = cos.reshape(b, s, -1).transpose(1, 0, 2)[:, :, None, :]
+                sin_ = sin.reshape(b, s, -1).transpose(1, 0, 2)[:, :, None, :]
             else:
                 if cfg.rolling_cache and not cfg.sliding_window:
                     raise ValueError("rolling_cache requires sliding_window")

@@ -51,7 +51,8 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["ChipProfile", "PROFILES", "EqnCost", "CaseCost",
            "cost_of_jaxpr", "cost_report", "decode_split",
-           "tp_decode_split", "ledger_metrics", "main"]
+           "tp_decode_split", "spec_decode_split", "ledger_metrics",
+           "main"]
 
 GIB = 1024 ** 3
 
@@ -537,6 +538,55 @@ def tp_decode_split(prog, profile: ChipProfile,
     }
 
 
+def spec_decode_split(prog, profile: ChipProfile) -> dict:
+    """The speculative round's weight economics (ISSUE 13): one round
+    streams the target weights ONCE (the ``s = k`` verify step) plus
+    the draft weights ``k`` times (the draft scan), and emits between 1
+    and ``k`` accepted tokens — so the per-ACCEPTED-token weight stream
+    is ``(W_target + k * W_draft) / a`` at acceptance length ``a``.
+    Decode is weight-bound (``decode_split``), so this ratio against
+    the non-speculative per-token stream (``W_target``) IS the speedup
+    model: speculation pays whenever ``k * W_draft < (a - 1) *
+    W_target``. ``prog`` is the ``gpt2s_engine_spec_step_chunk``
+    CaseProgram; its builder-attached ``meta`` carries the two weight
+    byte counts and ``k`` (``analysis/ir/harness.py``). Also prices the
+    per-acceptance-point round time against ``profile``'s HBM
+    bandwidth — the banded ledger metrics
+    ``spec_decode.predicted_step_ms_a<a>``."""
+    meta = prog.meta or {}
+    k = int(meta["k"])
+    target_w = int(meta["target_weight_bytes"])
+    draft_w = int(meta["draft_weight_bytes"])
+    cache, dcache = prog.args[0], prog.args[1]
+    kv_target, _ = _kv_step_bytes_max(cache)
+    kv_draft, _ = _kv_step_bytes_max(dcache)
+    # per round: one target verify pass + k draft passes, each reading
+    # its pool's worst-case pages
+    round_bytes = (target_w + kv_target) + k * (draft_w + kv_draft)
+    round_weight = target_w + k * draft_w
+    per_acceptance = {}
+    for a in range(1, k + 1):
+        per_acceptance[str(a)] = {
+            "weight_bytes_per_accepted_token": int(round_weight // a),
+            "hbm_bytes_per_accepted_token": int(round_bytes // a),
+            "predicted_step_ms": (round_bytes / a
+                                  / profile.hbm_bytes_per_sec * 1e3),
+        }
+    return {
+        "k": k, "draft_len": k - 1,
+        "target_weight_bytes": target_w,
+        "draft_weight_bytes": draft_w,
+        "round_weight_bytes": int(round_weight),
+        "round_hbm_bytes": int(round_bytes),
+        "per_acceptance": per_acceptance,
+        # the breakeven acceptance length: smallest a whose per-token
+        # weight stream beats the non-speculative W_target
+        "breakeven_acceptance": next(
+            (a for a in range(1, k + 1)
+             if round_weight // a < target_w), None),
+    }
+
+
 # --------------------------------------------------------------------------
 # whole-registry report
 # --------------------------------------------------------------------------
@@ -559,6 +609,7 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     errors: List[dict] = []
     split = None
     tp_split = None
+    spec_split = None
     for c in cases:
         try:
             ir = build_case_ir(c)
@@ -571,6 +622,9 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             if c.name == "tp2_engine_decode_chunk":
                 # per-CHIP split of the SHARDED decode chunk
                 tp_split = tp_decode_split(ir.prog, prof)
+            if c.name == "gpt2s_engine_spec_step_chunk":
+                # per-ACCEPTED-TOKEN split of the speculative round
+                spec_split = spec_decode_split(ir.prog, prof)
         except Exception as e:       # noqa: BLE001 — report, don't crash
             errors.append({"case": c.name,
                            "error": f"{type(e).__name__}: {e}"})
@@ -591,7 +645,8 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     return {"schema": 1, "profile": dataclasses.asdict(prof),
             "root": str(root), "cases": out_cases, "totals": totals,
             "by_domain": by_domain, "decode_split": split,
-            "tp_decode_split": tp_split, "errors": errors}
+            "tp_decode_split": tp_split,
+            "spec_decode_split": spec_split, "errors": errors}
 
 
 def ledger_metrics(report: dict) -> Dict[str, float]:
@@ -626,6 +681,20 @@ def ledger_metrics(report: dict) -> Dict[str, float]:
         # (lower-better "_ms"), not the exact-match ratchet
         m["tp2.paged_decode.predicted_step_ms"] = \
             float(tsplit["predicted_step_ms_per_chip"])
+    ssplit = report.get("spec_decode_split")
+    if ssplit:
+        m["cost.spec_decode.k"] = float(ssplit["k"])
+        m["cost.spec_decode.round_weight_bytes"] = \
+            float(ssplit["round_weight_bytes"])
+        m["cost.spec_decode.round_hbm_bytes"] = \
+            float(ssplit["round_hbm_bytes"])
+        for a, slot in sorted(ssplit["per_acceptance"].items()):
+            m[f"cost.spec_decode.weight_bytes_per_token_a{a}"] = \
+                float(slot["weight_bytes_per_accepted_token"])
+            # same banding rationale as tp2.paged_decode above: the
+            # per-acceptance-point round time is a headline, not a hash
+            m[f"spec_decode.predicted_step_ms_a{a}"] = \
+                float(slot["predicted_step_ms"])
     return m
 
 
@@ -694,6 +763,25 @@ def _text_report(report: dict) -> str:
             f"  predicted step @ mesh tp: "
             f"{tsplit['predicted_step_ms_per_chip']:.3f} ms/chip "
             "(HBM-bound)")
+    ssplit = report.get("spec_decode_split")
+    if ssplit:
+        lines += [
+            "",
+            "speculative round, per-accepted-token weight stream "
+            f"(k={ssplit['k']}, round "
+            f"{_fmt_qty(ssplit['round_weight_bytes'], 'B')} weights):",
+        ]
+        for a, slot in sorted(ssplit["per_acceptance"].items(),
+                              key=lambda kv: int(kv[0])):
+            lines.append(
+                f"  a={a}: "
+                f"{_fmt_qty(slot['weight_bytes_per_accepted_token'], 'B')}"
+                f"/token, {slot['predicted_step_ms']:.3f} ms "
+                f"(non-spec {_fmt_qty(ssplit['target_weight_bytes'], 'B')}"
+                "/token)")
+        lines.append(
+            f"  breakeven acceptance: {ssplit['breakeven_acceptance']} "
+            "(docs/serving.md)")
     top = []
     for c in report["cases"]:
         for e in c["top_eqns"]:

@@ -168,6 +168,11 @@ _BENCH_FIELDS = (
     "gpt2_frontend_tpot_ms_p50", "gpt2_frontend_tpot_ms_p95",
     "gpt2_frontend_deadline_miss_rate", "prefix_hit_rate",
     "pump.bubble_ms", "jit.compiles",
+    # ISSUE 13: in-engine speculative decode + chunked-prefill TTFT
+    "mean_acceptance_len",
+    "gpt2_frontend_chunked_ttft_ms_p50", "gpt2_frontend_chunked_ttft_ms_p95",
+    "gpt2_frontend_monolithic_ttft_ms_p50",
+    "gpt2_frontend_monolithic_ttft_ms_p95",
 )
 
 

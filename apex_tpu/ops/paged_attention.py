@@ -7,15 +7,20 @@ static pool (``apex_tpu/serving/kv_pool.py``) instead of one contiguous
 allocated by actual length, freed pages are reusable the moment a request
 retires, and admission never reshapes anything.
 
-This kernel computes GQA attention for single-token (``s=1``) decode
-queries directly against the page pool. The block table rides in as a
+This kernel computes GQA attention for a small static block of ``s``
+decode queries per slot (``s=1`` is plain decode; ``s=k`` verifies a
+speculative draft chunk in one pass; ``s``-sized chunks carry interleaved
+prefill) directly against the page pool. The block table rides in as a
 SCALAR-PREFETCH operand (``pltpu.PrefetchScalarGridSpec``) so the k/v
 BlockSpec index maps resolve the physical page for grid step ``j`` —
 ``block_tables[b, j]`` — before the body runs: each (page_size, d) page
 tile is DMA'd HBM->VMEM exactly once, and the gather never materializes a
 contiguous copy of the sequence. Online softmax (m, l, acc) carries across
 the sequential page axis exactly like flash_attention's k-block axis; fp32
-scores and accumulation (same numerics contract).
+scores and accumulation (same numerics contract). The ``s`` queries of a
+slot occupy positions ``lengths[b] - s + i`` (``i`` in ``0..s-1``), so the
+causal/window mask is a per-query-position band — the grid, the page
+skip, and the softmax carry are untouched by the generalization.
 
 Layout: the pool is ``(num_pages, kv_heads, page_size, head_dim)`` — the
 page tile's minor two dims are then ``(page_size, head_dim)``, which
@@ -59,7 +64,7 @@ _INTERPRET = _dispatch.interpret
 
 def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *, scale, page_size, max_pages,
-                  window=None):
+                  s_q, rep, window=None):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -77,29 +82,35 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # reserved null page) — never read, so never wrong
     page_live = j * page_size < seq_len
     if window is not None:
-        # sliding-window band: the single query sits at position
-        # seq_len - 1 and attends (seq_len - 1 - window, seq_len - 1].
-        # A page whose LAST position is at or below the band floor is
-        # dead for this and every later step (the band only moves
-        # forward) — the serving engine drops such pages from the block
-        # table entirely (kv_pool.drop_slot_pages), and this gate skips
-        # whatever the dropped entry now points at (the null page)
+        # sliding-window band: query i of the block sits at position
+        # seq_len - s_q + i and attends (pos_i - window, pos_i]. A page
+        # whose LAST position is at or below the EARLIEST query's band
+        # floor (seq_len - s_q) - window is dead for every query in the
+        # block and every later step (the band only moves forward) — the
+        # serving engine drops such pages from the block table entirely
+        # (kv_pool.drop_slot_pages), and this gate skips whatever the
+        # dropped entry now points at (the null page)
         page_live = jnp.logical_and(
-            page_live, (j + 1) * page_size + window > seq_len)
+            page_live, (j + 1) * page_size + window + s_q - 1 > seq_len)
 
     @pl.when(page_live)
     def _body():
-        q = q_ref[0, 0]                                   # (rep, d)
+        q = q_ref[0, 0]                                   # (s_q*rep, d)
         k = k_ref[0, 0]                                   # (ps, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # (rep, ps)
+            preferred_element_type=jnp.float32) * scale   # (s_q*rep, ps)
         pos = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
-        live = pos < seq_len
+        # rows are position-major: row r is query position seq_len - s_q
+        # + r // rep (each query's rep GQA heads are adjacent rows)
+        qpos = (seq_len - s_q
+                + lax.broadcasted_iota(jnp.int32, s.shape, 0) // rep)
+        live = pos <= qpos
         if window is not None:
-            # positions inside the boundary page but below the band
-            # floor mask out — exactly cached_attention_rolling's band
-            live = jnp.logical_and(live, pos > seq_len - 1 - window)
+            # positions inside a live page but below a query's band
+            # floor mask out — exactly cached_attention_rolling's band,
+            # per query position
+            live = jnp.logical_and(live, pos > qpos - window)
         s = jnp.where(live, s, DEFAULT_MASK_VALUE)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -124,14 +135,24 @@ def _validate(q, k_pages, v_pages, block_tables, lengths, window=None):
     if window is not None and (not isinstance(window, int) or window < 1):
         raise ValueError(f"window must be a static positive int, got "
                          f"{window!r}")
-    if q.ndim != 4 or q.shape[2] != 1:
-        raise ValueError(f"q must be (batch, heads, 1, d) single-token "
-                         f"decode queries, got {q.shape}")
+    if q.ndim != 4:
+        raise ValueError(f"q must be (batch, heads, s, d) decode-block "
+                         f"queries, got {q.shape}")
     if k_pages.shape != v_pages.shape:
         raise ValueError(f"k_pages {k_pages.shape} != v_pages "
                          f"{v_pages.shape}")
     num_pages, kv, page_size, d = k_pages.shape
-    b, h, _, qd = q.shape
+    b, h, s_q, qd = q.shape
+    if not 1 <= s_q <= page_size:
+        # the block's s queries live inside the last ceil(s/ps)+1 pages;
+        # bounding s by the page size keeps the per-page band mask a
+        # single iota comparison and the VMEM q tile small. Larger
+        # chunks belong to the prefill path (flash attention), the same
+        # split cached_attention_rolling documents for the rolling cache
+        raise ValueError(
+            f"paged attention takes query blocks of 1..page_size "
+            f"({page_size}) positions per step, got s={s_q}; longer "
+            f"chunks must use the contiguous prefill path")
     if qd != d:
         raise ValueError(f"head_dim mismatch: q {qd} vs pages {d}")
     if h % kv != 0:
@@ -150,11 +171,15 @@ def _validate(q, k_pages, v_pages, block_tables, lengths, window=None):
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale: Optional[float] = None,
                     window: Optional[int] = None):
-    """Single-step GQA attention over a paged KV pool.
+    """Decode-block GQA attention over a paged KV pool.
 
     Args:
-      q: ``(batch, heads, 1, head_dim)`` — this step's queries, one token
-        per sequence slot.
+      q: ``(batch, heads, s, head_dim)`` — this step's query block,
+        ``s`` consecutive tokens per sequence slot (``1 <= s <=
+        page_size``; ``s=1`` is plain decode, ``s=k`` verifies a
+        speculative draft chunk, ``s``-sized chunks carry interleaved
+        prefill). Query ``i`` sits at absolute position
+        ``lengths[b] - s + i``.
       k_pages / v_pages: ``(num_pages, kv_heads, page_size, head_dim)``
         shared page pool (``kv_heads`` divides ``heads``; GQA never
         expands). Inside a tensor-parallel ``shard_map`` region both
@@ -165,30 +190,39 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         ``[j*page_size, (j+1)*page_size)``. Entries past a sequence's
         allocation must hold a VALID page id (the pool reserves page 0 as
         a null page) — they are fetched by the pipeline but never read.
-      lengths: int32 ``(batch,)`` — valid positions per slot INCLUDING the
-        current token (its K/V must already be written to the pool).
-        Length 0 (idle slot) outputs exactly 0.
+      lengths: int32 ``(batch,)`` — valid positions per slot INCLUDING
+        all ``s`` current tokens (their K/V must already be written to
+        the pool). Length 0 (idle slot) outputs exactly 0; a slot whose
+        length is shorter than ``s`` zeroes the leading (pre-sequence)
+        query rows.
       scale: softmax scale; default ``1/sqrt(head_dim)``.
       window: optional STATIC sliding-window band (Mistral-style): the
-        query at position ``lengths[b] - 1`` attends only positions
-        ``(lengths[b] - 1 - window, lengths[b] - 1]`` — the exact band
-        ``cached_attention``/``cached_attention_rolling`` mask, so a
-        windowed model's paged decode is token-identical to its
-        contiguous/rolling decode. Pages fully below the band skip their
-        FLOPs (and may be dropped from the block table entirely — the
-        serving engine's O(window)-HBM trick, ``kv_pool.drop_slot_pages``).
+        query at position ``p_i = lengths[b] - s + i`` attends only
+        positions ``(p_i - window, p_i]`` — the exact band
+        ``cached_attention``/``cached_attention_rolling`` mask applied
+        per query position, so a windowed model's paged decode is
+        token-identical to its contiguous/rolling decode. Pages fully
+        below every query's band skip their FLOPs (and may be dropped
+        from the block table entirely — the serving engine's
+        O(window)-HBM trick, ``kv_pool.drop_slot_pages``).
 
-    Returns ``(batch, heads, 1, head_dim)`` in ``q.dtype``.
+    Returns ``(batch, heads, s, head_dim)`` in ``q.dtype``.
     """
     _validate(q, k_pages, v_pages, block_tables, lengths, window)
     num_pages, kv, page_size, d = k_pages.shape
-    b, h = q.shape[0], q.shape[1]
+    b, h, s_q = q.shape[0], q.shape[1], q.shape[2]
     rep = h // kv
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
-    qr = q.reshape(b, kv, rep, d)
+    # position-major row layout: row i*rep + r is query position i of
+    # GQA group-member r, so the kernel recovers the position as
+    # row // rep with the group's rows adjacent (one contraction for
+    # all s*rep rows against the page tile — same dot shape as s=1,
+    # just taller)
+    qr = (q.reshape(b, kv, rep, s_q, d).transpose(0, 1, 3, 2, 4)
+          .reshape(b, kv, s_q * rep, d))
     bt = block_tables.astype(jnp.int32)
     ln = lengths.astype(jnp.int32)
 
@@ -196,33 +230,34 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         num_scalar_prefetch=2,
         grid=(b, kv, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 1, rep, d),
+            pl.BlockSpec((1, 1, s_q * rep, d),
                          lambda b, h, j, bt, ln: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, rep, d),
+        out_specs=pl.BlockSpec((1, 1, s_q * rep, d),
                                lambda b, h, j, bt, ln: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep, d), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
-            pltpu.VMEM((rep, 1), jnp.float32),
+            pltpu.VMEM((s_q * rep, d), jnp.float32),
+            pltpu.VMEM((s_q * rep, 1), jnp.float32),
+            pltpu.VMEM((s_q * rep, 1), jnp.float32),
         ],
     )
     out = _dispatch.pallas_call(
         functools.partial(_paged_kernel, scale=float(scale),
                           page_size=page_size, max_pages=max_pages,
-                          window=window),
+                          s_q=s_q, rep=rep, window=window),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kv, rep, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kv, s_q * rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET(),
     )(bt, ln, qr, k_pages, v_pages)
-    return out.reshape(b, h, 1, d)
+    return (out.reshape(b, kv, s_q, rep, d).transpose(0, 1, 3, 2, 4)
+            .reshape(b, h, s_q, d))
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
@@ -233,7 +268,7 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
     attention — O(batch * max_len) HBM, exactly what the kernel avoids."""
     _validate(q, k_pages, v_pages, block_tables, lengths, window)
     num_pages, kv, page_size, d = k_pages.shape
-    b, h = q.shape[0], q.shape[1]
+    b, h, s_q = q.shape[0], q.shape[1], q.shape[2]
     rep = h // kv
     max_pages = block_tables.shape[1]
     if scale is None:
@@ -245,17 +280,20 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
 
     k = contig(k_pages).astype(jnp.float32)
     v = contig(v_pages).astype(jnp.float32)
-    qf = q.reshape(b, kv, rep, d).astype(jnp.float32)
-    s = jnp.einsum("bkrd,bktd->bkrt", qf, k,
+    qf = q.reshape(b, kv, rep, s_q, d).astype(jnp.float32)
+    s = jnp.einsum("bkrsd,bktd->bkrst", qf, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
-    pos = jnp.arange(max_pages * page_size, dtype=jnp.int32)[None, None, None]
-    ln = lengths[:, None, None, None]
-    mask = pos < ln
+    pos = jnp.arange(max_pages * page_size, dtype=jnp.int32)[
+        None, None, None, None]                        # (1,1,1,1,T)
+    # query i of the block sits at absolute position lengths[b] - s + i
+    qpos = (lengths[:, None, None, None, None] - s_q
+            + jnp.arange(s_q, dtype=jnp.int32)[None, None, None, :, None])
+    mask = pos <= qpos
     if window is not None:
-        mask = jnp.logical_and(mask, pos > ln - 1 - window)
+        mask = jnp.logical_and(mask, pos > qpos - window)
     s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(mask, p, 0.0)  # length-0 rows: softmax(-inf row) -> NaN
-    ctx = jnp.einsum("bkrt,bktd->bkrd", p, v,
+    p = jnp.where(mask, p, 0.0)  # all-dead rows: softmax(-inf row) -> NaN
+    ctx = jnp.einsum("bkrst,bktd->bkrsd", p, v,
                      preferred_element_type=jnp.float32)
-    return ctx.reshape(b, h, 1, d).astype(q.dtype)
+    return ctx.reshape(b, h, s_q, d).astype(q.dtype)

@@ -221,7 +221,8 @@ class _Entry:
                  "deadline_at", "arrival", "seq", "resume", "prev",
                  "seg_tokens", "nodes", "n_private", "joined",
                  "first_token_seen", "tpot_slo", "deadline_missed",
-                 "win_dropped")
+                 "win_dropped", "prefilling", "pf_pos", "pf_key",
+                 "pf_samp0")
 
     def __init__(self, idx, handle, prompt, total_new, priority,
                  deadline_at, arrival, seq):
@@ -244,6 +245,10 @@ class _Entry:
         self.deadline_missed = False
         self.win_dropped = 0             # leading block-table entries
         #                                  already window-dropped
+        self.prefilling = False          # chunked prefill in progress
+        self.pf_pos = 0                  # prompt tokens fed so far
+        self.pf_key = None               # req_key held until decode joins
+        self.pf_samp0 = 0
 
     @property
     def s0(self) -> int:
@@ -464,12 +469,17 @@ class ServingFrontend:
         eng = self.engine
         bucket = prompt_bucket(s0, eng.page_size,
                                eng.cfg.max_position_embeddings)
+        if eng.draft_len:
+            return eng._spec_admit_fn(bucket), bucket
         return eng._admit_fn(bucket), bucket
 
     def decode_program(self):
         """The jitted ``sync_every``-step decode chunk the pump
-        dispatches (the engine's ``_step_fn`` — one program, shared)."""
-        return self.engine._step_fn()
+        dispatches (the engine's ``_step_fn`` — or its speculative twin
+        ``_spec_step_fn`` when the engine drafts — one program,
+        shared)."""
+        eng = self.engine
+        return eng._spec_step_fn() if eng.draft_len else eng._step_fn()
 
     # --- the pump -----------------------------------------------------------
 
@@ -524,7 +534,7 @@ class ServingFrontend:
         self._wait_s = 0.0
         self._drain_ingest()
         prev, self._inflight = self._inflight, None
-        if self._active:
+        if any(not e.prefilling for e in self._active.values()):
             # the device sat idle iff everything dispatched so far has
             # already completed: either nothing was in flight (the last
             # chunk's completion time is in _last_ready), or the chunk
@@ -544,6 +554,7 @@ class ServingFrontend:
         if prev is not None:
             self._harvest(prev)
         self._drop_window_pages()
+        self._advance_prefills()
         admitted = self._admission()
         if (self._pending and not self._active and self._inflight is None
                 and not admitted):
@@ -723,11 +734,21 @@ class ServingFrontend:
         self._C["busy_slot_steps"].inc(busy * eng.sync_every)
         self._C["decode_steps"].inc(eng.sync_every)
         t0 = self.clock()
-        (eng.cache, self._tok, self._done, self._n_left, self._samp_i,
-         toks) = eng._step_fn()(eng.cache, eng.variables, self._tok,
-                                self._done, self._n_left, self._req_keys,
-                                self._samp_i)
-        self._inflight = _Chunk(toks, self._chunk, t0)
+        if eng.draft_len:
+            # speculative chunk: the payload is (target predictions,
+            # per-slot per-round acceptance counts) — the harvest emits
+            # toks[r, slot, :counts[r, slot]]
+            (eng.cache, eng.draft_cache, self._tok, self._done,
+             self._n_left, toks, counts) = eng._spec_step_fn()(
+                eng.cache, eng.draft_cache, eng.variables,
+                eng.draft_variables, self._tok, self._done, self._n_left)
+            self._inflight = _Chunk((toks, counts), self._chunk, t0)
+        else:
+            (eng.cache, self._tok, self._done, self._n_left, self._samp_i,
+             toks) = eng._step_fn()(eng.cache, eng.variables, self._tok,
+                                    self._done, self._n_left,
+                                    self._req_keys, self._samp_i)
+            self._inflight = _Chunk(toks, self._chunk, t0)
         self.peak_slots = max(self.peak_slots, len(self._active))
         self._occ.set(len(self._active))
 
@@ -739,7 +760,9 @@ class ServingFrontend:
         measurement before unrelated host work can inflate it."""
         if chunk.toks_np is None:
             t_enter = self.clock()
-            chunk.toks_np = np.asarray(chunk.toks)
+            chunk.toks_np = (tuple(np.asarray(t) for t in chunk.toks)
+                             if isinstance(chunk.toks, tuple)
+                             else np.asarray(chunk.toks))
             chunk.t_done = self.clock()
             # the blocked span counts as device wait, not host work
             self._wait_s += chunk.t_done - t_enter
@@ -760,8 +783,15 @@ class ServingFrontend:
             # histogram keeps that wall time
             self._per_run["pump.dispatch_ready_ms"].append(chunk_ms)
         eos = eng.eos_token_id
+        spec = isinstance(toks_np, tuple)
+        if spec:
+            preds_np, counts_np = toks_np
         for slot in list(self._active):
             entry = self._active[slot]
+            if entry.prefilling:
+                continue                 # chunked prefill in progress —
+            #                             cancellation is handled by
+            #                             _advance_prefills
             if entry.handle.cancelled:
                 self._retire(slot, cancelled=True)
                 self._done = self._done.at[slot].set(True)
@@ -769,14 +799,33 @@ class ServingFrontend:
             if entry.joined > chunk.idx:
                 continue                 # admitted after this chunk ran
             finished = False
-            for t in toks_np[:, slot]:
-                t = int(t)
-                entry.seg_tokens.append(t)
-                entry.handle._push(t)
-                if ((eos is not None and t == eos)
-                        or entry.generated >= entry.total_new):
-                    finished = True
-                    break
+            if spec:
+                # per speculative round: the slot's first counts[r]
+                # target predictions were accepted+emitted on device
+                for r in range(preds_np.shape[0]):
+                    cnt = int(counts_np[r, slot])
+                    if cnt:
+                        self._C["spec_rounds"].inc()
+                        self._C["spec_tokens"].inc(cnt)
+                    for t in preds_np[r, slot, :cnt]:
+                        t = int(t)
+                        entry.seg_tokens.append(t)
+                        entry.handle._push(t)
+                        if ((eos is not None and t == eos)
+                                or entry.generated >= entry.total_new):
+                            finished = True
+                            break
+                    if finished:
+                        break
+            else:
+                for t in toks_np[:, slot]:
+                    t = int(t)
+                    entry.seg_tokens.append(t)
+                    entry.handle._push(t)
+                    if ((eos is not None and t == eos)
+                            or entry.generated >= entry.total_new):
+                        finished = True
+                        break
             if finished:
                 self._retire(slot)
                 self._done = self._done.at[slot].set(True)
@@ -830,12 +879,23 @@ class ServingFrontend:
         eng = self.engine
         if eng.prefix is None:
             eng.cache = eng._free_jit(eng.cache, jnp.int32(slot))
+            if eng.draft_len:
+                # the draft pool mirrors the target pool slot-for-slot
+                eng.draft_cache = eng._draft_free_jit(eng.draft_cache,
+                                                      jnp.int32(slot))
             return
-        # written K/V = prompt + every token fed while alive (all but the
-        # final sampled token); only full pages of that are shareable
-        written = entry.s0 + len(entry.seg_tokens) - 1
-        seq = np.concatenate(
-            [entry.prompt, np.asarray(entry.seg_tokens[:-1], np.int32)])
+        if entry.prefilling:
+            # a mid-prefill release (cancel/shutdown): only the chunks
+            # already fed are written — their full pages are cacheable
+            written = entry.pf_pos
+            seq = entry.prompt[:written]
+        else:
+            # written K/V = prompt + every token fed while alive (all but
+            # the final sampled token); only full pages are shareable
+            written = entry.s0 + len(entry.seg_tokens) - 1
+            seq = np.concatenate(
+                [entry.prompt, np.asarray(entry.seg_tokens[:-1],
+                                          np.int32)])
         row = np.asarray(eng.cache["block_tables"][slot])
         keep = eng.prefix.release_and_insert(seq, written, entry.nodes, row)
         eng.cache = eng._release_jit(eng.cache, jnp.int32(slot),
@@ -938,8 +998,11 @@ class ServingFrontend:
                                        eng.page_size)
         if need_total > kv_pool.num_pages_of(eng.cache) - 1:
             return False
-        victim_slot = self.policy.select_victim(candidate, self._active,
-                                                now)
+        # a mid-prefill slot has emitted nothing and holds no decode
+        # state to fold back — never a preemption victim
+        decoding = {s: e for s, e in self._active.items()
+                    if not e.prefilling}
+        victim_slot = self.policy.select_victim(candidate, decoding, now)
         if victim_slot is None:
             return False
         n_active = len(self._active)
@@ -1017,6 +1080,48 @@ class ServingFrontend:
         tr.event(idx, "admit", slot=slot, free_pages=free, cached_pages=m)
         req_key = jax.random.fold_in(eng.rng, idx)
         samp0 = len(entry.prev)          # resume continues the key stream
+        # chunked prefill (docs/frontend.md): instead of one monolithic
+        # contiguous prefill, allocate the pages now and feed the
+        # uncached tail through the paged s>1 path one
+        # ``prefill_chunk``-token piece per pump iteration, interleaved
+        # with decode chunks — a long prompt never blocks the running
+        # slots' next decode step. Short tails (<= one chunk) keep the
+        # monolithic path: one program call either way.
+        if (eng.prefill_chunk is not None and s0 - m * ps > eng.prefill_chunk
+                and s0 + eng.prefill_chunk - 1 <= max_pages * ps):
+            tr.begin(idx, "prefill", cached_tokens=m * ps,
+                     computed_tokens=s0 - m * ps, chunked=True)
+            if m == 0:
+                eng.cache = eng._chunk_alloc_jit(
+                    eng.cache, jnp.int32(slot), jnp.int32(need))
+            else:
+                self._C["prefix_hits"].inc()
+                row = np.zeros((max_pages,), np.int32)
+                row[:m] = [n.page for n in nodes]
+                eng.cache = eng._chunk_alloc_shared_jit(
+                    eng.cache, jnp.int32(slot), jnp.asarray(row),
+                    jnp.int32(m), jnp.int32(need))
+            self._C["admitted"].inc()
+            self._C["chunked_prefills"].inc()
+            self._C["prefill_tokens_total"].inc(s0)
+            self._C["prefill_tokens_computed"].inc(s0 - m * ps)
+            eng.events.emit("admit", request=idx, slot=slot,
+                            prompt_tokens=s0, cached_tokens=m * ps,
+                            priority=entry.priority, chunked=True)
+            entry.nodes = nodes
+            entry.n_private = need
+            entry.win_dropped = 0
+            entry.seg_tokens = []
+            entry.prefilling = True
+            entry.pf_pos = m * ps
+            entry.pf_key = req_key
+            entry.pf_samp0 = samp0
+            # no harvestable decode tokens until the prefill finishes
+            entry.joined = self._chunk + (1 << 30)
+            self._active[slot] = entry
+            self._pool_dirty = True
+            self._feed_chunk(slot, entry)    # first chunk rides now
+            return True
         # prefill span: covers the admission program AND the first-token
         # sync — its end IS the first token's arrival
         with tr.span(idx, "prefill", cached_tokens=m * ps,
@@ -1025,10 +1130,18 @@ class ServingFrontend:
                 admit_fn, bucket = self.admission_program(s0)
                 ids = np.zeros((1, bucket), np.int32)
                 ids[0, :s0] = prompt
-                eng.cache, tok0 = admit_fn(
-                    eng.cache, eng.variables, jnp.asarray(ids),
-                    jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
-                    req_key, jnp.int32(samp0))
+                if eng.draft_len:
+                    # speculative admission prefills the draft pool too
+                    eng.cache, eng.draft_cache, tok0 = admit_fn(
+                        eng.cache, eng.draft_cache, eng.variables,
+                        eng.draft_variables, jnp.asarray(ids),
+                        jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                        req_key, jnp.int32(samp0))
+                else:
+                    eng.cache, tok0 = admit_fn(
+                        eng.cache, eng.variables, jnp.asarray(ids),
+                        jnp.int32(s0), jnp.int32(slot), jnp.int32(need),
+                        req_key, jnp.int32(samp0))
             else:
                 self._C["prefix_hits"].inc()
                 t_start = m * ps
@@ -1079,6 +1192,94 @@ class ServingFrontend:
         self._samp_i = self._samp_i.at[slot].set(samp0 + 1)
         self._req_keys = self._req_keys.at[slot].set(req_key)
         return True
+
+    def _advance_prefills(self) -> bool:
+        """Feed ONE ``prefill_chunk``-token chunk to every mid-prefill
+        slot (an async dispatch each — interleaved on the device stream
+        with the in-flight decode chunk); finish the ones whose prompt
+        is exhausted into decoding slots. True when any slot advanced."""
+        if self.engine.prefill_chunk is None:
+            return False
+        advanced = False
+        for slot in list(self._active):
+            entry = self._active.get(slot)
+            if entry is None or not entry.prefilling:
+                continue
+            if entry.handle.cancelled:
+                self._abort_prefill(slot, entry)
+                continue
+            self._feed_chunk(slot, entry)
+            advanced = True
+        return advanced
+
+    def _feed_chunk(self, slot: int, entry: _Entry) -> None:
+        eng = self.engine
+        C = eng.prefill_chunk
+        t, s0 = entry.pf_pos, entry.s0
+        valid = min(C, s0 - t)
+        ids = np.zeros((1, C), np.int32)     # final chunk zero-pads
+        ids[0, :valid] = entry.prompt[t:t + valid]
+        eng.cache, tok = eng._prefill_chunk_fn()(
+            eng.cache, eng.variables, jnp.asarray(ids), jnp.int32(slot),
+            jnp.int32(valid), entry.pf_key, jnp.int32(entry.pf_samp0))
+        entry.pf_pos = t + valid
+        self._C["prefill_chunks"].inc()
+        if entry.pf_pos >= s0:
+            # the first-token sync below waits on the whole stream —
+            # stamp the in-flight decode chunk's completion first so
+            # decode_step_ms never charges prefill work to it
+            if self._inflight is not None:
+                self._materialize(self._inflight)
+            self._finish_prefill(slot, entry, int(tok))
+
+    def _finish_prefill(self, slot: int, entry: _Entry, tok0: int) -> None:
+        """The prompt's final chunk landed: sample arrived (``tok0`` off
+        the last valid logit), so run the same post-admission wiring the
+        monolithic path does and hand the slot to the decode chunk."""
+        eng = self.engine
+        tr = self.tracer
+        idx = entry.idx
+        entry.prefilling = False
+        tr.end(idx, "prefill")
+        if not entry.first_token_seen:
+            entry.first_token_seen = True
+            tr.event(idx, "first_token", slot=slot)
+            if (entry.deadline_at is not None
+                    and self.clock() > entry.deadline_at):
+                entry.deadline_missed = True
+                self._C["deadline_misses"].inc()
+                tr.event(idx, "deadline_miss")
+                eng.events.emit("deadline_miss", request=idx)
+        tr.begin(idx, "decode", slot=slot)
+        entry.seg_tokens = [tok0]
+        entry.joined = self._chunk + 1
+        entry.handle._push(tok0)
+        self._pool_dirty = True
+        if ((eng.eos_token_id is not None and tok0 == eng.eos_token_id)
+                or entry.seg_new == 1):
+            self._retire(slot)
+            return
+        self._tok = self._tok.at[slot].set(tok0)
+        self._done = self._done.at[slot].set(False)
+        self._n_left = self._n_left.at[slot].set(entry.seg_new - 1)
+        self._samp_i = self._samp_i.at[slot].set(entry.pf_samp0 + 1)
+        self._req_keys = self._req_keys.at[slot].set(entry.pf_key)
+
+    def _abort_prefill(self, slot: int, entry: _Entry) -> None:
+        """Cancellation mid-prefill: no decode state exists — release
+        the pages (full fed pages still cacheable) and finish the handle
+        with the earlier segments' tokens."""
+        eng = self.engine
+        self._active.pop(slot)
+        self._C["retired"].inc()
+        self.tracer.end(entry.idx, "prefill")
+        self.tracer.event(entry.idx, "retire", slot=slot,
+                          new_tokens=len(entry.prev), cancelled=True)
+        eng.events.emit("cancel", request=entry.idx, slot=slot,
+                        new_tokens=len(entry.prev))
+        self._release_pages(slot, entry)
+        self._pool_dirty = True
+        entry.handle._finish(np.asarray(entry.prev, np.int32))
 
     def _admission(self) -> int:
         """Fill vacant slots from the policy-ordered pending queue;
@@ -1187,6 +1388,14 @@ class ServingFrontend:
             "prefill_tokens_computed": int(d["prefill_tokens_computed"]),
             "prefill_tokens_skipped": int(d["prefill_tokens_total"]
                                           - d["prefill_tokens_computed"]),
+            # speculative decode: emitted tokens per verify round (1..k;
+            # > 1 means the draft is paying for itself)
+            "spec_rounds": int(d["spec_rounds"]),
+            "spec_tokens": int(d["spec_tokens"]),
+            "mean_acceptance_len": (d["spec_tokens"]
+                                    / max(d["spec_rounds"], 1)),
+            "chunked_prefills": int(d["chunked_prefills"]),
+            "prefill_chunks": int(d["prefill_chunks"]),
         }
         # pump pipeline attribution + the recompile window
         # (docs/frontend.md "Measuring the pump"): bubble is the mean

@@ -84,7 +84,9 @@ _RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
                  "prefill_tokens_computed", "evicted_pages",
                  "deferred_admissions", "defrag_runs",
                  "preemptions", "resumes", "deadline_misses",
-                 "tpot_slo_misses", "window_dropped_pages")
+                 "tpot_slo_misses", "window_dropped_pages",
+                 "spec_rounds", "spec_tokens", "chunked_prefills",
+                 "prefill_chunks")
 
 #: per-request latency histograms (``serving.<name>``, log-bucketed ms)
 _RUN_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "decode_step_ms")
@@ -230,6 +232,53 @@ def make_shared_admit(model, *, t_start: int, tail_bucket: int,
     return admit
 
 
+def make_prefill_chunk(model, *, chunk: int, first_token=None,
+                       axis_name: str = MODEL_AXIS):
+    """Build the chunked-prefill step program (one compile per engine;
+    also the ``tpu_aot.py`` sweep's chunked-prefill case).
+
+    One call pushes the next ``chunk`` prompt tokens of ONE slot through
+    the model's PAGED s>1 path: a slot view (the shared pools plus the
+    slot's own block-table row and length) rides ``model.apply`` exactly
+    like a decode step, so the chunk's K/V lands directly in the slot's
+    pages — no contiguous staging buffer, no scatter — and the per-query
+    causal band (``len - s + i``) keeps position ``i`` from seeing
+    positions beyond itself inside the chunk. The final chunk of a
+    prompt is zero-padded to ``chunk`` tokens; padding rows write
+    garbage K/V at positions >= the true length, which the length
+    update below never exposes (the causal band reads strictly below
+    ``len``, and the next chunk or first decode step overwrites them).
+
+    Returns ``prefill_step(cache, variables, ids, slot, valid, req_key,
+    samp0) -> (cache, tok0)``: ``ids`` is ``(1, chunk)``, ``valid`` the
+    chunk's true token count, and ``tok0`` the first-token sample off
+    logit ``valid - 1`` — meaningful only on the prompt's final chunk
+    (earlier chunks' tok0 is discarded by the frontend)."""
+    if chunk < 1:
+        raise ValueError("prefill chunk must be >= 1 token")
+    if first_token is None:
+        def first_token(last, _key, _samp0=0):
+            return _greedy_token(last, axis_name)
+
+    def prefill_step(cache, variables, ids, slot, valid, req_key, samp0):
+        view = {
+            "layers": cache["layers"],
+            "block_tables": lax.dynamic_slice_in_dim(
+                cache["block_tables"], slot, 1, axis=0),
+            "len": lax.dynamic_slice_in_dim(cache["len"], slot, 1, axis=0),
+        }
+        logits, view = model.apply(variables, ids, cache=view)
+        # advance by the TRUE token count, not the padded chunk width:
+        # padded positions stay above len and are never read
+        cache = dict(cache, layers=view["layers"],
+                     len=cache["len"].at[slot].add(valid))
+        last = lax.dynamic_slice_in_dim(logits, valid - 1, 1, axis=1)[:, 0]
+        tok0 = first_token(last, req_key, samp0)[0]
+        return cache, tok0
+
+    return prefill_step
+
+
 class PagedDecodeEngine:
     """Continuous-batching greedy/sampled decode over ``num_slots`` slots.
 
@@ -246,7 +295,9 @@ class PagedDecodeEngine:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, rng=None,
                  sync_every: int = 1, axis_name: str = MODEL_AXIS,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 draft_model=None, draft_variables=None, draft_len: int = 0,
+                 prefill_chunk: Optional[int] = None):
         cfg = model.config
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -282,6 +333,66 @@ class PagedDecodeEngine:
                 "they fall below the attention band, and a dropped page "
                 "cannot be shared radix-cache property (decode windowed "
                 "models with prefix_cache=False)")
+        # in-engine speculative decode (docs/serving.md): every engine
+        # step drafts ``draft_len`` tokens per slot through a small draft
+        # model's own paged pool and verifies the block in ONE
+        # s = draft_len + 1 paged target step — the s>1 kernel
+        # generalization is what makes the verify a single program at one
+        # shape. Acceptance is per-slot (continuous batching never stalls
+        # a slot on its neighbours' rejections, unlike lock-step
+        # ``speculative_generate``'s min-over-batch).
+        self.draft_model = draft_model
+        self.draft_variables = draft_variables
+        self.draft_len = draft_len
+        self.prefill_chunk = prefill_chunk
+        if draft_len < 0:
+            raise ValueError("draft_len must be >= 0")
+        if draft_len > 0:
+            if draft_model is None:
+                raise ValueError(
+                    "draft_len > 0 needs a draft_model (and its "
+                    "draft_variables) to propose tokens")
+            if temperature:
+                raise ValueError(
+                    "in-engine speculative decode is greedy-only: "
+                    "acceptance compares draft proposals against the "
+                    "target's greedy predictions (set temperature=0)")
+            if prefix_cache:
+                raise ValueError(
+                    "speculative decode does not compose with "
+                    "prefix_cache yet: shared pages would need a second "
+                    "refcounted draft-pool mirror (run one or the other)")
+            if self.window is not None or getattr(
+                    draft_model.config, "sliding_window", None) is not None:
+                raise ValueError(
+                    "speculative decode does not support sliding-window "
+                    "models: the frontend drops pages below the band, "
+                    "and the draft pool would need the same banded drop "
+                    "protocol (use a full-attention target and draft)")
+            if prefill_chunk is not None:
+                raise ValueError(
+                    "speculative decode and chunked prefill are mutually "
+                    "exclusive engine modes for now (pick one)")
+            if draft_len + 1 > page_size:
+                raise ValueError(
+                    f"draft_len + 1 = {draft_len + 1} exceeds the paged "
+                    f"kernel's query-block limit page_size={page_size}")
+        # chunked prefill (Sarathi-style): admission feeds long prompts
+        # through the PAGED path in fixed ``prefill_chunk``-token pieces
+        # interleaved with decode chunks, so a long prompt never
+        # monopolizes the device between two decode steps (TTFT tail)
+        if prefill_chunk is not None:
+            if not 1 <= prefill_chunk <= page_size:
+                raise ValueError(
+                    f"prefill_chunk must be in 1..page_size ({page_size}), "
+                    f"got {prefill_chunk}: chunks ride the paged kernel's "
+                    f"query block, which is capped at one page")
+            if self.window is not None:
+                raise ValueError(
+                    "chunked prefill does not support sliding-window "
+                    "models yet: in-progress chunks hold positions the "
+                    "window-page dropper would free mid-prefill (use "
+                    "monolithic admission for windowed models)")
         if max_pages_per_seq is None:
             max_pages_per_seq = kv_pool.cdiv(cfg.max_position_embeddings,
                                              page_size)
@@ -290,6 +401,12 @@ class PagedDecodeEngine:
             num_pages = 1 + num_slots * max_pages_per_seq
         self.cache = self._make_cache(num_slots, num_pages, page_size,
                                       max_pages_per_seq)
+        # the draft pool mirrors the target pool's geometry slot-for-slot
+        # and page-for-page: one allocation decision covers both
+        self.draft_cache = (self._make_cache(num_slots, num_pages,
+                                             page_size, max_pages_per_seq,
+                                             config=draft_model.config)
+                            if draft_len > 0 else None)
         # observability (docs/observability.md): a bounded postmortem
         # event ring for the engine's lifetime, and the last run's span
         # tracer (fresh per run; run(tracer=...) injects one). Every
@@ -305,7 +422,10 @@ class PagedDecodeEngine:
                        if prefix_cache else None)
         self._admit_jit = {}             # prompt bucket -> compiled admit
         self._shared_admit_jit = {}      # (t_start, tail_bucket) -> admit
+        self._spec_admit_jit = {}        # prompt bucket -> spec admit
         self._step_jit = None
+        self._spec_step_jit = None
+        self._chunk_jit = None
         donate = _donate_cache()
         self._free_jit = self._compile(
             kv_pool.free_slot, ("cache", "rep"), ("cache",), donate)
@@ -320,18 +440,53 @@ class PagedDecodeEngine:
         self._drop_jit = self._compile(
             kv_pool.drop_slot_pages, ("cache", "rep", "rep"), ("cache",),
             donate)
+        if draft_len > 0:
+            # draft-pool mirrors of the maintenance programs, compiled
+            # through the same seam under the draft roles so TP shards
+            # them with the DRAFT config's head count
+            self._draft_free_jit = self._compile(
+                kv_pool.free_slot, ("draft_cache", "rep"),
+                ("draft_cache",), donate)
+            self._draft_defrag_jit = self._compile(
+                kv_pool.defrag_map, ("draft_cache", "rep"),
+                ("draft_cache", "rep"), donate)
+        if prefill_chunk is not None:
+            # chunked admission allocates the slot's pages up front (the
+            # whole-prompt page need is known) but starts at len 0 —
+            # chunks advance len as they land; alloc_slot itself never
+            # touches len, so set it explicitly on both variants
+            def chunk_alloc(cache, slot, n_pages):
+                cache = kv_pool.alloc_slot(cache, slot, n_pages)
+                return dict(cache, len=cache["len"].at[slot].set(0))
+
+            def chunk_alloc_shared(cache, slot, shared_row, n_shared,
+                                   n_private):
+                ps = kv_pool.page_size_of(cache)
+                cache = kv_pool.alloc_slot_shared(cache, slot, shared_row,
+                                                  n_shared, n_private)
+                return dict(cache, len=cache["len"].at[slot].set(
+                    n_shared * ps))
+
+            self._chunk_alloc_jit = self._compile(
+                chunk_alloc, ("cache", "rep", "rep"), ("cache",), donate)
+            self._chunk_alloc_shared_jit = self._compile(
+                chunk_alloc_shared, ("cache",) + ("rep",) * 4, ("cache",),
+                donate)
 
     # --- compilation seams (overridden by serving/tp.py) --------------------
 
     def _make_cache(self, num_slots, num_pages, page_size,
-                    max_pages_per_seq):
-        """Allocate the engine's paged cache. The single-chip engine
+                    max_pages_per_seq, config=None):
+        """Allocate a paged cache for ``config`` (default: the target
+        model's — speculative engines call this a second time with the
+        draft model's config for the draft pool). The single-chip engine
         holds the whole pool on the default device;
         :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine`
         overrides this to allocate one GLOBAL pool whose K/V head axis
         is sharded over its ``tp`` mesh."""
         return kv_pool.init_paged_cache(
-            self.cfg, num_slots, num_pages=num_pages, page_size=page_size,
+            config if config is not None else self.cfg, num_slots,
+            num_pages=num_pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq)
 
     def _compile(self, fn, in_roles, out_roles, donate=()):
@@ -339,8 +494,10 @@ class PagedDecodeEngine:
 
         ``in_roles`` / ``out_roles`` name each positional argument /
         result of ``fn``: ``"cache"`` (the paged pool pytree),
-        ``"vars"`` (the model variables), ``"rep"`` (a replicated
-        host-side value — tokens, slot indices, masks, keys). The
+        ``"vars"`` (the model variables), ``"draft_cache"`` /
+        ``"draft_vars"`` (the speculative draft model's pool and
+        variables), ``"rep"`` (a replicated host-side value — tokens,
+        slot indices, masks, keys). The
         single-chip engine ignores the roles and plain-jits;
         :class:`~apex_tpu.serving.tp.TensorParallelPagedEngine` wraps
         ``fn`` in ``shard_map`` over its mesh with per-role
@@ -403,6 +560,53 @@ class PagedDecodeEngine:
                 _donate_cache())
         return self._shared_admit_jit[key]
 
+    def _prefill_chunk_fn(self):
+        """Compile (once): one ``prefill_chunk``-token chunk of one
+        slot's prompt through the paged s>1 path
+        (``make_prefill_chunk``)."""
+        if self._chunk_jit is None:
+            fn = make_prefill_chunk(self.model, chunk=self.prefill_chunk,
+                                    first_token=self._first_token,
+                                    axis_name=self.axis_name)
+            self._chunk_jit = self._compile(
+                fn, ("cache", "vars") + ("rep",) * 5, ("cache", "rep"),
+                _donate_cache())
+        return self._chunk_jit
+
+    def _spec_admit_fn(self, bucket: int):
+        """Compile (once per prompt bucket): the speculative twin of
+        ``_admit_fn`` — the same contiguous target prefill + scatter,
+        plus the SAME prompt prefilled through the draft model into the
+        draft pool (both pools share the slot's page indices, so one
+        alloc decision covers both). tok0 comes from the TARGET: the
+        emitted stream is always target-greedy."""
+        if bucket in self._spec_admit_jit:
+            return self._spec_admit_jit[bucket]
+        model, draft = self.model, self.draft_model
+
+        def admit(cache, dcache, variables, dvariables, ids, s0, slot,
+                  n_pages, req_key, samp0=0):
+            contig = init_cache(self.cfg, 1, bucket)
+            logits, contig = model.apply(variables, ids, cache=contig)
+            last = lax.dynamic_slice_in_dim(logits, s0 - 1, 1, axis=1)[:, 0]
+            cache = kv_pool.alloc_slot(cache, slot, n_pages)
+            cache = kv_pool.prefill_into_pages(cache, slot,
+                                               contig["layers"], s0)
+            contig_d = init_cache(draft.config, 1, bucket)
+            _, contig_d = draft.apply(dvariables, ids, cache=contig_d)
+            dcache = kv_pool.alloc_slot(dcache, slot, n_pages)
+            dcache = kv_pool.prefill_into_pages(dcache, slot,
+                                                contig_d["layers"], s0)
+            tok0 = self._first_token(last, req_key, samp0)[0]
+            return cache, dcache, tok0
+
+        donate = (0, 1) if jax.default_backend() == "tpu" else ()
+        fn = self._compile(
+            admit, ("cache", "draft_cache", "vars", "draft_vars")
+            + ("rep",) * 6, ("cache", "draft_cache", "rep"), donate)
+        self._spec_admit_jit[bucket] = fn
+        return fn
+
     # --- pool maintenance ---------------------------------------------------
 
     def _leak_suspected(self, free: int, active) -> bool:
@@ -428,6 +632,14 @@ class PagedDecodeEngine:
                                                jnp.asarray(extra))
         if self.prefix is not None:
             self.prefix.remap(np.asarray(new_idx))
+        if self.draft_len:
+            # the draft pool's alloc/free mirrors the target pool's
+            # call-for-call, so it fragments identically — compact it in
+            # the same maintenance pass (no prefix pages to pin: the
+            # spec engine refuses prefix_cache)
+            self.draft_cache, _ = self._draft_defrag_jit(
+                self.draft_cache,
+                jnp.asarray(np.zeros((num_pages,), bool)))
 
     def _step_fn(self):
         """Compile (once): ``sync_every`` decode steps as a ``lax.scan``
@@ -485,6 +697,97 @@ class PagedDecodeEngine:
             ("cache",) + ("rep",) * 5, _donate_cache())
         return self._step_jit
 
+    def _spec_step_fn(self):
+        """Compile (once): ``sync_every`` speculative rounds as a
+        ``lax.scan``. One round = ``draft_len`` single-token draft steps
+        over the draft pool, then ONE ``s = draft_len + 1`` paged target
+        step verifying the block, then a PER-SLOT rollback of both
+        pools to their accepted lengths.
+
+        Invariant carried between rounds (same as lock-step
+        ``speculative_generate``): each live slot holds a PENDING token
+        — emitted to the caller but in NEITHER cache. The round writes
+        it as the verify chunk's first position, so the chunk is
+        ``[pending, d1 .. d_{draft_len}]`` and the target's greedy
+        prediction at chunk position ``i`` continues the true prefix —
+        emitted tokens are exactly the target's sequential greedy
+        stream, token-identical to the non-speculative engine. Per-slot
+        acceptance ``e`` (1..k accepted tokens, 0 for done slots) rides
+        the scan output next to the predictions; both pools roll back
+        to ``len0 + e`` (chunk prefix kept, new pending token
+        ``preds[e-1]`` left unwritten — the invariant restored)."""
+        if self._spec_step_jit is not None:
+            return self._spec_step_jit
+        model, draft = self.model, self.draft_model
+        eos = self.eos_token_id
+        k = self.draft_len + 1
+        arange = jnp.arange(self.num_slots)
+
+        def one_round(variables, dvariables, carry, _):
+            cache, dcache, tok, done, n_left = carry
+            len0, dlen0 = cache["len"], dcache["len"]
+
+            def draft_step(dcarry, _):
+                dc, t_in = dcarry
+                lg, dc = draft.apply(dvariables, t_in[:, None], cache=dc)
+                nxt = _greedy_token(lg[:, 0], self.axis_name)
+                return (dc, nxt), t_in
+
+            # stacked INPUTS of k draft steps = [pending, d1..d_{k-1}]:
+            # the k-th draft output is never proposed, but its k cache
+            # writes are exactly the chunk — the draft pool stays in
+            # lock-step with the target pool through the shared rollback
+            (dcache, _), toks_in = lax.scan(draft_step, (dcache, tok),
+                                            None, length=k)
+            chunk = toks_in.transpose(1, 0)                  # (slots, k)
+
+            logits, cache = model.apply(variables, chunk, cache=cache)
+            preds = _greedy_token(logits, self.axis_name)    # (slots, k)
+            props = chunk[:, 1:]
+            # accepted proposals = longest matching prefix against the
+            # target's own predictions; +1 for the bonus target token
+            m = jnp.sum(jnp.cumprod(
+                (props == preds[:, :-1]).astype(jnp.int32), axis=1),
+                axis=1)
+            e = jnp.minimum(m + 1, n_left)
+            if eos is not None:
+                iseos = preds == eos
+                has_eos = jnp.any(iseos, axis=1)
+                eos_idx = jnp.argmax(iseos, axis=1)
+                # never emit past the first EOS prediction
+                e = jnp.minimum(e, jnp.where(has_eos, eos_idx + 1, k))
+            e = jnp.where(done, 0, e)
+            # per-slot rollback of BOTH pools: chunk[:e] stays, the new
+            # pending token preds[e-1] stays unwritten; done slots
+            # freeze at len0 (their forward wrote only above-len
+            # garbage, same as the non-speculative step's frozen slots)
+            cache = dict(cache, len=len0 + e)
+            dcache = dict(dcache, len=dlen0 + e)
+            fill = jnp.int32(eos if eos is not None else 0)
+            tok = jnp.where(done, fill,
+                            preds[arange, jnp.clip(e - 1, 0, k - 1)])
+            n_left = n_left - e
+            if eos is not None:
+                done = jnp.logical_or(
+                    done, jnp.logical_and(has_eos, e == eos_idx + 1))
+            done = jnp.logical_or(done, n_left <= 0)
+            return (cache, dcache, tok, done, n_left), (preds, e)
+
+        def step(cache, dcache, variables, dvariables, tok, done, n_left):
+            ((cache, dcache, tok, done, n_left),
+             (toks, counts)) = lax.scan(
+                functools.partial(one_round, variables, dvariables),
+                (cache, dcache, tok, done, n_left), None,
+                length=self.sync_every)
+            return cache, dcache, tok, done, n_left, toks, counts
+
+        donate = (0, 1) if jax.default_backend() == "tpu" else ()
+        self._spec_step_jit = self._compile(
+            step, ("cache", "draft_cache", "vars", "draft_vars")
+            + ("rep",) * 3,
+            ("cache", "draft_cache") + ("rep",) * 5, donate)
+        return self._spec_step_jit
+
     # --- the host scheduling loop -------------------------------------------
 
     def _validate_request(self, r: Request) -> None:
@@ -505,6 +808,25 @@ class PagedDecodeEngine:
             raise ValueError(
                 f"request needs more than max_pages_per_seq="
                 f"{max_pages} pages")
+        if self.draft_len:
+            # a speculative round may write up to draft_len tokens past
+            # the final emitted one before rollback discards them — the
+            # position table and block table must absorb the overshoot
+            # in BOTH models (mirrors speculative_generate's bound)
+            k = self.draft_len + 1
+            lim = min(cfg.max_position_embeddings,
+                      self.draft_model.config.max_position_embeddings)
+            if s0 + r.max_new_tokens + k > lim:
+                raise ValueError(
+                    f"prompt ({s0}) + max_new_tokens "
+                    f"({r.max_new_tokens}) + draft block ({k}) exceeds "
+                    f"max_position_embeddings={lim} under speculative "
+                    f"decode")
+            if kv_pool.pages_for(s0 + r.max_new_tokens + k, ps) > max_pages:
+                raise ValueError(
+                    f"request + draft-block overshoot needs more than "
+                    f"max_pages_per_seq={max_pages} pages under "
+                    f"speculative decode")
 
     def run(self, requests: Sequence[Request], *,
             tracer: Optional[SpanTracer] = None, policy=None):

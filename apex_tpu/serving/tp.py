@@ -259,14 +259,30 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
         self.abstract = bool(abstract) or not isinstance(self.mesh, Mesh)
         self._cache_specs = kv_pool.cache_specs(cfg, axis_name=axis)
         _, self._var_specs = infer_variable_specs(model, axis_name=axis)
+        # speculative decode: the draft pool and draft variables shard
+        # over the SAME mesh (the draft model's own head/column layout),
+        # so the s>1 verify and the draft loop run under one shard_map
+        draft = kwargs.get("draft_model")
+        self._draft_cache_specs = self._draft_var_specs = None
+        if draft is not None:
+            if draft.config.tensor_parallel_size != tp:
+                raise ValueError(
+                    f"draft model has tensor_parallel_size="
+                    f"{draft.config.tensor_parallel_size}, target has "
+                    f"{tp} — both must shard over the same mesh")
+            self._draft_cache_specs = kv_pool.cache_specs(draft.config,
+                                                          axis_name=axis)
+            _, self._draft_var_specs = infer_variable_specs(
+                draft, axis_name=axis)
         super().__init__(model, variables, **kwargs)
 
     # --- the two seams the base engine exposes -----------------------------
 
     def _make_cache(self, num_slots, num_pages, page_size,
-                    max_pages_per_seq):
+                    max_pages_per_seq, config=None):
         return kv_pool.init_paged_cache(
-            self.cfg, num_slots, num_pages=num_pages, page_size=page_size,
+            config if config is not None else self.cfg, num_slots,
+            num_pages=num_pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq, mesh=self.mesh,
             axis_name=self.axis_name, abstract=self.abstract)
 
@@ -282,7 +298,8 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
         kernels cannot run under the vma checker) asserts nothing
         false."""
         spec_of = {"cache": self._cache_specs, "vars": self._var_specs,
-                   "rep": P()}
+                   "draft_cache": self._draft_cache_specs,
+                   "draft_vars": self._draft_var_specs, "rep": P()}
         in_specs = tuple(spec_of[r] for r in in_roles)
         out_specs = tuple(spec_of[r] for r in out_roles)
         if len(out_specs) == 1:

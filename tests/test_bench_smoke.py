@@ -48,8 +48,9 @@ def test_bench_sharded_over_8_cpu_devices():
 
 
 def test_decode_bench_smoke_emits_json(tmp_path):
-    """tpu_decode_bench.py in smoke mode prints four parseable JSON
-    records (lock-step, paged, prefix-cached, async frontend), the paged
+    """tpu_decode_bench.py in smoke mode prints its parseable JSON
+    records (lock-step, paged, tp=2, prefix-cached, async frontend,
+    speculative, chunked-prefill TTFT A/B), the paged
     record carries the TTFT/decode-step percentile fields (ISSUE 4), the
     frontend record carries the open-loop TTFT/TPOT/deadline-miss fields
     with preemptions > 0 under the adversarial burst (ISSUE 6), and the
@@ -137,6 +138,38 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     assert fe["jit.compiles"] >= 0
     assert fe["jit.trace_cache_misses"] >= 0
     assert fe["tpot_slo_misses"] >= 0 and 0.0 <= fe["slo_burn"] <= 1.0
+
+    # the in-engine speculative record (ISSUE 13, docs/serving.md):
+    # throughput parses, the self-draft run actually ran speculative
+    # rounds, and acceptance telemetry exceeds 1 token per round —
+    # token identity against the plain paged engine is asserted inside
+    # the bench itself
+    sp = recs["gpt2_spec_decode_tokens_per_sec_per_chip"]
+    assert sp["value"] > 0
+    assert sp["unit"] == "tokens/s/chip"
+    assert sp["draft_len"] >= 1 and sp["self_draft"] is True
+    assert sp["spec_rounds"] >= 1
+    assert sp["spec_tokens"] >= sp["spec_rounds"]
+    assert sp["mean_acceptance_len"] > 1.0
+    assert sp["mean_acceptance_len"] <= sp["draft_len"] + 1
+    assert sp["generated_tokens"] > 0
+
+    # the chunked-prefill TTFT A/B (ISSUE 13, docs/frontend.md): both
+    # variants' percentile fields parse, the chunk path engaged on the
+    # long prompt (many chunks per chunked admission), and the bench
+    # itself asserted token identity between the two runs — the p95
+    # reduction is an on-chip number, not a CPU-smoke assert
+    cp = recs["gpt2_frontend_chunked_ttft_ms_p95"]
+    assert cp["value"] == cp["gpt2_frontend_chunked_ttft_ms_p95"]
+    assert cp["gpt2_frontend_chunked_ttft_ms_p50"] > 0
+    assert (cp["gpt2_frontend_chunked_ttft_ms_p95"]
+            >= cp["gpt2_frontend_chunked_ttft_ms_p50"])
+    assert cp["gpt2_frontend_monolithic_ttft_ms_p50"] > 0
+    assert (cp["gpt2_frontend_monolithic_ttft_ms_p95"]
+            >= cp["gpt2_frontend_monolithic_ttft_ms_p50"])
+    assert cp["prefill_chunk"] == cp["page_size"]
+    assert cp["chunked_prefills"] >= 1
+    assert cp["prefill_chunks"] > cp["chunked_prefills"]
 
     # the run_tpu_round.sh metrics artifact: a strict-JSON registry
     # snapshot holding the serving histograms

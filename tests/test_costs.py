@@ -241,6 +241,35 @@ def test_ledger_metrics_flatten(registry_report):
     assert all(isinstance(v, float) for v in m.values())
 
 
+def test_spec_decode_split_beats_decode_at_acceptance_two(
+        registry_report):
+    """ISSUE 13 acceptance: the speculative round's per-ACCEPTED-token
+    weight stream — (W_target + k * W_draft) / a off the registered
+    spec case's meta — drops below the non-speculative decode stream
+    (``cost.decode.weight_bytes_per_step``) at every acceptance length
+    a >= 2, and the exact/banded ledger metric pair is emitted."""
+    ssplit = registry_report["spec_decode_split"]
+    assert ssplit is not None
+    k = ssplit["k"]
+    assert k >= 2
+    non_spec = registry_report["decode_split"]["weight_bytes_per_step"]
+    assert ssplit["target_weight_bytes"] == non_spec
+    # the round streams the target once + the draft k times, exactly
+    assert ssplit["round_weight_bytes"] == (
+        ssplit["target_weight_bytes"] + k * ssplit["draft_weight_bytes"])
+    a1 = ssplit["per_acceptance"]["1"]
+    assert a1["weight_bytes_per_accepted_token"] > non_spec
+    for a in range(2, k + 1):
+        slot = ssplit["per_acceptance"][str(a)]
+        assert slot["weight_bytes_per_accepted_token"] < non_spec
+        assert slot["predicted_step_ms"] < a1["predicted_step_ms"]
+    assert ssplit["breakeven_acceptance"] == 2
+    m = costs.ledger_metrics(registry_report)
+    assert m["cost.spec_decode.weight_bytes_per_token_a2"] \
+        < m["cost.decode.weight_bytes_per_step"]
+    assert f"spec_decode.predicted_step_ms_a{k}" in m
+
+
 def test_cli_single_case_and_text_report(tmp_path, capsys):
     rc = costs.main(["--case", "layer_norm_fwd",
                      "--json", str(tmp_path / "r.json")])

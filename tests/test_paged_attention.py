@@ -116,8 +116,9 @@ def test_validation_errors(rng):
     bt = jnp.zeros((2, 2), jnp.int32)
     lens = jnp.zeros((2,), jnp.int32)
     good_q = jnp.zeros((2, 2, 1, d))
-    with pytest.raises(ValueError):      # multi-token chunk
-        paged_attention(jnp.zeros((2, 2, 3, d)), k_pages, v_pages, bt, lens)
+    with pytest.raises(ValueError):      # query block wider than a page
+        paged_attention(jnp.zeros((2, 2, ps + 1, d)), k_pages, v_pages,
+                        bt, lens)
     with pytest.raises(ValueError):      # heads not a kv multiple
         paged_attention(jnp.zeros((2, 3, 1, d)), k_pages, v_pages, bt, lens)
     with pytest.raises(ValueError):      # head_dim mismatch
@@ -191,3 +192,76 @@ def test_windowed_dropped_pages_leave_the_result_unchanged(rng):
     with pytest.raises(ValueError):      # non-static (array) window
         paged_attention(q, k_pages, v_pages, bt, lens,
                         window=jnp.int32(W))
+
+
+# --------------------------------------------------------------------------
+# s > 1 query blocks (ISSUE 13: speculative verify / chunked prefill)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("s_q", [2, 4, 8])
+def test_query_block_matches_reference(rng, s_q):
+    """The kernel generalized to a static query block: position ``i`` of
+    the block attends causally up to ``lengths[b] - s_q + i`` — parity
+    against the reference at every s, over boundary lengths including
+    ``len < s_q`` (admission never produces it, but the mask must stay
+    sane) and ``len = 0``."""
+    P, kv, ps, d, mp = 40, 2, 8, 16, 4
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    lens = jnp.asarray([s_q, ps, ps + 1, 2 * ps - 1, mp * ps,
+                        max(s_q - 1, 0), 0], jnp.int32)
+    b = lens.shape[0]
+    q = jnp.asarray(rng.standard_normal((b, 4, s_q, d)), jnp.float32)
+    bt = _tables(rng, b, mp, P)
+    out = np.asarray(paged_attention(q, k_pages, v_pages, bt, lens))
+    ref = np.asarray(paged_attention_reference(q, k_pages, v_pages, bt,
+                                               lens))
+    np.testing.assert_allclose(out, ref, **TOL)
+    assert out.shape == (b, 4, s_q, d)
+    assert (out[6] == 0).all()           # length 0 -> exactly zero block
+
+
+def test_query_block_gqa_matches_reference(rng):
+    """GQA grouping under an s=4 block: each kv head serves rep=3 query
+    heads at every block position."""
+    P, kv, h, ps, d, b, mp, s_q = 20, 2, 6, 8, 32, 2, 3, 4
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    q = jnp.asarray(rng.standard_normal((b, h, s_q, d)), jnp.float32)
+    bt = _tables(rng, b, mp, P)
+    lens = jnp.asarray([9, 24], jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, bt, lens)
+    ref = paged_attention_reference(q, k_pages, v_pages, bt, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
+def test_query_block_last_row_matches_s1(rng):
+    """Consistency across block widths: the LAST row of an s-block at
+    length t equals the s=1 call at length t (same query, same visible
+    set ``<= t - 1``) — the property that makes a chunked prefill's
+    final logit interchangeable with a decode step's."""
+    P, kv, ps, d, b, mp, s_q = 24, 2, 8, 16, 2, 3, 4
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    q = jnp.asarray(rng.standard_normal((b, 4, s_q, d)), jnp.float32)
+    bt = _tables(rng, b, mp, P)
+    lens = jnp.asarray([13, 2 * ps], jnp.int32)
+    block = np.asarray(paged_attention(q, k_pages, v_pages, bt, lens))
+    single = np.asarray(paged_attention(q[:, :, -1:], k_pages, v_pages,
+                                        bt, lens))
+    np.testing.assert_allclose(block[:, :, -1:], single, **TOL)
+
+
+def test_query_block_windowed_matches_reference(rng):
+    """The window band composes with s>1: block position ``i`` sees
+    exactly ``(qpos_i - W, qpos_i]`` — parity at a page-misaligned
+    window, including lengths inside the first window."""
+    P, kv, ps, d, mp, s_q = 40, 2, 8, 16, 4, 4
+    W = 11
+    k_pages, v_pages = _pool(rng, P, kv, ps, d)
+    lens = jnp.asarray([s_q, W, W + s_q, 2 * ps, mp * ps], jnp.int32)
+    b = lens.shape[0]
+    q = jnp.asarray(rng.standard_normal((b, 4, s_q, d)), jnp.float32)
+    bt = _tables(rng, b, mp, P)
+    out = np.asarray(paged_attention(q, k_pages, v_pages, bt, lens,
+                                     window=W))
+    ref = np.asarray(paged_attention_reference(q, k_pages, v_pages, bt,
+                                               lens, window=W))
+    np.testing.assert_allclose(out, ref, **TOL)

@@ -1,0 +1,295 @@
+"""In-engine speculative decode + chunked prefill (ISSUE 13).
+
+Spec tier: the engine's per-slot speculative rounds (``draft_len``
+proposals drafted through a second paged pool, verified in ONE
+``s = draft_len + 1`` paged target step) must be greedy token-identical
+to BOTH lock-step ``speculative_generate`` and the non-speculative
+engine — under full acceptance (self-draft), mixed accept/reject
+(unrelated random draft), a smaller-architecture draft, EOS landing
+inside a draft block, and preemption/resume mid-stream. Chunked tier:
+admission through fixed ``prefill_chunk``-token paged pieces must be
+token-identical to monolithic admission, scheduling-invariant across
+``sync_every``, and compose with the prefix cache (cached head pages +
+chunked tail). All tiny models run in f32, where the s>1 and s=1
+forwards agree exactly (the repo's chunked-verify exactness contract)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.generation import speculative_generate
+from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+from apex_tpu.serving import PagedDecodeEngine, Request, free_page_count
+from apex_tpu.serving.frontend import ServingFrontend
+from apex_tpu.serving.kv_pool import num_pages_of
+from apex_tpu.serving.policy import PriorityDeadlinePolicy
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    probe = jnp.zeros((1, 8), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), probe)
+    # an UNRELATED draft (same dims, different weights): low acceptance,
+    # every round exercises the reject/rollback path
+    draft = GPTModel(cfg)
+    dv = draft.init(jax.random.PRNGKey(99), probe)
+    # a smaller-architecture draft: the draft pool's head/width dims
+    # differ from the target pool's
+    scfg = dataclasses.replace(cfg, hidden_size=32, num_heads=2,
+                               num_layers=1)
+    small = GPTModel(scfg)
+    sv = small.init(jax.random.PRNGKey(5), probe)
+    return cfg, model, v, draft, dv, small, sv
+
+
+def _reqs(rng, sizes=((5, 6), (19, 6), (29, 6))):
+    return [Request(prompt=rng.integers(0, 128, s).astype(np.int32),
+                    max_new_tokens=m) for s, m in sizes]
+
+
+def _run(model, v, reqs, **kw):
+    eng = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                            sync_every=2, **kw)
+    return eng.run(reqs)
+
+
+@pytest.fixture(scope="module")
+def baseline(setup):
+    """One non-speculative monolithic reference run, shared by the spec
+    and chunked identity tests (every engine instance compiles its own
+    programs, so the shared baseline saves a full compile per test).
+    The workload mixes short prompts (monolithic fallback under
+    chunking) with multi-page ones."""
+    _, model, v, *_ = setup
+    reqs = _reqs(np.random.default_rng(7))
+    outs, _ = PagedDecodeEngine(model, v, num_slots=2,
+                                page_size=8).run(reqs)
+    return reqs, outs
+
+
+def test_spec_engine_identical_full_acceptance(setup, baseline):
+    """The correctness contract: the self-draft spec engine (every
+    proposal accepted) emits the target's greedy stream
+    request-for-request, across a ``sync_every`` change vs the
+    reference; telemetry shows multi-token rounds. (Rejecting drafts —
+    mixed acceptance, smaller architecture — ride the slow tier to
+    respect the tier-1 wall budget.)"""
+    _, model, v, *_ = setup
+    reqs, base = baseline
+    full, s_full = _run(model, v, reqs, draft_model=model,
+                        draft_variables=v, draft_len=3)
+    for a, b in zip(base, full):
+        np.testing.assert_array_equal(a, b)
+    # self-draft accepts every proposal except budget-clipped final
+    # rounds
+    assert s_full["mean_acceptance_len"] > 2.0
+    assert s_full["spec_rounds"] < s_full["spec_tokens"]
+
+
+@pytest.mark.slow
+def test_spec_engine_identical_mixed_acceptance(setup, baseline):
+    """An UNRELATED random draft (most proposals rejected) still emits
+    the target's greedy stream — the reject/rollback path is
+    token-exact — and the acceptance telemetry stays near the
+    one-token-per-round floor."""
+    _, model, v, draft, dv, *_ = setup
+    reqs, base = baseline
+    mixed, s_mixed = _run(model, v, reqs, draft_model=draft,
+                          draft_variables=dv, draft_len=3)
+    for a, c in zip(base, mixed):
+        np.testing.assert_array_equal(a, c)
+    # a random draft on a random target accepts ~none: every round
+    # still banks the verify step's own token (the floor is 1.0)
+    assert 1.0 <= s_mixed["mean_acceptance_len"] < 2.0
+
+
+@pytest.mark.slow
+def test_spec_engine_matches_lockstep_speculative_generate(setup, rng):
+    """Same-length prompts run through lock-step
+    ``speculative_generate`` (min-over-batch acceptance) and the engine
+    (per-slot acceptance): both are exactly target-greedy, so the token
+    streams agree even though the round boundaries differ."""
+    cfg, model, v, draft, dv, _, _ = setup
+    prompts = rng.integers(0, cfg.vocab_size, (3, 9)).astype(np.int32)
+    ref = np.asarray(speculative_generate(
+        model, v, draft, dv, jnp.asarray(prompts), max_new_tokens=10,
+        k=3))[:, 9:]
+    outs, _ = _run(model, v,
+                   [Request(prompt=p, max_new_tokens=10) for p in prompts],
+                   draft_model=draft, draft_variables=dv, draft_len=2)
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(ref[i], np.asarray(out))
+
+
+@pytest.mark.slow
+def test_spec_eos_inside_draft_block(setup, rng):
+    """EOS predicted mid-block: emission stops AT the EOS (never past
+    it), matching the non-speculative engine's stream exactly."""
+    cfg, model, v, _, _, _, _ = setup
+    prompt = rng.integers(0, cfg.vocab_size, (5,)).astype(np.int32)
+    base, _ = _run(model, v, [Request(prompt=prompt, max_new_tokens=12)])
+    # pick the 4th greedy token as EOS — it lands inside the first
+    # self-draft block of 4 (k = draft_len + 1)
+    eos = int(base[0][3])
+    r = [Request(prompt=prompt, max_new_tokens=12)]
+    o_spec, _ = _run(model, v, r, eos_token_id=eos, draft_model=model,
+                     draft_variables=v, draft_len=3)
+    o_base, _ = _run(model, v, r, eos_token_id=eos)
+    np.testing.assert_array_equal(o_spec[0], o_base[0])
+    assert int(o_spec[0][-1]) == eos and len(o_spec[0]) == 4
+
+
+@pytest.mark.slow
+def test_spec_preemption_resumes_token_identical(setup, rng):
+    """A speculative slot preempted mid-stream (both pools released,
+    discard-and-recompute resume — the spec engine refuses the prefix
+    cache) must still emit the uninterrupted greedy stream, and the
+    pool must drain clean."""
+    cfg, model, v, _, _, small, sv = setup
+    lo = Request(prompt=rng.integers(0, 128, 9).astype(np.int32),
+                 max_new_tokens=16, priority=0)
+    hi = Request(prompt=rng.integers(0, 128, 4).astype(np.int32),
+                 max_new_tokens=6, priority=5)
+    base, _ = PagedDecodeEngine(model, v, num_slots=1,
+                                page_size=8).run([lo, hi])
+    eng = PagedDecodeEngine(model, v, num_slots=1, page_size=8,
+                            draft_model=small, draft_variables=sv,
+                            draft_len=2)
+    fe = ServingFrontend(
+        eng, policy=PriorityDeadlinePolicy(preempt_on_priority=True))
+    h_lo = fe.submit(lo, request_id=0)
+    fe.pump()
+    fe.pump()                      # lo is mid-draft when hi arrives
+    h_hi = fe.submit(hi, request_id=1)
+    fe.drain()
+    stats = fe.stats()
+    assert stats["preemptions"] >= 1 and stats["resumes"] >= 1
+    np.testing.assert_array_equal(np.asarray(h_lo.result(timeout=0)),
+                                  base[0])
+    np.testing.assert_array_equal(np.asarray(h_hi.result(timeout=0)),
+                                  base[1])
+    # both pools fully drained (the zero-leak contract covers the twin)
+    assert int(free_page_count(eng.cache)) == num_pages_of(eng.cache) - 1
+    assert int(free_page_count(eng.draft_cache)) == \
+        num_pages_of(eng.draft_cache) - 1
+
+
+def test_spec_engine_refuses_invalid_modes(setup):
+    cfg, model, v, draft, dv, _, _ = setup
+    mk = lambda **kw: PagedDecodeEngine(model, v, num_slots=1,
+                                        page_size=8, **kw)
+    with pytest.raises(ValueError, match="draft_model"):
+        mk(draft_len=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        mk(draft_model=draft, draft_variables=dv, draft_len=2,
+           temperature=0.5, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="prefix_cache"):
+        mk(draft_model=draft, draft_variables=dv, draft_len=2,
+           prefix_cache=True)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        mk(draft_model=draft, draft_variables=dv, draft_len=2,
+           prefill_chunk=8)
+    with pytest.raises(ValueError, match="query-block limit"):
+        mk(draft_model=draft, draft_variables=dv, draft_len=8)
+    with pytest.raises(ValueError, match="1..page_size"):
+        mk(prefill_chunk=9)
+
+
+def test_windowed_models_refuse_spec_and_chunked(setup):
+    """Sliding-window models either get the s>1 band (the kernel has
+    it) or the ENGINE modes refuse by name — never a silent wrong-mask
+    path through the frontend."""
+    from apex_tpu.models.llama import LlamaModel, llama_tiny_config
+    _, _, _, _, _, small, sv = setup
+    wcfg = dataclasses.replace(llama_tiny_config(), sliding_window=6)
+    wm = LlamaModel(wcfg)
+    wv = wm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    with pytest.raises(ValueError, match="sliding-window"):
+        PagedDecodeEngine(wm, wv, num_slots=1, page_size=8,
+                          draft_model=small, draft_variables=sv,
+                          draft_len=2)
+    with pytest.raises(ValueError, match="sliding-window"):
+        PagedDecodeEngine(wm, wv, num_slots=1, page_size=8,
+                          prefill_chunk=8)
+
+
+def test_spec_validate_request_draft_overshoot(setup, rng):
+    """The draft block's position/page overshoot bound is enforced at
+    submit time for BOTH configs."""
+    cfg, model, v, _, _, small, sv = setup
+    eng = PagedDecodeEngine(model, v, num_slots=1, page_size=8,
+                            draft_model=small, draft_variables=sv,
+                            draft_len=2)
+    prompt = rng.integers(0, 128, 8).astype(np.int32)
+    # fits without the draft block, overflows with it
+    over = cfg.max_position_embeddings - prompt.shape[0] - 1
+    with pytest.raises(ValueError, match="draft block"):
+        eng._validate_request(Request(prompt=prompt, max_new_tokens=over))
+
+
+def test_chunked_prefill_identical_and_sync_invariant(setup, baseline):
+    """Chunked admission is token-identical to monolithic admission for
+    every request — with the two engines at different ``sync_every``
+    settings, so the same A/B pins scheduling invariance — and short
+    prompts fall back to the monolithic path inside the same engine."""
+    _, model, v, *_ = setup
+    reqs, base = baseline
+    # the monolithic reference runs at sync_every=1, the chunked engine
+    # at sync_every=3 — one A/B covers both the admission mode and the
+    # chunk cadence (the slow-tier composition test runs chunking at
+    # sync_every=1 again)
+    eng = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                            sync_every=3, prefill_chunk=8)
+    outs, stats = eng.run(reqs)
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(a, b)
+    # the 5-token prompt rode the monolithic path; the rest chunked
+    assert stats["chunked_prefills"] == 2
+    assert stats["prefill_chunks"] > stats["chunked_prefills"]
+    assert int(free_page_count(eng.cache)) == \
+        num_pages_of(eng.cache) - 1
+
+
+@pytest.mark.slow
+def test_chunked_prefill_composes_with_prefix_cache(setup, rng):
+    """A prefix-cache hit admits the cached head as shared pages and
+    chunks only the uncached tail — token-identical to the cache-off
+    monolithic engine."""
+    cfg, model, v, _, _, _, _ = setup
+    shared = rng.integers(0, 128, 24).astype(np.int32)
+    reqs = [Request(prompt=np.concatenate(
+        [shared, rng.integers(0, 128, 13).astype(np.int32)]),
+        max_new_tokens=6) for _ in range(4)]
+    base, _ = PagedDecodeEngine(model, v, num_slots=2,
+                                page_size=8).run(reqs)
+    eng = PagedDecodeEngine(model, v, num_slots=2, page_size=8,
+                            prefix_cache=True, prefill_chunk=8)
+    outs, stats = eng.run(reqs)
+    for a, b in zip(base, outs):
+        np.testing.assert_array_equal(a, b)
+    assert stats["prefix_hits"] >= 1
+    assert stats["chunked_prefills"] >= 1
+    assert stats["prefill_tokens_skipped"] > 0
+
+
+def test_chunked_prefill_cancel_mid_prefill_frees_pages(setup, rng):
+    """Cancellation between chunks aborts the prefill cleanly: the
+    handle finishes empty and every page returns to the stack."""
+    cfg, model, v, _, _, _, _ = setup
+    req = Request(prompt=rng.integers(0, 128, 61).astype(np.int32),
+                  max_new_tokens=6)
+    eng = PagedDecodeEngine(model, v, num_slots=1, page_size=8,
+                            prefill_chunk=8)
+    fe = ServingFrontend(eng)
+    handle = fe.submit(req, request_id=0)
+    fe.pump()
+    fe.pump()                        # a few chunks in, far from done
+    handle.cancel()
+    fe.drain()
+    assert len(handle.result(timeout=0)) == 0
+    assert int(free_page_count(eng.cache)) == num_pages_of(eng.cache) - 1

@@ -315,6 +315,16 @@ def kernel_cases():
             _sds((513, 12, 16, 64), bf16), _sds((8, 32), i32),
             _sds((8,), i32)])
 
+    # -- the s>1 query-block generalization (ISSUE 13): the speculative
+    # verify step reads a 4-token block (draft_len 3 + 1 pending) per
+    # slot through the SAME kernel — the per-row causal band
+    # (len - s + i) is the only new Mosaic surface, so one s=4 case
+    # gates it at the gpt2s pool shape.
+    yield ("gpt2s_paged_spec_verify", paged_attention,
+           [_sds((8, 12, 4, 64), bf16), _sds((513, 12, 16, 64), bf16),
+            _sds((513, 12, 16, 64), bf16), _sds((8, 32), i32),
+            _sds((8,), i32)])
+
     # -- serving path (r5): tpu_decode_bench.py's exact programs — flash
     # prefill + lax.scan single-token decode + argmax, GPT-2 small at the
     # bench config (batch 8, prompt 128, 128 new tokens, bf16), fp AND
@@ -368,6 +378,18 @@ def kernel_cases():
            [pcache_abs, dvars, _sds((1, 128), i32), _sds((), i32),
             _sds((), i32), _sds((pc_max_pages,), i32), _sds((), i32),
             _sds((2,), jnp.uint32)])
+
+    # -- chunked-prefill step (ISSUE 13): one 16-token prompt chunk of
+    # one slot rides the paged s>1 path straight into the slot's pages
+    # (no contiguous staging, no scatter) — the program the frontend
+    # interleaves between decode chunks to bound TTFT.
+    from apex_tpu.serving.scheduler import make_prefill_chunk
+
+    chunk_step = make_prefill_chunk(dmodel, chunk=16, axis_name="unbound")
+
+    yield ("gpt2s_chunked_prefill_step", chunk_step,
+           [pcache_abs, dvars, _sds((1, 16), i32), _sds((), i32),
+            _sds((), i32), _sds((2,), jnp.uint32), _sds((), i32)])
 
 
 def tight_headdim_cases():
