@@ -323,6 +323,60 @@ def _build_admit_bucketed() -> CaseProgram:
                        variants=[args_for(93)], max_traces=1)
 
 
+def _build_int8kv_engine_program(kind: str) -> CaseProgram:
+    """The QUANTIZED-KV engine programs (docs/serving.md "Quantized KV
+    pages"): the ``sync_every``-step decode chunk and the bucketed
+    admission over an int8 page pool — the decode chunk stages the
+    paged kernel WITH its per-(page, kv_head) scale operands and
+    in-kernel dequant, the admission the quantize-on-write prefill
+    scatter. Same compile-key contract as the fp cases (two same-bucket
+    admission variants, ``max_traces=1``); ``obs/costs.py`` reads the
+    decode chunk's abstract pool to price the narrow KV stream
+    (``cost.decode.int8_kv.*``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving.scheduler import (PagedDecodeEngine,
+                                            prompt_bucket)
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, sync_every=4,
+                               kv_dtype="int8")
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    if kind == "decode":
+        args = (cache_abs, dvars,
+                jax.ShapeDtypeStruct((4,), i32),           # tok
+                jax.ShapeDtypeStruct((4,), jnp.bool_),     # done
+                jax.ShapeDtypeStruct((4,), i32),           # n_left
+                jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
+                jax.ShapeDtypeStruct((4,), i32))           # samp_i
+        return CaseProgram(fn=engine._step_fn(), args=args)
+    assert kind == "admit"
+
+    def args_for(s0: int) -> tuple:
+        bucket = prompt_bucket(s0, engine.page_size,
+                               cfg.max_position_embeddings)
+        return (cache_abs, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
+    bucket = prompt_bucket(90, engine.page_size,
+                           cfg.max_position_embeddings)
+    return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(90),
+                       variants=[args_for(93)], max_traces=1)
+
+
 def _build_frontend_program(kind: str) -> CaseProgram:
     """The serving FRONT-END's programs, bound through its own accessors
     (``ServingFrontend.admission_program`` / ``decode_program``) rather
@@ -422,7 +476,7 @@ def _build_llama_windowed_program(kind: str) -> CaseProgram:
                        variants=[args_for(22)], max_traces=1)
 
 
-def _build_tp_engine_program(kind: str) -> CaseProgram:
+def _build_tp_engine_program(kind: str, kv_dtype=None) -> CaseProgram:
     """The TENSOR-PARALLEL serving programs (serving/tp.py,
     docs/tp_serving.md): the tp=2 engine's shard_map-wrapped admission
     and ``sync_every``-step decode chunk, traced over a deviceless
@@ -447,7 +501,8 @@ def _build_tp_engine_program(kind: str) -> CaseProgram:
     model = GPTModel(cfg)
     engine = TensorParallelPagedEngine(
         model, variables=None, mesh=abstract_tp_mesh(tp), num_slots=4,
-        page_size=16, num_pages=33, max_pages_per_seq=16, sync_every=4)
+        page_size=16, num_pages=33, max_pages_per_seq=16, sync_every=4,
+        kv_dtype=kv_dtype)
     dvars, var_specs = infer_variable_specs(model)
 
     def _bytes(leaf):
@@ -557,6 +612,15 @@ def analysis_cases(root) -> List[AnalysisCase]:
     cases.append(AnalysisCase(
         "tp2_engine_admit_bucketed", "serving",
         lambda: _build_tp_engine_program("admit")))
+    cases.append(AnalysisCase(
+        "gpt2s_int8kv_engine_decode_chunk", "serving",
+        lambda: _build_int8kv_engine_program("decode")))
+    cases.append(AnalysisCase(
+        "gpt2s_int8kv_engine_admit_bucketed", "serving",
+        lambda: _build_int8kv_engine_program("admit")))
+    cases.append(AnalysisCase(
+        "tp2_int8kv_engine_decode_chunk", "serving",
+        lambda: _build_tp_engine_program("decode", kv_dtype="int8")))
     cases.append(AnalysisCase(
         "optim_sgd_momentum_buffer", "optimizers",
         lambda: _build_optimizer_update("sgd")))

@@ -52,6 +52,7 @@ from jax import lax
 
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import MODEL_AXIS
+from apex_tpu.ops import quant
 from apex_tpu.transformer.tensor_parallel.mappings import (
     axis_is_bound as _axis_bound,
     gather_from_tensor_model_parallel_region,
@@ -170,6 +171,52 @@ def update_layer_cache(lc, k_chunk, v_chunk):
     return out
 
 
+def _append_quantized_pages(pages, scales, chunk, bt, t, ps, max_pages,
+                            qmax):
+    """Quantized-pool append with REQUANTIZE-ON-GROW (docs/serving.md
+    "Quantized KV pages"): the ``s <= page_size`` chunk spans at most the
+    boundary page and its successor, so two sequential rounds each (1)
+    take the per-(slot, kv_head) amax of the new tokens landing in that
+    page, (2) grow the page's symmetric scale monotonically
+    (``new = max(old, amax/qmax)``), (3) rescale the page's EXISTING
+    quantized contents onto the grown grid (ratio 1 — the common case —
+    is a bit-exact rewrite), and (4) merge the new tokens quantized at
+    the new scale. Only pages at or past ``len // page_size`` are ever
+    touched, so full pages — the prefix cache's sharing unit and the
+    preemption spill set — stay bit-stable forever."""
+    slots, kvh, s, d = chunk.shape
+    cf = chunk.astype(jnp.float32)
+    pos = t[:, None] + jnp.arange(s, dtype=t.dtype)[None, :]  # (slots, s)
+    base = t // ps
+    sl = jnp.arange(slots)
+    for j in (0, 1):
+        ent = base + j
+        pg = jnp.take_along_axis(
+            bt, jnp.clip(ent, 0, max_pages - 1)[:, None], axis=1)[:, 0]
+        in_pg = (pos // ps) == ent[:, None]                  # (slots, s)
+        has = in_pg.any(axis=1)
+        amax = jnp.where(in_pg[:, None, :, None], jnp.abs(cf), 0.0
+                         ).max(axis=(2, 3))                  # (slots, kv)
+        old = scales[pg]
+        new = jnp.where(has[:, None], jnp.maximum(old, amax / qmax), old)
+        ratio = jnp.where(new > 0, old / jnp.maximum(new, 1e-30), 0.0)
+        tile = pages[pg].astype(jnp.float32) * ratio[:, :, None, None]
+        tile_q = quant.kv_cast(tile, pages.dtype, qmax)
+        inv = jnp.where(new > 0, 1.0 / jnp.maximum(new, 1e-30), 0.0)
+        qtok = quant.kv_cast(cf * inv[:, :, None, None], pages.dtype,
+                             qmax)
+        # members scatter at their in-page offset; non-members drop at
+        # the out-of-range offset ps
+        off = jnp.where(in_pg, pos % ps, ps)                 # (slots, s)
+        tile_q = tile_q.at[sl[:, None], :, off, :].set(
+            qtok.transpose(0, 2, 1, 3), mode="drop")
+        # distinct live slots own distinct pages; idle/done rows collide
+        # only on the garbage null page 0, which no live slot reads
+        pages = pages.at[pg].set(tile_q)
+        scales = scales.at[pg].set(new)
+    return pages, scales
+
+
 def update_paged_layer_cache(lc, k_chunk, v_chunk):
     """Write an ``(slots, kv, s, d)`` K/V chunk into the page pool at each
     slot's current length: slot ``b``'s chunk position ``i`` lands in page
@@ -178,16 +225,30 @@ def update_paged_layer_cache(lc, k_chunk, v_chunk):
     slot's ``s`` positions are distinct ``(page, offset)`` pairs (callers
     keep ``s <= page_size``, the paged kernel's own bound), so the scatter
     indices never collide; an idle slot (block table row all null-page)
-    writes into the reserved page 0, which no live sequence ever reads."""
+    writes into the reserved page 0, which no live sequence ever reads.
+
+    A QUANTIZED pool (``k_scales`` in the layer view) quantizes on write:
+    the chunk's pages requantize-on-grow through
+    :func:`_append_quantized_pages`, and the per-page scales ride the
+    layer view back to the model's ``paged_attention`` call."""
     ps = lc["k_pages"].shape[2]
     max_pages = lc["block_tables"].shape[1]
     s = k_chunk.shape[2]
     t = lc["len"]                                            # (slots,)
+    out = dict(lc)
+    if "k_scales" in lc:
+        qmax = quant.kv_qmax(lc["k_pages"].dtype)
+        out["k_pages"], out["k_scales"] = _append_quantized_pages(
+            lc["k_pages"], lc["k_scales"], k_chunk, lc["block_tables"],
+            t, ps, max_pages, qmax)
+        out["v_pages"], out["v_scales"] = _append_quantized_pages(
+            lc["v_pages"], lc["v_scales"], v_chunk, lc["block_tables"],
+            t, ps, max_pages, qmax)
+        return out
     pos = t[:, None] + jnp.arange(s, dtype=t.dtype)[None, :]  # (slots, s)
     page = jnp.take_along_axis(
         lc["block_tables"], jnp.clip(pos // ps, 0, max_pages - 1), axis=1)
     off = pos % ps
-    out = dict(lc)
     # advanced-index dims lead: [page, :, off, :] scatters (slots, s)
     # index pairs over (kv, d) tiles — values arrive position-major
     out["k_pages"] = lc["k_pages"].at[page, :, off, :].set(
@@ -412,7 +473,7 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
              rng=None, eos_token_id: Optional[int] = None,
              axis_name: str = MODEL_AXIS, paged: bool = False,
              num_slots: Optional[int] = None, page_size: int = 16,
-             prefix_cache: bool = False):
+             prefix_cache: bool = False, kv_dtype=None):
     """Prefill the prompt (flash-kernel path), then scan ``max_new_tokens``
     single-token decode steps. Returns ``(batch, prompt_len +
     max_new_tokens)`` token ids (prompt included). After ``eos_token_id``
@@ -431,10 +492,18 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
     token-identical to the lock-step scan. ``prefix_cache=True`` (paged
     only) additionally shares cached K/V pages across requests with a
     common prompt prefix — same outputs, prefill skipped for the shared
-    pages (``apex_tpu/serving/prefix_cache.py``)."""
+    pages (``apex_tpu/serving/prefix_cache.py``). ``kv_dtype`` (paged
+    only) stores the pool's K/V pages quantized (``"int8"`` or
+    ``"fp8"``/``"e4m3"``) with per-(page, kv_head) scales, dequantized
+    inside the paged kernel — greedy output then matches the fp pool to
+    tolerance, not bit-exactly (docs/serving.md "Quantized KV pages")."""
     if prefix_cache and not paged:
         raise ValueError("prefix_cache requires paged=True (sharing lives "
                          "in the page pool)")
+    if kv_dtype is not None and not paged:
+        raise ValueError("kv-dtype-unsupported: kv_dtype requires "
+                         "paged=True (quantized K/V lives in the page "
+                         "pool; the lock-step cache is full-precision)")
     if paged:
         from apex_tpu.serving import generate_paged
 
@@ -448,7 +517,7 @@ def generate(model, variables, prompt_ids, max_new_tokens: int, *,
             temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
             eos_token_id=eos_token_id, axis_name=axis_name,
             num_slots=num_slots, page_size=page_size,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, kv_dtype=kv_dtype)
     cfg = model.config
     b, s0 = prompt_ids.shape
     t_max = validate_decode_bounds(s0, max_new_tokens,

@@ -159,7 +159,9 @@ class ParallelDecoderBlock(nn.Module):
             cache = update_paged_layer_cache(cache, to_bhsd(k), to_bhsd(v))
             ctx = paged_attention(to_bhsd(q), cache["k_pages"],
                                   cache["v_pages"], cache["block_tables"],
-                                  cache["len"] + s)
+                                  cache["len"] + s,
+                                  k_scales=cache.get("k_scales"),
+                                  v_scales=cache.get("v_scales"))
         elif cache is not None:
             # incremental decoding: append this chunk's K/V into the static
             # per-layer cache; a trace-time-provable prefill (static len 0)

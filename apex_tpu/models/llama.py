@@ -204,7 +204,9 @@ class LlamaDecoderBlock(nn.Module):
             # rolling ring buffer
             ctx = paged_attention(q, cache["k_pages"], cache["v_pages"],
                                   cache["block_tables"], cache["len"] + s,
-                                  window=cfg.sliding_window)
+                                  window=cfg.sliding_window,
+                                  k_scales=cache.get("k_scales"),
+                                  v_scales=cache.get("v_scales"))
         elif cache is not None:
             # incremental decoding: append K/V at the cache offset; a
             # trace-time-provable prefill rides the training flash kernel,

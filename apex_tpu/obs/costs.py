@@ -455,9 +455,13 @@ def _kv_step_bytes_max(cache):
     """Worst-case KV pool bytes one decode step reads: per layer, each
     slot's kernel reads its block-table row — at most
     ``max_pages_per_seq`` pages — bounded by the pool size (page 0 is
-    the null sink). Returns ``(kv_bytes, pool_pages)``; shared by the
-    single-chip and tensor-parallel splits so the bound can never
-    drift between them."""
+    the null sink). Page bytes derive from the pages array's ACTUAL
+    dtype (``_aval_bytes``), so a quantized int8/fp8 pool prices 2-4x
+    narrower than bf16/f32 without a special case; a quantized pool's
+    per-(page, kv_head) scale reads (``k_scales``/``v_scales``, one f32
+    row per page read) are counted on top. Returns ``(kv_bytes,
+    pool_pages)``; shared by the single-chip and tensor-parallel splits
+    so the bound can never drift between them."""
     num_slots, max_pages = cache["block_tables"].shape
     kv_step = 0
     pool_pages = None
@@ -466,6 +470,9 @@ def _kv_step_bytes_max(cache):
             pages = layer[key]
             pool_pages = int(pages.shape[0])
             page_bytes = _aval_bytes(pages) // pool_pages
+            scales = layer.get(key[0] + "_scales")
+            if scales is not None:
+                page_bytes += _aval_bytes(scales) // pool_pages
             kv_step += min(pool_pages - 1, num_slots * max_pages) \
                 * page_bytes
     return kv_step, pool_pages
@@ -610,6 +617,8 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     split = None
     tp_split = None
     spec_split = None
+    int8kv_split = None
+    int8kv_tp_split = None
     for c in cases:
         try:
             ir = build_case_ir(c)
@@ -625,6 +634,12 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             if c.name == "gpt2s_engine_spec_step_chunk":
                 # per-ACCEPTED-TOKEN split of the speculative round
                 spec_split = spec_decode_split(ir.prog, prof)
+            if c.name == "gpt2s_int8kv_engine_decode_chunk":
+                # same split over the QUANTIZED pool: the narrow KV
+                # stream + scale reads (docs/serving.md)
+                int8kv_split = decode_split(ir.prog)
+            if c.name == "tp2_int8kv_engine_decode_chunk":
+                int8kv_tp_split = tp_decode_split(ir.prog, prof)
         except Exception as e:       # noqa: BLE001 — report, don't crash
             errors.append({"case": c.name,
                            "error": f"{type(e).__name__}: {e}"})
@@ -646,7 +661,10 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             "root": str(root), "cases": out_cases, "totals": totals,
             "by_domain": by_domain, "decode_split": split,
             "tp_decode_split": tp_split,
-            "spec_decode_split": spec_split, "errors": errors}
+            "spec_decode_split": spec_split,
+            "int8kv_decode_split": int8kv_split,
+            "int8kv_tp_decode_split": int8kv_tp_split,
+            "errors": errors}
 
 
 def ledger_metrics(report: dict) -> Dict[str, float]:
@@ -681,6 +699,26 @@ def ledger_metrics(report: dict) -> Dict[str, float]:
         # (lower-better "_ms"), not the exact-match ratchet
         m["tp2.paged_decode.predicted_step_ms"] = \
             float(tsplit["predicted_step_ms_per_chip"])
+    qsplit = report.get("int8kv_decode_split")
+    if qsplit:
+        m["cost.decode.int8_kv.kv_bytes_per_step_max"] = \
+            float(qsplit["kv_bytes_per_step_max"])
+        m["cost.decode.int8_kv.weight_fraction"] = \
+            float(qsplit["weight_fraction"])
+        if split:
+            # the PR's acceptance number: the narrow pool's per-step KV
+            # stream as a fraction of the fp pool's (<= 0.55 pinned by
+            # tests/test_quantized_kv.py)
+            m["cost.decode.int8_kv.kv_bytes_ratio_vs_fp"] = \
+                float(qsplit["kv_bytes_per_step_max"]) / \
+                float(split["kv_bytes_per_step_max"])
+    qtsplit = report.get("int8kv_tp_decode_split")
+    if qtsplit:
+        for tp, slot in sorted(qtsplit["per_tp"].items()):
+            m[f"cost.tp_decode.int8_kv.kv_bytes_per_chip_per_step_tp"
+              f"{tp}"] = float(slot["kv_bytes_per_chip_per_step_max"])
+            m[f"cost.tp_decode.int8_kv.weight_fraction_tp{tp}"] = \
+                float(slot["weight_fraction"])
     ssplit = report.get("spec_decode_split")
     if ssplit:
         m["cost.spec_decode.k"] = float(ssplit["k"])
@@ -742,6 +780,15 @@ def _text_report(report: dict) -> str:
             f"-> weight fraction {split['weight_fraction']:.3f} "
             "(weight-bound decode, docs/serving.md)",
         ]
+    qsplit = report.get("int8kv_decode_split")
+    if qsplit:
+        ratio = (qsplit["kv_bytes_per_step_max"]
+                 / split["kv_bytes_per_step_max"]) if split else None
+        lines.append(
+            "  int8-kv pool: KV <= "
+            f"{_fmt_qty(qsplit['kv_bytes_per_step_max'], 'B')}/step"
+            + (f" ({ratio:.3f}x the fp pool's stream, scales included)"
+               if ratio is not None else ""))
     tsplit = report.get("tp_decode_split")
     if tsplit:
         lines += [

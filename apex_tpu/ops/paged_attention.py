@@ -62,9 +62,17 @@ from apex_tpu.ops.flash_attention import DEFAULT_MASK_VALUE
 _INTERPRET = _dispatch.interpret
 
 
-def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, scale, page_size, max_pages,
-                  s_q, rep, window=None):
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, *rest, scale,
+                  page_size, max_pages, s_q, rep, window=None,
+                  quantized=False):
+    if quantized:
+        # two extra scalar operands: this page's per-kv-head symmetric
+        # dequant scales, prefetched by the same bt[b, j] index map as
+        # the page tiles (docs/serving.md "Quantized KV pages")
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -97,9 +105,20 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     def _body():
         q = q_ref[0, 0]                                   # (s_q*rep, d)
         k = k_ref[0, 0]                                   # (ps, d)
+        if quantized:
+            # dequant is a SCALAR fold, never a widened tensor: the
+            # page's k-scale rides the score multiply (q.k * sk == q.
+            # (k*sk)), the v-scale rides p before the value dot — the
+            # narrow page is cast in VMEM, the f32 pool never exists.
+            # int8 (<=127) and e4m3 (<=448) values are exact in bf16/f32
+            k = k.astype(q.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (s_q*rep, ps)
+        if quantized:
+            # keep the scale a (1, 1) array and broadcast — extracting a
+            # true scalar from a VMEM tile is an unsupported shape cast
+            s = s * ks_ref[0, 0]
         pos = lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * page_size
         # rows are position-major: row r is query position seq_len - s_q
         # + r // rep (each query's rep GQA heads are adjacent rows)
@@ -119,8 +138,12 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_new
         v = v_ref[0, 0]
+        if quantized:
+            p_in, v_in = p * vs_ref[0, 0], v.astype(jnp.float32)
+        else:
+            p_in, v_in = p.astype(v.dtype), v
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_in, v_in, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == max_pages - 1)
@@ -131,10 +154,24 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-def _validate(q, k_pages, v_pages, block_tables, lengths, window=None):
+def _validate(q, k_pages, v_pages, block_tables, lengths, window=None,
+              k_scales=None, v_scales=None):
     if window is not None and (not isinstance(window, int) or window < 1):
         raise ValueError(f"window must be a static positive int, got "
                          f"{window!r}")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together "
+                         "(a quantized pool quantizes both tensors)")
+    if k_scales is not None:
+        want = k_pages.shape[:2]
+        for name, sc in (("k_scales", k_scales), ("v_scales", v_scales)):
+            if sc.shape != want:
+                raise ValueError(
+                    f"{name} must be (num_pages, kv_heads) = {want} "
+                    f"per-page/per-kv-head scales, got {sc.shape}")
+            if not jnp.issubdtype(sc.dtype, jnp.floating):
+                raise ValueError(f"{name} must be float scales, got "
+                                 f"{sc.dtype}")
     if q.ndim != 4:
         raise ValueError(f"q must be (batch, heads, s, d) decode-block "
                          f"queries, got {q.shape}")
@@ -170,7 +207,8 @@ def _validate(q, k_pages, v_pages, block_tables, lengths, window=None):
 
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale: Optional[float] = None,
-                    window: Optional[int] = None):
+                    window: Optional[int] = None,
+                    k_scales=None, v_scales=None):
     """Decode-block GQA attention over a paged KV pool.
 
     Args:
@@ -205,10 +243,21 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         below every query's band skip their FLOPs (and may be dropped
         from the block table entirely — the serving engine's
         O(window)-HBM trick, ``kv_pool.drop_slot_pages``).
+      k_scales / v_scales: f32 ``(num_pages, kv_heads)`` per-page,
+        per-kv-head symmetric dequant scales of a QUANTIZED pool
+        (int8 / fp8 e4m3 pages, ``kv_pool.init_paged_cache(kv_dtype=)``)
+        — ``true_k[p, h] = k_pages[p, h].astype(f32) * k_scales[p, h]``.
+        Both or neither. The kernel prefetches each page's two scalars
+        through the same ``bt[b, j]`` index map as the page tiles and
+        folds them into the score / value dots, so the dequantized pool
+        is never materialized. Under TP they shard along the kv-head
+        axis with the pages.
 
     Returns ``(batch, heads, s, head_dim)`` in ``q.dtype``.
     """
-    _validate(q, k_pages, v_pages, block_tables, lengths, window)
+    _validate(q, k_pages, v_pages, block_tables, lengths, window,
+              k_scales, v_scales)
+    quantized = k_scales is not None
     num_pages, kv, page_size, d = k_pages.shape
     b, h, s_q = q.shape[0], q.shape[1], q.shape[2]
     rep = h // kv
@@ -226,17 +275,34 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     bt = block_tables.astype(jnp.int32)
     ln = lengths.astype(jnp.int32)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, s_q * rep, d),
+                     lambda b, h, j, bt, ln: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d),
+                     lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+    ]
+    operands = [bt, ln, qr, k_pages, v_pages]
+    if quantized:
+        # one scalar scale block per (page, kv_head) grid step, resolved
+        # by the SAME scalar-prefetched bt[b, j] map as the page tiles.
+        # The (pages, kv) array is viewed as (pages, kv, 1, 1) so the
+        # block's last two dims EQUAL the array's — the only legal shape
+        # for a sub-(8, 128) VMEM block under Mosaic's tiling rules
+        # (same trick as the upstream quantized paged-attention kernels)
+        in_specs += [
+            pl.BlockSpec((1, 1, 1, 1),
+                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 1),
+                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
+        ]
+        operands += [k_scales.astype(jnp.float32)[:, :, None, None],
+                     v_scales.astype(jnp.float32)[:, :, None, None]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kv, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, 1, s_q * rep, d),
-                         lambda b, h, j, bt, ln: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda b, h, j, bt, ln: (bt[b, j], h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, s_q * rep, d),
                                lambda b, h, j, bt, ln: (b, h, 0, 0)),
         scratch_shapes=[
@@ -248,25 +314,29 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     out = _dispatch.pallas_call(
         functools.partial(_paged_kernel, scale=float(scale),
                           page_size=page_size, max_pages=max_pages,
-                          s_q=s_q, rep=rep, window=window),
+                          s_q=s_q, rep=rep, window=window,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kv, s_q * rep, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_INTERPRET(),
-    )(bt, ln, qr, k_pages, v_pages)
+    )(*operands)
     return (out.reshape(b, kv, s_q, rep, d).transpose(0, 1, 3, 2, 4)
             .reshape(b, h, s_q, d))
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
                               scale: Optional[float] = None,
-                              window: Optional[int] = None):
+                              window: Optional[int] = None,
+                              k_scales=None, v_scales=None):
     """Pure-jnp ground truth: gather every table entry into a contiguous
-    ``(b, kv, max_pages*page_size, d)`` view and run dense masked GQA
+    ``(b, kv, max_pages*page_size, d)`` view (dequantizing with the
+    gathered per-page scales when given) and run dense masked GQA
     attention — O(batch * max_len) HBM, exactly what the kernel avoids."""
-    _validate(q, k_pages, v_pages, block_tables, lengths, window)
+    _validate(q, k_pages, v_pages, block_tables, lengths, window,
+              k_scales, v_scales)
     num_pages, kv, page_size, d = k_pages.shape
     b, h, s_q = q.shape[0], q.shape[1], q.shape[2]
     rep = h // kv
@@ -274,12 +344,16 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths, *,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
-    def contig(pages):
+    def contig(pages, scales=None):
         g = jnp.take(pages, block_tables, axis=0)      # (b, mp, kv, ps, d)
+        g = g.astype(jnp.float32)
+        if scales is not None:
+            sc = jnp.take(scales, block_tables, axis=0)      # (b, mp, kv)
+            g = g * sc.astype(jnp.float32)[..., None, None]
         return g.transpose(0, 2, 1, 3, 4).reshape(b, kv, max_pages * page_size, d)
 
-    k = contig(k_pages).astype(jnp.float32)
-    v = contig(v_pages).astype(jnp.float32)
+    k = contig(k_pages, k_scales)
+    v = contig(v_pages, v_scales)
     qf = q.reshape(b, kv, rep, s_q, d).astype(jnp.float32)
     s = jnp.einsum("bkrsd,bktd->bkrst", qf, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)

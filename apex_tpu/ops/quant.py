@@ -28,6 +28,82 @@ def quantize_weight(w, *, axis: int = 1):
     return q, scale.squeeze(axis).astype(jnp.float32)
 
 
+# --------------------------------------------------------------------------
+# quantized KV pages (docs/serving.md "Quantized KV pages")
+# --------------------------------------------------------------------------
+# The paged pool stores K/V narrow (int8 or fp8 e4m3) with one symmetric
+# f32 scale per (page, kv_head) living beside the block table; the paged
+# kernel folds the scale into its score/value dots, so a full-precision
+# pool is never materialized. Same AQT recipe as the W8A8 path above,
+# page-granular instead of channel-granular.
+
+_KV_QMAX = {"int8": 127.0, "fp8": 448.0}          # e4m3 finite max
+
+
+def resolve_kv_dtype(kv_dtype):
+    """Map a user-facing ``kv_dtype`` to ``(jnp dtype, qmax)``.
+
+    ``None`` -> ``None`` (full-precision pool). Accepts ``"int8"`` /
+    ``jnp.int8`` and ``"fp8"`` / ``"e4m3"`` / ``jnp.float8_e4m3fn``.
+    Raises a NAMED ValueError for anything else — never a silent
+    full-precision fallback — and for fp8 on a jax/ml_dtypes build that
+    lacks ``float8_e4m3fn``.
+    """
+    if kv_dtype is None:
+        return None
+    name = kv_dtype if isinstance(kv_dtype, str) else \
+        jnp.dtype(kv_dtype).name
+    if name == "int8":
+        return jnp.int8, _KV_QMAX["int8"]
+    if name in ("fp8", "e4m3", "float8_e4m3fn"):
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv-dtype-unsupported: fp8 KV pages need "
+                "jnp.float8_e4m3fn (ml_dtypes); this build lacks it — "
+                "use kv_dtype='int8'")
+        return jnp.float8_e4m3fn, _KV_QMAX["fp8"]
+    raise ValueError(
+        f"kv-dtype-unsupported: kv_dtype={kv_dtype!r} is not a "
+        f"quantized page dtype (expected None, 'int8', or 'fp8'/'e4m3')")
+
+
+def kv_qmax(dtype) -> float:
+    """qmax of a quantized page dtype already in the pool (int8 -> 127,
+    e4m3 -> 448); raises on a non-quantized dtype."""
+    name = jnp.dtype(dtype).name
+    if name == "int8":
+        return _KV_QMAX["int8"]
+    if name == "float8_e4m3fn":
+        return _KV_QMAX["fp8"]
+    raise ValueError(f"kv-dtype-unsupported: {name} is not a quantized "
+                     f"KV page dtype")
+
+
+def is_quantized_kv(dtype) -> bool:
+    name = jnp.dtype(dtype).name
+    return name == "int8" or name.startswith("float8")
+
+
+def kv_cast(x, qdtype, qmax):
+    """Cast an already-scale-normalized tensor to the page dtype:
+    round+clip for int8, saturate-clip for fp8 (the cast rounds)."""
+    if jnp.dtype(qdtype) == jnp.int8:
+        return jnp.clip(jnp.round(x), -qmax, qmax).astype(jnp.int8)
+    return jnp.clip(x, -qmax, qmax).astype(qdtype)
+
+
+def kv_quantize(x, qdtype, qmax, *, axes):
+    """Symmetric quantization over ``axes``: returns ``(q, scale)`` with
+    ``x ≈ q.astype(f32) * scale`` (scale broadcast over ``axes``). An
+    all-zero group gets scale 0 and quantizes to exact zeros (dequant by
+    multiply restores them exactly)."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = amax / qmax
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    return kv_cast(xf * inv, qdtype, qmax), scale
+
+
 def int8_matmul(x, qw, scale):
     """``y = x @ dequant(qw).T`` via an int8 MXU dot.
 

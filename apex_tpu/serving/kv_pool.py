@@ -5,7 +5,10 @@ models' incremental-decode path, recognized by its ``block_tables`` key):
 
     pcache = {
       "layers": [{"k_pages": (num_pages, kv_local, page_size, d),
-                  "v_pages": ...}] * num_layers,
+                  "v_pages": ...,
+                  # quantized pools only (init_paged_cache(kv_dtype=)):
+                  "k_scales": (num_pages, kv_local) f32, "v_scales": ...}]
+                * num_layers,
       "block_tables": (num_slots, max_pages_per_seq) int32,
       "len":          (num_slots,) int32   # tokens written per slot
       "alloc_pages":  (num_slots,) int32,  # pages OWNED per slot
@@ -60,6 +63,16 @@ full ``num_kv_heads`` and sharded along the head axis over the mesh's
 stack / lengths / refcounts stay replicated, so every pure-JAX pool op
 in this module runs unchanged inside ``shard_map`` (none of them index
 the head axis).
+
+Quantized pools (``init_paged_cache(kv_dtype="int8"|"fp8")``,
+docs/serving.md "Quantized KV pages"): pages store K/V narrow with one
+symmetric f32 scale per ``(page, kv_head)`` beside the block table
+(``k_scales``/``v_scales``, shape ``(num_pages, kv_local)``). The pool
+ops here stay DTYPE-BLIND — they move page *names*, and a page's scale
+rides with the page: alloc resets a fresh private page's scales to 0,
+defrag gathers scales through the same permutation as the pages, and
+shared (prefix-cached) pages keep their scales across sharers. Under TP
+the scales shard along the same kv-head axis as the pages (dim 1).
 """
 
 from __future__ import annotations
@@ -74,6 +87,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from apex_tpu.amp.policy import resolve_compute_dtype
 from apex_tpu.mesh import MODEL_AXIS
 from apex_tpu.ops._dispatch import cdiv
+from apex_tpu.ops.quant import kv_cast, kv_qmax, resolve_kv_dtype
 from apex_tpu.transformer.utils import divide
 from apex_tpu.utils import metrics
 
@@ -93,7 +107,7 @@ def pages_for(length, page_size: int):
     return (length + page_size - 1) // page_size
 
 
-def cache_specs(config, axis_name: str = MODEL_AXIS):
+def cache_specs(config, axis_name: str = MODEL_AXIS, *, kv_dtype=None):
     """PartitionSpec pytree mirroring the paged-cache structure for a
     tensor-parallel mesh (``serving/tp.py``): the per-layer K/V pools
     shard along the kv-HEAD axis (dim 1 — each chip holds
@@ -102,12 +116,19 @@ def cache_specs(config, axis_name: str = MODEL_AXIS):
     and refcounts stay replicated (the host admission/retirement logic
     reads them and is chip-count-blind). The tree is both the
     ``shard_map`` in/out spec for every engine program and the
-    ``NamedSharding`` layout of the global cache."""
+    ``NamedSharding`` layout of the global cache.
+
+    ``kv_dtype``: non-None adds the quantized pool's per-layer
+    ``k_scales``/``v_scales`` ``(num_pages, kv)`` entries, sharded along
+    the same kv-head axis (dim 1) as the pages — per-chip scale bytes
+    halve with the pool shard."""
     kv = PartitionSpec(None, axis_name)
     rep = PartitionSpec()
+    layer = {"k_pages": kv, "v_pages": kv}
+    if kv_dtype is not None:
+        layer.update({"k_scales": kv, "v_scales": kv})
     return {
-        "layers": [{"k_pages": kv, "v_pages": kv}
-                   for _ in range(config.num_layers)],
+        "layers": [dict(layer) for _ in range(config.num_layers)],
         "block_tables": rep, "len": rep, "alloc_pages": rep,
         "shared_pages": rep, "page_ref": rep, "free_stack": rep,
         "free_top": rep,
@@ -117,7 +138,8 @@ def cache_specs(config, axis_name: str = MODEL_AXIS):
 def init_paged_cache(config, num_slots: int, *, num_pages: int,
                      page_size: int = 16,
                      max_pages_per_seq: Optional[int] = None, dtype=None,
-                     mesh=None, axis_name: str = MODEL_AXIS,
+                     kv_dtype=None, mesh=None,
+                     axis_name: str = MODEL_AXIS,
                      abstract: bool = False):
     """Allocate the shared page pool + empty slot state.
 
@@ -135,7 +157,19 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
     else is replicated. ``abstract=True`` (implied by an
     ``AbstractMesh``) returns ``ShapeDtypeStruct`` leaves instead of
     materializing — the trace/AOT-compile form (a real ``Mesh`` stamps
-    the NamedShardings on the structs; an ``AbstractMesh`` cannot)."""
+    the NamedShardings on the structs; an ``AbstractMesh`` cannot).
+
+    ``kv_dtype`` (``"int8"`` / ``"fp8"``, docs/serving.md "Quantized KV
+    pages"): store the pages at the narrow dtype with per-``(page,
+    kv_head)`` symmetric f32 scales (``k_scales``/``v_scales``) in each
+    layer dict — roughly 2x the slots per pool byte at bf16 parity
+    tolerance. Mutually exclusive with ``dtype`` (the page dtype IS the
+    quantized dtype)."""
+    if kv_dtype is not None and dtype is not None:
+        raise ValueError("kv-dtype-conflict: pass dtype= OR kv_dtype=, "
+                         "not both — a quantized pool's page dtype is "
+                         "the quantized dtype")
+    quant = resolve_kv_dtype(kv_dtype)
     if page_size % 8 != 0:
         raise ValueError(f"page_size must be a sublane multiple (8), got "
                          f"{page_size}")
@@ -157,13 +191,18 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
                 "shapes and the pool's head sharding would disagree")
         kv_dim = kv_local * tp_world            # the GLOBAL head count
     d = config.head_dim
-    dt = dtype if dtype is not None else resolve_compute_dtype(config.dtype)
+    if quant is not None:
+        dt = quant[0]
+    else:
+        dt = dtype if dtype is not None \
+            else resolve_compute_dtype(config.dtype)
     if max_pages_per_seq is None:
         max_pages_per_seq = cdiv(config.max_position_embeddings, page_size)
     shape = (num_pages, kv_dim, page_size, d)
+    scale_shape = (num_pages, kv_dim)
     if mesh is not None and (abstract or not isinstance(mesh, Mesh)):
         # trace/AOT form: no buffers, just (sharded) shapes
-        specs = cache_specs(config, axis_name)
+        specs = cache_specs(config, axis_name, kv_dtype=kv_dtype)
         stamp = isinstance(mesh, Mesh)
 
         def sds(sh, dt_, spec):
@@ -172,10 +211,18 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
 
         kv_spec = specs["layers"][0]["k_pages"]
         rep = PartitionSpec()
+
+        def layer_sds():
+            lc = {"k_pages": sds(shape, dt, kv_spec),
+                  "v_pages": sds(shape, dt, kv_spec)}
+            if quant is not None:
+                sc_spec = specs["layers"][0]["k_scales"]
+                lc["k_scales"] = sds(scale_shape, jnp.float32, sc_spec)
+                lc["v_scales"] = sds(scale_shape, jnp.float32, sc_spec)
+            return lc
+
         return {
-            "layers": [{"k_pages": sds(shape, dt, kv_spec),
-                        "v_pages": sds(shape, dt, kv_spec)}
-                       for _ in range(config.num_layers)],
+            "layers": [layer_sds() for _ in range(config.num_layers)],
             "block_tables": sds((num_slots, max_pages_per_seq), jnp.int32,
                                 rep),
             "len": sds((num_slots,), jnp.int32, rep),
@@ -186,9 +233,14 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
             "free_top": sds((), jnp.int32, rep),
         }
     def build():
-        layers = [{"k_pages": jnp.zeros(shape, dt),
-                   "v_pages": jnp.zeros(shape, dt)}
-                  for _ in range(config.num_layers)]
+        def layer_buf():
+            lc = {"k_pages": jnp.zeros(shape, dt),
+                  "v_pages": jnp.zeros(shape, dt)}
+            if quant is not None:
+                lc["k_scales"] = jnp.zeros(scale_shape, jnp.float32)
+                lc["v_scales"] = jnp.zeros(scale_shape, jnp.float32)
+            return lc
+        layers = [layer_buf() for _ in range(config.num_layers)]
         return {
             "layers": layers,
             "block_tables": jnp.zeros((num_slots, max_pages_per_seq),
@@ -209,7 +261,8 @@ def init_paged_cache(config, num_slots: int, *, num_pages: int,
     # the global pool on one device first would OOM at exactly the
     # shapes TP exists for (a pool bigger than one chip's HBM)
     shardings = jax.tree.map(lambda spec: NamedSharding(mesh, spec),
-                             cache_specs(config, axis_name),
+                             cache_specs(config, axis_name,
+                                         kv_dtype=kv_dtype),
                              is_leaf=lambda x: isinstance(
                                  x, PartitionSpec))
     return jax.jit(build, out_shardings=shardings)()
@@ -242,6 +295,23 @@ def observe_pool(cache, labels: Optional[dict] = None) -> dict:
     return vals
 
 
+def _reset_page_scales(cache, page_ids):
+    """Zero the quantized-pool scales of freshly allocated PRIVATE pages
+    (no-op on a full-precision pool). The requantize-on-grow append and
+    the prefill scatter both trust scale 0 == "page holds nothing yet";
+    a previous occupant's stale scale would silently inflate the new
+    occupant's quantization grid. ``page_ids`` may contain 0 (the null
+    page) for masked-out entries — page 0's scale is garbage like its
+    contents and is never read by a live slot."""
+    if "k_scales" not in cache["layers"][0]:
+        return cache["layers"]
+    zero = jnp.zeros(page_ids.shape + cache["layers"][0]["k_scales"]
+                     .shape[1:], jnp.float32)
+    return [dict(lc, k_scales=lc["k_scales"].at[page_ids].set(zero),
+                 v_scales=lc["v_scales"].at[page_ids].set(zero))
+            for lc in cache["layers"]]
+
+
 def alloc_slot(cache, slot, n_pages):
     """Pop ``n_pages`` pages off the free stack and install them as slot
     ``slot``'s block table row (entries past ``n_pages`` point at the null
@@ -263,6 +333,7 @@ def alloc_slot(cache, slot, n_pages):
     out["alloc_pages"] = cache["alloc_pages"].at[slot].set(
         jnp.asarray(n_pages, jnp.int32))
     out["shared_pages"] = cache["shared_pages"].at[slot].set(0)
+    out["layers"] = _reset_page_scales(cache, row)
     return out
 
 
@@ -291,6 +362,13 @@ def alloc_slot_shared(cache, slot, shared_row, n_shared, n_private):
     out["shared_pages"] = cache["shared_pages"].at[slot].set(n_shared)
     ref_ids = jnp.where(idx < n_shared, shared_row, num_pages)  # OOB drops
     out["page_ref"] = cache["page_ref"].at[ref_ids].add(1, mode="drop")
+    # only the freshly popped PRIVATE pages reset their scales — the
+    # shared prefix pages keep theirs (shared pages are shared scales).
+    # Gated so the fp pool's program never carries the dead page-id
+    # select (the helper itself no-ops on fp pools, its argument not)
+    if "k_scales" in cache["layers"][0]:
+        out["layers"] = _reset_page_scales(
+            cache, jnp.where(take_priv, row, 0))
     return out
 
 
@@ -439,9 +517,11 @@ def defrag_map(cache, extra_live=None):
         jnp.arange(num_pages, dtype=jnp.int32))
 
     out = dict(cache)
-    out["layers"] = [{"k_pages": lc["k_pages"][old_of_new],
-                      "v_pages": lc["v_pages"][old_of_new]}
-                     for lc in cache["layers"]]
+    # a page's scale moves with the page through the same permutation —
+    # remapped quantized contents stay bit-identical to pre-defrag
+    out["layers"] = [
+        {key: lc[key][old_of_new] for key in lc}
+        for lc in cache["layers"]]
     out["block_tables"] = jnp.where(used_entries, new_idx[bt], 0)
     out["page_ref"] = cache["page_ref"][old_of_new]
     idx = jnp.arange(num_pages, dtype=jnp.int32)
@@ -481,16 +561,91 @@ def prefill_into_pages(cache, slot, contig_layers, s0, *, start=0):
     off = pos % ps
 
     out = dict(cache)
+    quantized = "k_scales" in cache["layers"][0]
+    if quantized:
+        # quantize-on-write (docs/serving.md "Quantized KV pages"): each
+        # written table entry gets a fresh per-(page, kv_head) symmetric
+        # scale from ITS tokens' amax — alloc reset these pages to scale
+        # 0, so set (not max) is exact. Entries below ``start`` (shared
+        # prefix pages) and bucket padding have no valid positions: their
+        # writes sink to the null page and their scale row targets page 0
+        # — shared pages keep their shared scales.
+        qmax = kv_qmax(cache["layers"][0]["k_pages"].dtype)
+        nb = cdiv(len_bucket, ps)
+        pad = nb * ps - len_bucket
+        valid_p = jnp.pad(valid, (0, pad))
+        ent_any = valid_p.reshape(nb, ps).any(axis=1)          # (nb,)
+        page_e = jnp.where(ent_any, row[:nb], 0)
+        ent_of = jnp.clip(pos // ps, 0, nb - 1)
+
+        def scatter_q(pages, scales, x):
+            xf = x.astype(jnp.float32)           # (len_bucket, kv, d)
+            ax = jnp.where(valid[:, None, None], jnp.abs(xf), 0.0)
+            ax = jnp.pad(ax, ((0, pad), (0, 0), (0, 0)))
+            amax = ax.reshape(nb, ps, *x.shape[1:]).max(axis=(1, 3))
+            sc = amax / qmax                                   # (nb, kv)
+            inv = jnp.where(sc > 0, 1.0 / jnp.maximum(sc, 1e-30), 0.0)
+            q = kv_cast(xf * inv[ent_of][:, :, None], pages.dtype, qmax)
+            return (pages.at[phys, :, off, :].set(q),
+                    scales.at[page_e].set(
+                        jnp.where(ent_any[:, None], sc, 0.0)))
+
     new_layers = []
     for lc, src in zip(cache["layers"], contig_layers):
         k = src["k"][0].transpose(1, 0, 2)       # (len_bucket, kv, d)
         v = src["v"][0].transpose(1, 0, 2)
-        new_layers.append({
-            "k_pages": lc["k_pages"].at[phys, :, off, :].set(
-                k.astype(lc["k_pages"].dtype)),
-            "v_pages": lc["v_pages"].at[phys, :, off, :].set(
-                v.astype(lc["v_pages"].dtype)),
-        })
+        if quantized:
+            kp, ks = scatter_q(lc["k_pages"], lc["k_scales"], k)
+            vp, vs = scatter_q(lc["v_pages"], lc["v_scales"], v)
+            new_layers.append({"k_pages": kp, "v_pages": vp,
+                               "k_scales": ks, "v_scales": vs})
+        else:
+            new_layers.append({
+                "k_pages": lc["k_pages"].at[phys, :, off, :].set(
+                    k.astype(lc["k_pages"].dtype)),
+                "v_pages": lc["v_pages"].at[phys, :, off, :].set(
+                    v.astype(lc["v_pages"].dtype)),
+            })
     out["layers"] = new_layers
     out["len"] = cache["len"].at[slot].set(jnp.asarray(s0, jnp.int32))
     return out
+
+
+# --------------------------------------------------------------------------
+# pool sizing (the capacity lever the quantized pool exists for)
+# --------------------------------------------------------------------------
+
+def page_bytes(config, page_size: int = 16, *, kv_dtype=None,
+               dtype=None) -> int:
+    """Pool bytes ONE page costs across all layers: the K and V page
+    tiles at the pool dtype, plus — quantized pools — their two f32
+    per-(page, kv_head) scale entries. The honest per-page denominator
+    for capacity planning: at ``page_size=16, head_dim=64`` an int8 page
+    costs ``(16*64 + 4) / (2*16*64) ≈ 0.502`` of a bf16 page, which is
+    where the ~2x slot capacity comes from."""
+    quant = resolve_kv_dtype(kv_dtype)
+    if quant is not None:
+        dt = quant[0]
+    else:
+        dt = dtype if dtype is not None \
+            else resolve_compute_dtype(config.dtype)
+    kv_heads = getattr(config, "num_kv_heads", config.num_heads)
+    kv_local = divide(kv_heads, config.tensor_parallel_size)
+    per_tensor = kv_local * page_size * config.head_dim * \
+        jnp.dtype(dt).itemsize
+    if quant is not None:
+        per_tensor += kv_local * jnp.dtype(jnp.float32).itemsize
+    return 2 * per_tensor * config.num_layers
+
+
+def max_slots_for_pool_bytes(config, pool_bytes: int, *,
+                             pages_per_slot: int, page_size: int = 16,
+                             kv_dtype=None, dtype=None) -> int:
+    """How many ``pages_per_slot``-page slots a ``pool_bytes`` budget
+    admits (the null page 0 is carved out first). Holding ``pool_bytes``
+    fixed, ``kv_dtype='int8'`` admits ~2x the slots of the bf16 pool —
+    the acceptance pin in ``tests/test_quantized_kv.py`` and the
+    slot-capacity telemetry in ``tpu_decode_bench.py``."""
+    pb = page_bytes(config, page_size, kv_dtype=kv_dtype, dtype=dtype)
+    num_pages = pool_bytes // pb
+    return max(int(num_pages - 1) // pages_per_slot, 0)

@@ -73,6 +73,7 @@ from apex_tpu.models.generation import (_greedy_token, _sample_token,
 from apex_tpu.obs.events import EventLog
 from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
+from apex_tpu.ops.quant import resolve_kv_dtype
 from apex_tpu.serving import kv_pool
 from apex_tpu.serving.prefix_cache import PrefixCache
 
@@ -204,17 +205,26 @@ def make_shared_admit(model, *, t_start: int, tail_bucket: int,
         contig = init_cache(cfg, 1, bucket)
         layers = []
         for pool_lc, lc in zip(cache["layers"], contig["layers"]):
-            def gathered(pages, dst):
+            def gathered(pages, dst, scales=None):
                 # (m, kv, ps, d) page tiles -> the buffer's leading
-                # t_start positions
+                # t_start positions; a quantized pool dequantizes by its
+                # gathered per-(page, kv_head) scales on the way out
                 kv, d = pages.shape[1], pages.shape[3]
+                if scales is not None:
+                    pages = pages.astype(jnp.float32) * \
+                        scales[:, :, None, None]
                 block = pages.transpose(1, 0, 2, 3).reshape(
                     1, kv, t_start, d)
                 return dst.at[:, :, :t_start, :].set(
                     block.astype(dst.dtype))
+            quantized = "k_scales" in pool_lc
             layers.append(
-                {"k": gathered(pool_lc["k_pages"][shared_row[:m]], lc["k"]),
-                 "v": gathered(pool_lc["v_pages"][shared_row[:m]], lc["v"])})
+                {"k": gathered(pool_lc["k_pages"][shared_row[:m]], lc["k"],
+                               pool_lc["k_scales"][shared_row[:m]]
+                               if quantized else None),
+                 "v": gathered(pool_lc["v_pages"][shared_row[:m]], lc["v"],
+                               pool_lc["v_scales"][shared_row[:m]]
+                               if quantized else None)})
         # static len t_start: the tail chunk is a chunked continuation —
         # bounds check at trace time, dense cached attention over the
         # buffer (the flash path needs len 0, which the prefix occupies)
@@ -297,12 +307,29 @@ class PagedDecodeEngine:
                  sync_every: int = 1, axis_name: str = MODEL_AXIS,
                  prefix_cache: bool = False,
                  draft_model=None, draft_variables=None, draft_len: int = 0,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None, kv_dtype=None,
+                 draft_kv_dtype="match"):
         cfg = model.config
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if sync_every < 1:
             raise ValueError("sync_every must be >= 1")
+        # quantized KV pages (docs/serving.md "Quantized KV pages"):
+        # resolve eagerly so an unsupported kv_dtype raises a NAMED
+        # ValueError here — never a silent full-precision fallback
+        resolve_kv_dtype(kv_dtype)
+        self.kv_dtype = kv_dtype
+        # the draft pool mirrors the target pool page-for-page AND
+        # dtype-for-dtype: one capacity/cost story covers both pools, so
+        # a divergent draft dtype is a named config error, not a knob
+        if draft_kv_dtype == "match":
+            draft_kv_dtype = kv_dtype
+        if draft_len > 0 and draft_kv_dtype != kv_dtype:
+            raise ValueError(
+                f"kv-dtype-mismatch: the speculative draft pool must "
+                f"share the target pool's kv_dtype (target "
+                f"{kv_dtype!r}, draft {draft_kv_dtype!r}) — the pools "
+                f"mirror each other slot-for-slot and page-for-page")
         self.model = model
         self.variables = variables
         self.cfg = cfg
@@ -487,7 +514,7 @@ class PagedDecodeEngine:
         return kv_pool.init_paged_cache(
             config if config is not None else self.cfg, num_slots,
             num_pages=num_pages, page_size=page_size,
-            max_pages_per_seq=max_pages_per_seq)
+            max_pages_per_seq=max_pages_per_seq, kv_dtype=self.kv_dtype)
 
     def _compile(self, fn, in_roles, out_roles, donate=()):
         """The single seam every engine program is compiled through.
@@ -896,7 +923,8 @@ def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
                    axis_name: str = MODEL_AXIS,
                    num_slots: Optional[int] = None, page_size: int = 16,
                    num_pages: Optional[int] = None, sync_every: int = 1,
-                   prefix_cache: bool = False, return_stats: bool = False):
+                   prefix_cache: bool = False, return_stats: bool = False,
+                   kv_dtype=None):
     """`generate`-shaped front end over the engine.
 
     ``prompt_ids`` may be a rectangular ``(batch, s0)`` array (the
@@ -915,7 +943,7 @@ def generate_paged(model, variables, prompt_ids, max_new_tokens: int, *,
         page_size=page_size, num_pages=num_pages,
         eos_token_id=eos_token_id, temperature=temperature, top_k=top_k,
         top_p=top_p, rng=rng, sync_every=sync_every, axis_name=axis_name,
-        prefix_cache=prefix_cache)
+        prefix_cache=prefix_cache, kv_dtype=kv_dtype)
     reqs = [Request(prompt=p, max_new_tokens=max_new_tokens)
             for p in prompts]
     outs, stats = engine.run(reqs)

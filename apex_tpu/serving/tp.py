@@ -257,7 +257,11 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
                 "shapes and the engine's head sharding would disagree")
         self.tp_world = tp
         self.abstract = bool(abstract) or not isinstance(self.mesh, Mesh)
-        self._cache_specs = kv_pool.cache_specs(cfg, axis_name=axis)
+        # quantized pools add per-(page, kv_head) scale arrays, which
+        # shard P(None, axis) with the pages they scale — per-chip pool
+        # bytes stay 1/tp of the (already ~2x smaller) global pool
+        self._cache_specs = kv_pool.cache_specs(
+            cfg, axis_name=axis, kv_dtype=kwargs.get("kv_dtype"))
         _, self._var_specs = infer_variable_specs(model, axis_name=axis)
         # speculative decode: the draft pool and draft variables shard
         # over the SAME mesh (the draft model's own head/column layout),
@@ -270,8 +274,9 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
                     f"draft model has tensor_parallel_size="
                     f"{draft.config.tensor_parallel_size}, target has "
                     f"{tp} — both must shard over the same mesh")
-            self._draft_cache_specs = kv_pool.cache_specs(draft.config,
-                                                          axis_name=axis)
+            self._draft_cache_specs = kv_pool.cache_specs(
+                draft.config, axis_name=axis,
+                kv_dtype=kwargs.get("kv_dtype"))
             _, self._draft_var_specs = infer_variable_specs(
                 draft, axis_name=axis)
         super().__init__(model, variables, **kwargs)
@@ -284,7 +289,8 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
             config if config is not None else self.cfg, num_slots,
             num_pages=num_pages, page_size=page_size,
             max_pages_per_seq=max_pages_per_seq, mesh=self.mesh,
-            axis_name=self.axis_name, abstract=self.abstract)
+            axis_name=self.axis_name, abstract=self.abstract,
+            kv_dtype=self.kv_dtype)
 
     def _compile(self, fn, in_roles, out_roles, donate=()):
         """shard_map ``fn`` over the mesh: the cache argument/result

@@ -38,6 +38,7 @@ CASE_NAMES = [
     "gpt2s_prefix_cached_admit",      # prefix cache: tail-only admission
     "gpt2s_paged_spec_verify",        # s=4 query block: spec verify step
     "gpt2s_chunked_prefill_step",     # chunked prefill through the s>1 path
+    "gpt2s_paged_decode_int8kv",      # quantized pool: in-kernel dequant
 ]
 
 

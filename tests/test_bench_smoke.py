@@ -49,8 +49,8 @@ def test_bench_sharded_over_8_cpu_devices():
 
 def test_decode_bench_smoke_emits_json(tmp_path):
     """tpu_decode_bench.py in smoke mode prints its parseable JSON
-    records (lock-step, paged, tp=2, prefix-cached, async frontend,
-    speculative, chunked-prefill TTFT A/B), the paged
+    records (lock-step, paged, int8-kv paged, tp=2, prefix-cached,
+    async frontend, speculative, chunked-prefill TTFT A/B), the paged
     record carries the TTFT/decode-step percentile fields (ISSUE 4), the
     frontend record carries the open-loop TTFT/TPOT/deadline-miss fields
     with preemptions > 0 under the adversarial burst (ISSUE 6), and the
@@ -91,6 +91,25 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     assert paged["decode_step_ms_p95"] >= paged["decode_step_ms_p50"]
     assert paged["queue_wait_ms_p50"] >= 0
     assert paged["tpot_ms_p50"] > 0
+
+    # the quantized KV-page engine's record (ISSUE 14, docs/serving.md
+    # "Quantized KV pages"): throughput parses, the slot-capacity
+    # telemetry carries the >= 1.9x fixed-budget win, and — asserted
+    # inside the bench itself — every request's shape and first token
+    # match the fp paged engine (full token parity is tolerance-pinned
+    # in tests/test_quantized_kv.py, not an exact-identity bench gate)
+    q8 = recs["gpt2_int8kv_paged_decode_tokens_per_sec_per_chip"]
+    assert q8["value"] > 0
+    assert q8["unit"] == "tokens/s/chip"
+    assert q8["kv_dtype"] == "int8"
+    assert q8["generated_tokens"] == paged["generated_tokens"]
+    assert q8["page_bytes_int8"] < q8["page_bytes_fp"]
+    assert q8["int8_slot_capacity"] >= 1.9 * q8["fp_slot_capacity"]
+    assert q8["slot_capacity_ratio"] >= 1.9
+    assert q8["gpt2_int8kv_paged_decode_ttft_ms_p50"] > 0
+    assert (q8["gpt2_int8kv_paged_decode_ttft_ms_p95"]
+            >= q8["gpt2_int8kv_paged_decode_ttft_ms_p50"])
+    assert q8["tpot_ms_p50"] > 0
 
     # the tensor-parallel paged engine's record (ISSUE 10,
     # docs/tp_serving.md): the tp=2 run must have actually happened

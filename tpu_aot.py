@@ -325,6 +325,18 @@ def kernel_cases():
             _sds((513, 12, 16, 64), bf16), _sds((8, 32), i32),
             _sds((8,), i32)])
 
+    # -- quantized KV pages (docs/serving.md "Quantized KV pages"): the
+    # SAME decode step over an int8 pool with per-(page, kv_head) f32
+    # scales dequantized inside the kernel. The new Mosaic surfaces this
+    # case gates: int8 page tiles at the (1, 1, page, d) block shape and
+    # the (1, 1) scale blocks indexed through the prefetched table.
+    yield ("gpt2s_paged_decode_int8kv",
+           lambda q, k, v, bt, ln, ks, vs: paged_attention(
+               q, k, v, bt, ln, k_scales=ks, v_scales=vs),
+           [_sds((8, 12, 1, 64), bf16), _sds((513, 12, 16, 64), jnp.int8),
+            _sds((513, 12, 16, 64), jnp.int8), _sds((8, 32), i32),
+            _sds((8,), i32), _sds((513, 12), f32), _sds((513, 12), f32)])
+
     # -- serving path (r5): tpu_decode_bench.py's exact programs — flash
     # prefill + lax.scan single-token decode + argmax, GPT-2 small at the
     # bench config (batch 8, prompt 128, 128 new tokens, bf16), fp AND

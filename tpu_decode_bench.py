@@ -26,6 +26,16 @@ and shared-system-prompt request sets are the scenario library's
 (apex_tpu/serving/scenarios, docs/scenarios.md), materialized from a
 fixed seed — the bench keeps only the measurement loops and asserts.
 
+After the paged line: the QUANTIZED KV-PAGE engine — the same workload
+with ``kv_dtype='int8'`` (int8 pages + per-(page, kv_head) f32 scales,
+dequant inside the kernel), emitting
+{"metric": "gpt2_int8kv_paged_decode_tokens_per_sec_per_chip", ...}
+with slot-capacity telemetry (``kv_pool.max_slots_for_pool_bytes`` at a
+fixed pool-byte budget: int8 admits ~2x the slots); the smoke run
+asserts per-request shapes and first tokens match the fp engine (full
+token-level parity is tolerance-pinned in tests/test_quantized_kv.py)
+and the >= 1.9x capacity ratio.
+
 Between the paged and prefix-cached lines: the TENSOR-PARALLEL paged
 engine (serving/tp.py, docs/tp_serving.md) — the same mixed-length
 workload through a tp=2 ``TensorParallelPagedEngine`` (head-sharded
@@ -222,6 +232,87 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(prec), flush=True)
+
+    # --- quantized (int8) KV-page serving metric ----------------------------
+    # the SAME mixed-length workload through the engine with
+    # ``kv_dtype='int8'`` (docs/serving.md "Quantized KV pages"): K/V
+    # pages live in the pool as int8 with per-(page, kv_head) f32 scales
+    # and dequantize inside the paged-attention kernel. The headline
+    # rides next to the slot-capacity telemetry — at a FIXED pool-byte
+    # budget the int8 pool admits ~2x the slots of the bf16 pool
+    # (kv_pool.max_slots_for_pool_bytes), which is the actual win:
+    # more concurrent sequences per chip, not a faster single step.
+    from apex_tpu.serving import kv_pool as _kvp
+
+    q_engine = PagedDecodeEngine(model, v, num_slots=num_slots,
+                                 page_size=page_size, kv_dtype="int8")
+    q_engine.run(requests)                               # compile + warm
+    t0 = time.perf_counter()
+    q_outs, q_stats = q_engine.run(requests)
+    q_elapsed = time.perf_counter() - t0
+    q_tokens = int(sum(o.shape[0] for o in q_outs))
+    if smoke:
+        # NOT exact token identity: quantization legitimately perturbs
+        # logits by more than a tiny random-init model's argmax gaps
+        # (the tolerance-pinned parity lives in
+        # tests/test_quantized_kv.py). What IS exact: request shapes,
+        # and each request's FIRST token — it comes off the prefill
+        # forward pass's own logits, before any quantized-pool read
+        for i, (a, b) in enumerate(zip(outs, q_outs)):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape:
+                raise SystemExit(
+                    f"int8-kv engine changed request {i}'s output shape: "
+                    f"{a.shape} vs fp {b.shape}")
+            if a.shape[0] and a[0] != b[0]:
+                raise SystemExit(
+                    f"int8-kv engine flipped request {i}'s FIRST token "
+                    f"({b[0]} vs fp {a[0]}) — prefill logits never touch "
+                    f"the quantized pool, so this is a real bug")
+    # slot capacity at a fixed budget: what one fp pool's bytes would
+    # buy in each dtype (pages_per_slot from the bench's own shapes)
+    pps = max((max(prompt_lens) + max(new_tokens) + page_size - 1)
+              // page_size, 1)
+    fp_pool_bytes = _kvp.page_bytes(cfg, page_size) * (
+        num_slots * pps + 1)
+    fp_cap = _kvp.max_slots_for_pool_bytes(cfg, fp_pool_bytes,
+                                           pages_per_slot=pps,
+                                           page_size=page_size)
+    q_cap = _kvp.max_slots_for_pool_bytes(cfg, fp_pool_bytes,
+                                          pages_per_slot=pps,
+                                          page_size=page_size,
+                                          kv_dtype="int8")
+    if smoke and q_cap < 1.9 * fp_cap:
+        raise SystemExit(
+            f"int8-kv slot capacity regressed: {q_cap} slots vs "
+            f"{fp_cap} fp slots at a fixed pool budget (< 1.9x)")
+    q_rec = {
+        "metric": "gpt2_int8kv_paged_decode_tokens_per_sec_per_chip",
+        "value": round(q_tokens / max(q_elapsed, 1e-9), 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,  # no reference analog (apex ships no inference)
+        "requests": n_req, "num_slots": num_slots, "page_size": page_size,
+        "kv_dtype": "int8",
+        "generated_tokens": q_tokens,
+        "decode_steps": q_stats["decode_steps"],
+        "fp_tokens_per_sec": prec["value"],
+        # capacity telemetry: slots a fixed pool-byte budget admits
+        "pool_bytes_budget": int(fp_pool_bytes),
+        "pages_per_slot": int(pps),
+        "fp_slot_capacity": int(fp_cap),
+        "int8_slot_capacity": int(q_cap),
+        "slot_capacity_ratio": round(q_cap / max(fp_cap, 1), 3),
+        "page_bytes_fp": int(_kvp.page_bytes(cfg, page_size)),
+        "page_bytes_int8": int(_kvp.page_bytes(cfg, page_size,
+                                               kv_dtype="int8")),
+        "gpt2_int8kv_paged_decode_ttft_ms_p50": round(
+            q_stats["ttft_ms_p50"], 3),
+        "gpt2_int8kv_paged_decode_ttft_ms_p95": round(
+            q_stats["ttft_ms_p95"], 3),
+        "tpot_ms_p50": round(q_stats["tpot_ms_p50"], 3),
+        "device": dev.device_kind, "platform": dev.platform,
+    }
+    print(json.dumps(q_rec), flush=True)
 
     # --- tensor-parallel paged serving metric -------------------------------
     # the SAME mixed-length workload through a tp=2
