@@ -638,5 +638,45 @@ def check_useless_local_lock(model: ConcModel) -> Iterator[Finding]:
                     scope=key.qualname)
 
 
+# --------------------------------------------------------------------------
+# 9. conc-await-under-lock
+# --------------------------------------------------------------------------
+
+@conc_rule("conc-await-under-lock", "error",
+           "`await` while holding a threading lock — the suspension "
+           "keeps the lock, so every other task on the event loop that "
+           "contends for it wedges the whole loop (and any real thread "
+           "contending for it stalls for the awaited I/O's latency)")
+def check_await_under_lock(model: ConcModel) -> Iterator[Finding]:
+    """An asyncio task that suspends while holding a *threading* lock
+    is the cross-color deadlock the HTTP surface must never ship: the
+    loop thread parks at the ``await`` with the lock still held, so a
+    contending pump/submitter thread blocks the OS thread, and a
+    contending *task* blocks the loop itself — which is the only thing
+    that could ever run the release. Only registered sync locks fire;
+    ``async with asyncio.Lock()`` suspends instead of blocking and is
+    the sanctioned pattern (its kinds never enter the lock registry —
+    see ``locks._ASYNC_CTOR_KINDS``)."""
+    r = CONC_RULES["conc-await-under-lock"]
+    for key, ctx in sorted(model.funcs.items(),
+                           key=lambda kv: (kv[0].module, kv[0].qualname)):
+        # walk_shallow for the same reason as rule 3: a nested def is
+        # its own ctx with its own entry lockset
+        for node in walk_shallow(ctx.info.node):
+            if not isinstance(node, ast.Await):
+                continue
+            held = model.effective_locks(key, node)
+            if not held:
+                continue
+            yield _finding(
+                r, key.module, node,
+                f"`{key.qualname}` awaits while holding "
+                f"{_lockset_str(held)} — the task suspends with the "
+                "lock held, wedging every loop task and OS thread that "
+                "contends for it; release before the await (or use an "
+                "asyncio.Lock, which suspends instead of blocking)",
+                scope=key.qualname)
+
+
 def conc_rules() -> List[ConcRule]:
     return list(CONC_RULES.values())

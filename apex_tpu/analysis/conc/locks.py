@@ -58,7 +58,26 @@ _CTOR_KINDS = {
     "Timer": "thread",
 }
 
-SYNC_KINDS = frozenset(_CTOR_KINDS.values())
+#: asyncio's same-named primitives are a DIFFERENT color: they suspend
+#: the awaiting task, never a thread, so they must not enter the lock
+#: registry (an ``async with asyncio.Lock()`` can never guard a field
+#: against the pump thread, and treating it as a threading lock would
+#: both manufacture false guards and hide real await-under-lock bugs).
+#: They still classify as sync kinds so the shared-field analysis skips
+#: them — they synchronize their tasks, just not across threads.
+_ASYNC_CTOR_KINDS = {
+    "Lock": "alock",
+    "Event": "aevent",
+    "Condition": "acondition",
+    "Semaphore": "asemaphore",
+    "BoundedSemaphore": "asemaphore",
+    "Queue": "aqueue",
+    "LifoQueue": "aqueue",
+    "PriorityQueue": "aqueue",
+}
+
+SYNC_KINDS = (frozenset(_CTOR_KINDS.values())
+              | frozenset(_ASYNC_CTOR_KINDS.values()))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,7 +167,14 @@ def _is_sync_ctor(node: ast.AST) -> Optional[str]:
     cn = call_name(node)
     if cn is None:
         return None
-    return _CTOR_KINDS.get(cn.split(".")[-1])
+    parts = cn.split(".")
+    if len(parts) >= 2 and parts[-2] == "asyncio":
+        # asyncio.Lock() et al: the task-colored kinds (see
+        # _ASYNC_CTOR_KINDS) — never threading locks. A bare `Lock()`
+        # after a from-import keeps the threading reading (syntactic
+        # tier: precision over recall; the repo spells asyncio dotted).
+        return _ASYNC_CTOR_KINDS.get(parts[-1])
+    return _CTOR_KINDS.get(parts[-1])
 
 
 class ConcModel:

@@ -21,7 +21,21 @@ thread entry point:
   ``/metrics`` endpoint's handler runs on server threads;
 - the callable handed to ``jax.debug.callback`` — the metrics channel
   delivers on XLA runtime threads (the ``record()`` docstring's
-  contract), so its payload is colored ``jax-callback``.
+  contract), so its payload is colored ``jax-callback``;
+- **asyncio tasks** — coroutines handed to ``asyncio.run`` /
+  ``loop.run_until_complete`` / ``run_coroutine_threadsafe``,
+  spawned via ``create_task``/``ensure_future`` on a loop-ish
+  receiver, or installed as ``asyncio.start_server``'s
+  per-connection callback. All carry ONE color, ``asyncio``: tasks
+  on a loop interleave only at ``await`` points, so they form a
+  single cooperative "thread" — what matters to the rules is (a)
+  that loop-confined state is not also touched from real threads
+  and (b) that no task ``await``\\ s while holding a *threading*
+  lock (``conc-await-under-lock``: the loop thread would keep the
+  lock across the suspension and every other task contending for it
+  wedges the whole loop). ``loop.run_in_executor(...)`` /
+  ``asyncio.to_thread(...)`` payloads leave the loop for a worker
+  pool and are colored ``executor``.
 
 Colors propagate through the same resolved call edges the lockset
 machinery uses. A function with any color is *multi-thread*: it runs on
@@ -43,6 +57,22 @@ from apex_tpu.analysis.walker import (call_name, kwarg, name_tail,
 _EXECUTORISH = ("executor", "pool", "workers")
 
 _HOST_CALLBACK_FNS = {"jax.debug.callback", "debug.callback"}
+
+#: receiver-name fragments that make a ``.create_task(coro)`` /
+#: ``.ensure_future(coro)`` / ``.run_until_complete(coro)`` call an
+#: event-loop dispatch (``loop``, ``self._loop``, a TaskGroup ``tg``)
+#: rather than some application-level method of the same name
+_LOOPISH = ("loop", "asyncio", "tg", "taskgroup")
+
+
+def _coro_target(expr: Optional[ast.AST]) -> Optional[ast.AST]:
+    """The function behind a task-spawn argument. Spawns usually pass
+    an *invoked* coroutine (``create_task(self._watch(reader))``), so
+    unwrap one Call layer to the callee; a bare reference (the
+    ``start_server`` callback) passes through."""
+    if isinstance(expr, ast.Call):
+        return expr.func
+    return expr
 
 
 def _literal_name(call: ast.Call) -> Optional[str]:
@@ -115,6 +145,35 @@ def thread_roots(model: ConcModel) -> List[Tuple[str, FuncKey]]:
                 # like the AST tier's exemption logic
                 for fk in resolve(mi, node.args[0], node):
                     roots.append(("jax-callback", fk))
+            elif tail in ("create_task", "ensure_future",
+                          "run_until_complete",
+                          "run_coroutine_threadsafe") and node.args:
+                recv = ""
+                if isinstance(node.func, ast.Attribute):
+                    recv = name_tail(node.func.value) or ""
+                if cn.startswith("asyncio.") \
+                        or any(w in recv.lower() for w in _LOOPISH):
+                    for fk in resolve(mi, _coro_target(node.args[0]),
+                                      node):
+                        roots.append(("asyncio", fk))
+            elif cn in ("asyncio.run",) and node.args:
+                for fk in resolve(mi, _coro_target(node.args[0]), node):
+                    roots.append(("asyncio", fk))
+            elif cn in ("asyncio.start_server",) and node.args:
+                # the per-connection callback: one task per accepted
+                # socket — THE root that colors an asyncio server
+                for fk in resolve(mi, node.args[0], node):
+                    roots.append(("asyncio", fk))
+            elif cn in ("asyncio.to_thread",) and node.args:
+                for fk in resolve(mi, node.args[0], node):
+                    roots.append(("executor", fk))
+            elif tail == "run_in_executor" and len(node.args) > 1:
+                recv = ""
+                if isinstance(node.func, ast.Attribute):
+                    recv = name_tail(node.func.value) or ""
+                if any(w in recv.lower() for w in _LOOPISH):
+                    for fk in resolve(mi, node.args[1], node):
+                        roots.append(("executor", fk))
     return roots
 
 

@@ -183,11 +183,13 @@ def latest_costs() -> Optional[dict]:
         return _COSTS_DOC
 
 
-def health_doc(frontend=None) -> dict:
+def health_doc(frontend=None, router=None) -> dict:
     """The ``/healthz`` payload: process liveness plus — when a serving
     frontend is wired in — pump-thread liveness, queue depth, active
     slots, and the pump's terminal failure if it died. Shape pinned by
-    tests/test_observability.py."""
+    tests/test_observability.py (the frontend-only shape is unchanged;
+    ``router=`` ADDS a ``router`` block with per-replica liveness and
+    queue depth — the router-level health the HTTP surface serves)."""
     doc = {"ok": True, "time_unix": time.time(), "frontend": False,
            "pump_alive": False, "queue_depth": None, "active_slots": None,
            "failure": None}
@@ -199,6 +201,26 @@ def health_doc(frontend=None) -> dict:
             active_slots=frontend.active_slots,
             failure=repr(failure) if failure is not None else None)
         doc["ok"] = failure is None
+    if router is not None:
+        per_replica = []
+        for rep in router.replicas:
+            per_replica.append({
+                "replica": rep.index,
+                "alive": rep.alive,
+                "draining": rep.draining,
+                "pump_alive": rep.frontend.pump_alive if rep.alive
+                else False,
+                "queue_depth": rep.frontend.queue_depth if rep.alive
+                else None,
+                "failure": repr(rep.dead_reason)
+                if rep.dead_reason is not None else None,
+            })
+        n_alive = sum(1 for r in per_replica if r["alive"])
+        doc["router"] = {"replicas": len(per_replica), "alive": n_alive,
+                         "queue_depth": sum(r["queue_depth"] or 0
+                                            for r in per_replica),
+                         "per_replica": per_replica}
+        doc["ok"] = doc["ok"] and n_alive > 0
     return doc
 
 
@@ -213,7 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
                     + "\n").encode()
             ctype = "application/json"
         elif path == "/healthz":
-            doc = health_doc(getattr(self.server, "frontend", None))
+            doc = health_doc(getattr(self.server, "frontend", None),
+                             router=getattr(self.server, "router", None))
             body = (json.dumps(doc, sort_keys=True) + "\n").encode()
             ctype = "application/json"
         elif path == "/costs":
@@ -237,13 +260,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(port: int = 0, host: str = "127.0.0.1",
-          frontend=None) -> ThreadingHTTPServer:
+          frontend=None, router=None) -> ThreadingHTTPServer:
     """Start the metrics endpoint on a daemon thread. ``port=0`` binds an
     ephemeral port (read it from ``server.server_address[1]``).
     ``frontend=`` wires a :class:`~apex_tpu.serving.frontend.
-    ServingFrontend` into ``/healthz``."""
+    ServingFrontend` into ``/healthz``; ``router=`` a
+    :class:`~apex_tpu.serving.router.ReplicaRouter` (per-replica
+    liveness and queue depth in the ``router`` block)."""
     server = ThreadingHTTPServer((host, port), _Handler)
     server.frontend = frontend
+    server.router = router
     thread = threading.Thread(target=server.serve_forever,
                               name="apex-tpu-metrics", daemon=True)
     thread.start()

@@ -155,6 +155,16 @@ _SCENARIO_ROUTER_FIELDS = ("failover_recovered_rate",
                            "affinity_hit_rate", "round_robin_hit_rate",
                            "affinity_delta_hit_rate")
 
+#: per-scenario HTTP fields (the over-the-wire chaos tier,
+#: docs/http.md): extracted from a report's ``http`` block as
+#: ``scenario.<name>.http_<field>``. Counters, so informational —
+#: recorded in the banked trajectory (the spill/disconnect proof stays
+#: reviewable per round) while the scenario's SLO percentiles above do
+#: the band-gating
+_SCENARIO_HTTP_FIELDS = ("backpressure_spills", "disconnects",
+                         "conn_reset_retries", "slow_reader_stalls",
+                         "errors")
+
 #: numeric bench-record fields worth tracking besides the headline value
 _BENCH_FIELDS = (
     "step_ms", "int8_speedup", "step_savings",
@@ -191,6 +201,11 @@ def _scenario_metrics(doc: dict) -> Dict[str, float]:
             v = router.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"scenario.{name}.{field}"] = float(v)
+        http = rep.get("http", {}) if isinstance(rep, dict) else {}
+        for field in _SCENARIO_HTTP_FIELDS:
+            v = http.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"scenario.{name}.http_{field}"] = float(v)
     return out
 
 

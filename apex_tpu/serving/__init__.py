@@ -51,15 +51,32 @@ This package replaces that with the two serving-stack staples:
   terminal :class:`ServingError`, never a hang). ``faults`` makes the
   failures seeded, replayable scenario inputs (docs/router.md).
 
+- **HTTP/SSE surface** (``http`` + ``aio``): a stdlib-asyncio server
+  exposing ``POST /v1/generate`` token streaming (plus health, metrics,
+  and cost endpoints on the same port) over :class:`AsyncStreamHandle`,
+  an awaitable adapter on the thread-based pump. Admission ties to the
+  frontend's ``backpressure_window`` — a stalled reader spills its slot
+  through the preemption path instead of pinning pages for a socket —
+  and a client disconnect cancels at the next sync boundary and frees
+  everything. :class:`HttpReplicaClient` wraps a remote server in the
+  frontend surface so a :class:`ReplicaRouter` can supervise N
+  networked replicas exactly like in-process ones (docs/http.md).
+
 The decode attention is ``apex_tpu.ops.paged_attention`` — a Pallas kernel
 that gathers pages via the block table with scalar-prefetch index maps.
 """
 
+from apex_tpu.serving.aio import AsyncStreamHandle  # noqa: F401
 from apex_tpu.serving.faults import (  # noqa: F401
+    NETWORK_FAULT_KINDS,
     FaultInjector,
     FaultPlan,
     FaultSpec,
     InjectedFault,
+)
+from apex_tpu.serving.http import (  # noqa: F401
+    HttpReplicaClient,
+    HttpServingServer,
 )
 from apex_tpu.serving.frontend import (  # noqa: F401
     ServingError,

@@ -1,0 +1,152 @@
+"""Asyncio adapter over the thread-based serving pump.
+
+:class:`~apex_tpu.serving.frontend.StreamHandle` is a thread-queue
+object: the pump pushes tokens from its thread, consumers block in
+``get()``. An asyncio server cannot block its event loop, so
+:class:`AsyncStreamHandle` bridges the two worlds without adding any
+thread of its own:
+
+- it reads through the handle's lock-snapshotted ``tokens_so_far()``
+  cursor-style (never the blocking queue), so the event loop never
+  parks in a ``queue.Queue.get``;
+- the handle's listener seam (``StreamHandle.set_listener``) fires on
+  the PUMP's thread after every push/finish/fail; the adapter trampolines
+  it onto the loop with ``call_soon_threadsafe`` to set one
+  ``asyncio.Event`` — the only cross-thread traffic is that wake-up;
+- consumption is **explicitly acked**: reading a token here does NOT
+  mark it consumed. The HTTP writer calls :meth:`ack` only after
+  ``await writer.drain()`` returns for that token's bytes, which is what
+  ties socket backpressure to the frontend's spill window
+  (``ServingFrontend(backpressure_window=...)`` — docs/http.md).
+
+The adapter holds no sync lock across an ``await`` (the conc-lint tier's
+``conc-await-under-lock`` rule binds that for the whole repo — an await
+under a held ``threading.Lock`` wedges every task on the loop, including
+the one that would release it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from apex_tpu.serving.frontend import StreamHandle
+
+__all__ = ["AsyncStreamHandle"]
+
+
+class AsyncStreamHandle:
+    """One request's token stream as an async iterator.
+
+    Wraps a :class:`StreamHandle` for consumption from a single asyncio
+    task (one connection = one adapter = one consumer task; the adapter
+    is NOT safe for concurrent ``get()`` from multiple tasks). The
+    underlying handle remains fully usable — ``cancel()``/``result()``
+    delegate to it.
+    """
+
+    def __init__(self, handle: StreamHandle,
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        self.handle = handle
+        self._loop = loop if loop is not None \
+            else asyncio.get_event_loop()
+        self._evt = asyncio.Event()
+        self._cursor = 0                 # tokens read through get()
+        handle.set_listener(self._wake)
+
+    # -- pump-thread side ----------------------------------------------------
+
+    def _wake(self) -> None:
+        """Listener trampoline: runs on the pump thread; the only thing
+        it may touch is the loop's threadsafe call queue."""
+        try:
+            self._loop.call_soon_threadsafe(self._evt.set)
+        except RuntimeError:
+            pass                         # loop already closed — nothing
+        #                                  left to wake
+
+    # -- event-loop side -----------------------------------------------------
+
+    @property
+    def request_id(self):
+        return self.handle.request_id
+
+    @property
+    def done(self) -> bool:
+        return self.handle.done
+
+    @property
+    def cancelled(self) -> bool:
+        return self.handle.cancelled
+
+    @property
+    def error(self):
+        return self.handle.error
+
+    @property
+    def cursor(self) -> int:
+        """Tokens read so far (== the index to :meth:`ack` once their
+        bytes are drained)."""
+        return self._cursor
+
+    def cancel(self) -> None:
+        self.handle.cancel()
+
+    def ack(self, n: Optional[int] = None) -> None:
+        """Mark the first ``n`` tokens (default: everything read so
+        far) consumed on the underlying handle — the backpressure
+        signal. Call AFTER the transport accepted the bytes."""
+        self.handle.ack(self._cursor if n is None else n)
+
+    async def get(self) -> Optional[int]:
+        """Next token, or None once the stream terminated; raises the
+        terminal :class:`~apex_tpu.serving.frontend.ServingError` if the
+        request failed. Never blocks the event loop."""
+        while True:
+            toks = self.handle.tokens_so_far()
+            if self._cursor < len(toks):
+                tok = toks[self._cursor]
+                self._cursor += 1
+                return int(tok)
+            if self.handle.done:
+                err = self.handle.error
+                if err is not None:
+                    raise err
+                return None
+            self._evt.clear()
+            # close the set-before-clear race: a push between the
+            # snapshot above and the clear would otherwise be lost
+            if (len(self.handle.tokens_so_far()) > self._cursor
+                    or self.handle.done):
+                continue
+            await self._evt.wait()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        tok = await self.get()
+        if tok is None:
+            raise StopAsyncIteration
+        return tok
+
+    async def wait_done(self, timeout: Optional[float] = None) -> bool:
+        """Await stream termination (True) or timeout (False) without
+        blocking the loop."""
+        deadline = (self._loop.time() + timeout
+                    if timeout is not None else None)
+        while not self.handle.done:
+            self._evt.clear()
+            if self.handle.done:
+                break
+            if deadline is None:
+                await self._evt.wait()
+                continue
+            left = deadline - self._loop.time()
+            if left <= 0:
+                return False
+            try:
+                await asyncio.wait_for(self._evt.wait(), left)
+            except asyncio.TimeoutError:
+                return False
+        return True

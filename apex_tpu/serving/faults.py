@@ -40,6 +40,29 @@ Fault kinds:
   delays ``delay_ms`` per tick (``consume_delay_s``): a slow client;
   streams must stay ordered and the pump unblocked (handles buffer,
   pages never pin on consumption).
+
+Network fault kinds (applied CLIENT-side, by the HTTP load driver in
+``scenarios/http_driver.py`` — they model the wire, so they never go
+through the frontend's ``fault_hook`` seams):
+
+- ``client_disconnect`` — the client reads ``at`` token events, then
+  drops the connection (RST): the server must cancel at the next sync
+  boundary and free every page; the request's output is a prefix.
+- ``slow_reader`` — the client reads ``at`` tokens, then stops reading
+  (socket open, recv window filling) for ``delay_ms``, then resumes to
+  completion: the frontend's backpressure window must spill the slot
+  (pages into the radix cache) and resume it on consumption,
+  token-identically.
+- ``conn_reset`` — the connection resets mid-submission (partial
+  request then abort): the request never reaches the engine; the
+  driver retries once on a fresh connection (client-level retry — the
+  server must survive the torn request without leaking the
+  connection).
+
+For network kinds ``replica`` keeps its meaning (which server, 0 for a
+single-replica scenario) and the fault applies to request ids
+``{0, …, count-1}`` — deterministic, so chaos checks know exactly which
+outputs are prefixes (``FaultPlan.net_faults_for``).
 """
 
 from __future__ import annotations
@@ -52,11 +75,16 @@ from typing import List, Optional, Sequence, Tuple
 
 from apex_tpu.serving.frontend import ServingError
 
-__all__ = ["FAULT_KINDS", "FaultSpec", "FaultPlan", "FaultInjector",
-           "InjectedFault"]
+__all__ = ["FAULT_KINDS", "NETWORK_FAULT_KINDS", "FaultSpec",
+           "FaultPlan", "FaultInjector", "InjectedFault"]
 
 FAULT_KINDS = ("kill_replica", "pump_stall", "admission_reject",
-               "slow_consumer")
+               "slow_consumer", "client_disconnect", "slow_reader",
+               "conn_reset")
+
+#: the client-side kinds — delivered by the HTTP load driver on the
+#: wire, never through the frontend's fault_hook seams
+NETWORK_FAULT_KINDS = ("client_disconnect", "slow_reader", "conn_reset")
 
 
 class InjectedFault(ServingError):
@@ -71,10 +99,12 @@ class FaultSpec:
 
     ``at`` is the trigger index in the fault kind's own counter —
     pump iterations (0-based) for ``kill_replica``/``pump_stall``,
-    submissions for ``admission_reject``; ignored by ``slow_consumer``
-    (which applies from the first token). ``count`` bounds repeating
-    faults (stalled iterations / rejected submissions); ``delay_ms``
-    is the stall or per-tick consumer delay."""
+    submissions for ``admission_reject``, the token index for
+    ``client_disconnect``/``slow_reader``; ignored by
+    ``slow_consumer``/``conn_reset``. ``count`` bounds repeating faults
+    (stalled iterations / rejected submissions / affected request ids
+    for network kinds); ``delay_ms`` is the stall, per-tick consumer
+    delay, or the slow reader's stall duration."""
 
     kind: str
     replica: int = 0
@@ -92,7 +122,7 @@ class FaultSpec:
             raise ValueError("count must be >= 1")
         if self.delay_ms < 0:
             raise ValueError("delay_ms must be >= 0")
-        if self.kind in ("pump_stall", "slow_consumer") \
+        if self.kind in ("pump_stall", "slow_consumer", "slow_reader") \
                 and self.delay_ms == 0:
             raise ValueError(f"{self.kind} needs delay_ms > 0")
 
@@ -108,9 +138,20 @@ class FaultPlan:
 
     def injector(self, replica: int) -> Optional["FaultInjector"]:
         """The replica's frontend hook, or None when this plan holds
-        nothing for it (no hook = zero per-iteration overhead)."""
-        specs = self.for_replica(replica)
+        nothing for it (no hook = zero per-iteration overhead).
+        Network kinds never ride the hook — they are the load driver's
+        to deliver (``net_faults_for``)."""
+        specs = tuple(s for s in self.for_replica(replica)
+                      if s.kind not in NETWORK_FAULT_KINDS)
         return FaultInjector(specs) if specs else None
+
+    def net_faults_for(self, request_id: int) -> Tuple[FaultSpec, ...]:
+        """The network faults the load driver applies to ``request_id``
+        — a network spec covers request ids ``{0, …, count-1}``
+        (deterministic, so prefix-tolerant checks know their ids)."""
+        return tuple(s for s in self.specs
+                     if s.kind in NETWORK_FAULT_KINDS
+                     and request_id < s.count)
 
     # -- JSON round-trip (rides inside ScenarioSpec) -------------------------
 

@@ -123,18 +123,32 @@ class StreamHandle:
         self._cancelled = threading.Event()
         self._output: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
+        # consumption cursor: tokens the consumer has actually taken
+        # (``get``/iteration advance it implicitly; ``ack`` explicitly —
+        # the HTTP writer acks only after the socket accepted the bytes,
+        # so ``unread()`` is the per-connection in-flight-token window
+        # the frontend's backpressure spill keys on)
+        self._consumed = 0
+        self._listener = None            # push/terminate notification
 
     # -- pump side -----------------------------------------------------------
 
     def _push(self, tok: int) -> None:
         with self._lock:
             self._tokens.append(tok)
+            listener = self._listener
         self._q.put(tok)
+        if listener is not None:
+            listener()                   # outside the lock, by contract
 
     def _finish(self, output: np.ndarray) -> None:
         self._output = output
         self._done.set()
         self._q.put(_END)
+        with self._lock:
+            listener = self._listener
+        if listener is not None:
+            listener()
 
     def _fail(self, exc: BaseException) -> None:
         # terminal errors surface as ServingError everywhere (result,
@@ -147,6 +161,10 @@ class StreamHandle:
         self._error = exc
         self._done.set()
         self._q.put(_END)
+        with self._lock:
+            listener = self._listener
+        if listener is not None:
+            listener()
 
     # -- caller side ---------------------------------------------------------
 
@@ -169,6 +187,36 @@ class StreamHandle:
         with self._lock:
             return list(self._tokens)
 
+    def unread(self) -> int:
+        """Tokens pushed but not yet consumed — the per-consumer
+        in-flight window. ``get``/iteration consume implicitly;
+        adapters that read via :meth:`tokens_so_far` (the asyncio
+        bridge) must :meth:`ack` explicitly."""
+        with self._lock:
+            return len(self._tokens) - self._consumed
+
+    def ack(self, n: int) -> None:
+        """Mark the first ``n`` streamed tokens consumed (monotonic;
+        clamped to what has been pushed). The HTTP writer calls this
+        after the socket accepted a token's bytes — a stalled reader
+        stops acking and :meth:`unread` grows until the frontend spills
+        the slot."""
+        with self._lock:
+            self._consumed = max(self._consumed,
+                                 min(n, len(self._tokens)))
+
+    def set_listener(self, fn) -> None:
+        """Register one callback fired (outside the handle lock, on the
+        pusher's thread) after every push/finish/fail — the seam the
+        asyncio adapter uses to wake its event loop. Fires once
+        immediately if the stream already has tokens or terminated, so
+        a late registration can never miss the wake-up."""
+        with self._lock:
+            self._listener = fn
+            pending = bool(self._tokens) or self._done.is_set()
+        if pending and fn is not None:
+            fn()
+
     @property
     def error(self) -> Optional[BaseException]:
         """The terminal :class:`ServingError`, if the request failed
@@ -187,6 +235,8 @@ class StreamHandle:
             if self._error is not None:
                 raise self._error
             return None
+        with self._lock:
+            self._consumed += 1          # queue order == push order
         return tok
 
     def __iter__(self):
@@ -294,8 +344,18 @@ class ServingFrontend:
 
     def __init__(self, engine, *, policy: Optional[PriorityDeadlinePolicy]
                  = None, tracer: Optional[SpanTracer] = None,
-                 clock=time.perf_counter, fault_hook=None):
+                 clock=time.perf_counter, fault_hook=None,
+                 backpressure_window: Optional[int] = None):
         self.engine = engine
+        # per-consumer in-flight-token bound (None = unbounded, the
+        # pre-HTTP behavior): an active slot whose handle has more than
+        # this many unconsumed tokens is spilled through the preemption
+        # path — pages into the radix cache, slot freed — and held out
+        # of re-admission until the consumer catches back up to half the
+        # window. Pool pages are never pinned by a stalled socket.
+        if backpressure_window is not None and backpressure_window < 1:
+            raise ValueError("backpressure_window must be >= 1")
+        self.backpressure_window = backpressure_window
         # fault-injection seam (serving/faults.py): an object with
         # ``on_pump(frontend)`` (start of every pump iteration — may
         # raise to kill the pump, or sleep to stall it) and
@@ -553,10 +613,12 @@ class ServingFrontend:
                 self._last_ready = None
         if prev is not None:
             self._harvest(prev)
+        self._backpressure_spill()
         self._drop_window_pages()
         self._advance_prefills()
         admitted = self._admission()
-        if (self._pending and not self._active and self._inflight is None
+        if (any(not self._bp_held(e) for e in self._pending)
+                and not self._active and self._inflight is None
                 and not admitted):
             raise RuntimeError(
                 "scheduler deadlock: queued request cannot be admitted "
@@ -575,7 +637,13 @@ class ServingFrontend:
             self._host_H.observe(host_ms)
             self._per_run["pump.host_work_ms"].append(host_ms)
         self._check_compile_storm()
-        alive = bool(self._pending or self._active or self._inflight)
+        # a pending entry held by backpressure does not count as live
+        # work: the pump has nothing to do for it until its consumer
+        # catches up, so the background loop falls back to its bounded
+        # re-poll (work_evt wait) instead of busy-spinning, and a
+        # synchronous drain() returns rather than hanging on a socket
+        alive = bool(self._active or self._inflight
+                     or any(not self._bp_held(e) for e in self._pending))
         if not alive:
             self._last_ready = None      # idle gaps are not bubbles
         return alive
@@ -696,7 +764,14 @@ class ServingFrontend:
                     if budget < 0:
                         break
                 if not self.pump():
-                    break
+                    # a drain can go idle with backpressure-HELD entries
+                    # still pending (their consumers stalled): keep
+                    # waiting for consumption until the deadline flips
+                    # us to cancellation; anything else idle is done
+                    if cancelled or not any(self._bp_held(e)
+                                            for e in self._pending):
+                        break
+                    time.sleep(0.002)
         except Exception:                # noqa: BLE001 — handles already
             pass                         # failed by pump(); stop cleanly
         leftovers = []
@@ -983,6 +1058,55 @@ class ServingFrontend:
         entry.nodes = []
         entry.resume = True
         self._pending.append(entry)
+
+    # --- consumption-aware backpressure (docs/http.md) ----------------------
+
+    def _bp_stalled(self, entry: _Entry) -> bool:
+        """An ACTIVE slot whose consumer is stalled past the window —
+        a backpressure-spill victim. Cancelled handles are excluded
+        (harvest retires them; their pages free anyway)."""
+        w = self.backpressure_window
+        return (w is not None and not entry.prefilling
+                and not entry.handle.cancelled
+                and entry.handle.unread() > w)
+
+    def _bp_held(self, entry: _Entry) -> bool:
+        """A PENDING entry whose consumer is still behind — held out of
+        admission (re-admitting would spill again next boundary).
+        Hysteresis: released once unread falls to half the window, so a
+        resumed slot gets a full half-window of runway. Cancelled
+        entries are never held (admission finishes them)."""
+        w = self.backpressure_window
+        return (w is not None and entry.resume
+                and not entry.handle.cancelled
+                and entry.handle.unread() > w // 2)
+
+    def _backpressure_spill(self) -> None:
+        """Spill every active slot whose reader stalled past the
+        in-flight-token window through the PREEMPTION path: flush the
+        pipeline, release the slot's full pages into the radix cache
+        (partial tail frees), requeue the entry for resume-on-
+        consumption. This bypasses the policy's ``wants_preempt`` gate —
+        the victim is not losing its slot to a more urgent request, it
+        is refusing to pin pool pages behind a dead socket."""
+        if self.backpressure_window is None or not self._active:
+            return
+        victims = [s for s, e in self._active.items()
+                   if self._bp_stalled(e)]
+        if not victims:
+            return
+        self._flush()                    # victim state must be current
+        for slot in victims:
+            entry = self._active.get(slot)
+            if entry is None or not self._bp_stalled(entry):
+                continue                 # the flush retired/changed it
+            self._C["backpressure_spills"].inc()
+            self.tracer.event(entry.idx, "backpressure_spill",
+                              slot=slot, unread=entry.handle.unread())
+            self.engine.events.emit("backpressure_spill",
+                                    request=entry.idx, slot=slot,
+                                    unread=entry.handle.unread())
+            self._preempt(slot)
 
     def _maybe_preempt(self, candidate: _Entry, now: float) -> bool:
         """Try to free a slot (and spill pages) for a blocked
@@ -1290,6 +1414,16 @@ class ServingFrontend:
         policy order)."""
         eng = self.engine
         now = self.clock()
+        held: List[_Entry] = []
+        if self.backpressure_window is not None and self._pending:
+            # backpressure-held entries sit out this admission pass
+            # entirely (they are waiting on their CONSUMER, not on
+            # slots/pages) — and must not head-of-line-block the queue
+            held = [e for e in self._pending if self._bp_held(e)]
+            if held:
+                held_ids = {id(e) for e in held}
+                self._pending = [e for e in self._pending
+                                 if id(e) not in held_ids]
         self._pending.sort(key=lambda e: self.policy.sort_key(e, now))
         admitted = 0
         preempts_left = eng.num_slots    # bound the preempt-retry loop
@@ -1319,6 +1453,7 @@ class ServingFrontend:
                 preempts_left -= 1
                 continue
             break
+        self._pending.extend(held)
         return admitted
 
     # --- recompile storm check ----------------------------------------------
@@ -1373,6 +1508,7 @@ class ServingFrontend:
             "tp_world": int(getattr(eng, "tp_world", 1)),
             "preemptions": int(d["preemptions"]),
             "resumes": int(d["resumes"]),
+            "backpressure_spills": int(d["backpressure_spills"]),
             "deadline_misses": int(d["deadline_misses"]),
             "tpot_slo_misses": int(d["tpot_slo_misses"]),
             "window_dropped_pages": int(d["window_dropped_pages"]),

@@ -22,8 +22,13 @@ makes workloads DECLARATIVE, SEEDED, and REPLAYABLE:
   ``priority-flood``, ``windowed-llama``, the two bench workloads, the
   ``preemption-storm`` adversary, and the replicated-serving tier:
   ``chaos-replica-kill`` / ``chaos-pump-stall`` (seeded fault injection
-  through ``serving/faults.py``) and ``router-affinity-ab`` (the
-  affinity-vs-round-robin hit-rate A/B over ``serving/router.py``)).
+  through ``serving/faults.py``), ``router-affinity-ab`` (the
+  affinity-vs-round-robin hit-rate A/B over ``serving/router.py``), and
+  the over-the-wire network-chaos tier ``chaos-slow-reader`` /
+  ``chaos-disconnect-storm`` (``EngineSpec(http=True)`` replays the
+  trace through a real localhost HTTP/SSE server via
+  :mod:`http_driver`, delivering the NETWORK fault kinds on the client
+  side of the socket; the report grows an ``http`` block)).
 
 CLI: ``python -m apex_tpu.serving.scenarios --list`` /
 ``--scenario NAME [--scenario NAME ...] --json OUT --seed N [--check]``
@@ -42,6 +47,7 @@ from apex_tpu.serving.scenarios.library import (  # noqa: F401
 )
 from apex_tpu.serving.scenarios.report import (  # noqa: F401
     AGGREGATE_FIELDS,
+    HTTP_FIELDS,
     REPORT_SCHEMA,
     ROUTER_FIELDS,
     SCENARIOS_SCHEMA,

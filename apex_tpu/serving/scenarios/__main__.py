@@ -41,6 +41,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run the correctness amplifiers (greedy "
                              "token identity vs lock-step + scheduling "
                              "invariance)")
+    parser.add_argument("--http", action="store_true",
+                        help="force replay over localhost HTTP/SSE "
+                             "(EngineSpec(http=True)): every request is "
+                             "a real POST /v1/generate stream and the "
+                             "report grows the pinned http block")
     parser.add_argument("--save-trace", default=None, metavar="DIR",
                         help="save each materialized trace as "
                              "<DIR>/<name>.trace.jsonl")
@@ -74,6 +79,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyError as e:
             print(f"[scenarios] {e.args[0]}")
             return 2
+    if args.http:
+        # drive the same catalog entries over the wire: replay_http boots
+        # an HttpServingServer and each trace event becomes a real SSE
+        # stream (docs/http.md) — the amplifiers then prove the transport
+        # corrupts nothing
+        import dataclasses
+        specs = {name: dataclasses.replace(
+                     spec,
+                     engine=dataclasses.replace(spec.engine, http=True))
+                 for name, spec in specs.items()}
 
     reports = {}
     check_failed = False

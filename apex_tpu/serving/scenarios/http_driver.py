@@ -1,0 +1,271 @@
+"""Closed-loop HTTP client driver for scenario replay (ISSUE 15).
+
+``EngineSpec(http=True)`` routes a scenario's replay over the wire: the
+trace's requests are submitted as real ``POST /v1/generate`` streams
+against an in-process :class:`~apex_tpu.serving.http.HttpServingServer`
+on localhost, one client thread per request honoring the trace's
+arrival times. Outputs are what the CLIENT read off the socket — so the
+greedy-identity amplifier proves the whole transport (submit body, SSE
+framing, ack-driven backpressure, cancel-on-disconnect) end to end, not
+just the in-process pump.
+
+The driver is also the delivery vehicle for the NETWORK fault kinds
+(``serving/faults.py``): they model the wire, so they are applied on
+the client side of the socket, never through the frontend's
+``fault_hook`` seams —
+
+- ``client_disconnect`` — read ``at`` token events, then drop the
+  connection for real (``sock.shutdown(SHUT_RDWR)`` — ``close()``
+  alone defers the FIN while a ``makefile`` reader holds the fd, and
+  the server would never see the drop); the request's banked output is
+  the prefix the client read, and the server must cancel + free pages.
+- ``slow_reader`` — read ``at`` tokens, stop reading for ``delay_ms``
+  with the socket open (recv window fills, ``writer.drain()`` parks,
+  unconsumed tokens cross the frontend's ``backpressure_window``, the
+  slot spills), then resume to completion — token-identically, which
+  the identity amplifier then proves.
+- ``conn_reset`` — tear the connection mid-REQUEST (half the bytes,
+  then an RST via ``SO_LINGER 0``), then retry once on a fresh
+  connection: the request never reached the engine, the server must
+  survive the torn submit, and the retry completes normally.
+
+Faults target request ids ``{0, …, count-1}`` (``FaultPlan.
+net_faults_for``), so the checks know exactly which outputs are
+prefixes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["replay_http"]
+
+
+def _post_stream(host: str, port: int, body: dict, *,
+                 disconnect_at: Optional[int],
+                 slow_at: Optional[int], slow_s: float,
+                 timeout_s: float = 60.0) -> dict:
+    """One generate stream; returns ``{"tokens", "finish",
+    "disconnected", "stalled"}``. Fault knobs: ``disconnect_at`` drops
+    the connection after that many token events; ``slow_at`` stops
+    reading for ``slow_s`` after that many."""
+    from apex_tpu.serving.http import _iter_sse
+
+    raw = json.dumps(body).encode()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    if slow_at is not None:
+        # a slow reader only exerts backpressure once the kernel
+        # buffers fill — shrink the receive window (must happen BEFORE
+        # connect: the window scale is fixed at the handshake)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 2048)
+    sock.settimeout(timeout_s)
+    try:
+        sock.connect((host, port))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.sendall((f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                      f"Content-Length: {len(raw)}\r\n"
+                      f"Connection: close\r\n\r\n").encode() + raw)
+        f = sock.makefile("rb")
+        status_line = f.readline().decode("latin-1")
+        while f.readline() not in (b"\r\n", b"\n", b""):
+            pass
+        parts = status_line.split(" ", 2)
+        status = int(parts[1]) if len(parts) > 1 else 0
+        if status != 200:
+            payload = f.read().decode("utf-8", "replace")
+            raise RuntimeError(f"HTTP {status} from /v1/generate: "
+                               f"{payload[:200]}")
+        out: dict = {"tokens": [], "finish": None,
+                     "disconnected": False, "stalled": False}
+        if disconnect_at is not None and disconnect_at == 0:
+            sock.shutdown(socket.SHUT_RDWR)   # drop before any token
+            out["disconnected"] = True
+            return out
+        for event, data in _iter_sse(f):
+            if event == "token":
+                out["tokens"].append(int(data["token"]))
+                n = len(out["tokens"])
+                if disconnect_at is not None and n >= disconnect_at:
+                    # a REAL drop: close() would keep the fd alive under
+                    # the makefile reader and the server never notices
+                    sock.shutdown(socket.SHUT_RDWR)
+                    out["disconnected"] = True
+                    return out
+                if slow_at is not None and n == slow_at:
+                    out["stalled"] = True
+                    time.sleep(slow_s)   # socket open, nothing read
+            elif event == "done":
+                out["finish"] = data.get("finish_reason")
+                return out
+            elif event == "error":
+                raise RuntimeError(f"stream error: {data.get('error')}")
+        raise RuntimeError("stream ended without a terminal event")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _torn_submit(host: str, port: int, body: dict) -> None:
+    """The ``conn_reset`` fault: half a request, then an RST. The
+    server must survive the torn submit (the request never reaches the
+    engine); the caller retries on a fresh connection."""
+    raw = json.dumps(body).encode()
+    head = (f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(raw)}\r\n\r\n").encode()
+    wire = head + raw
+    sock = socket.create_connection((host, port), timeout=10.0)
+    try:
+        sock.sendall(wire[:max(len(wire) // 2, 1)])
+        # SO_LINGER(on, 0): close sends RST, not FIN — the reset the
+        # fault kind is named for
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    finally:
+        sock.close()
+
+
+def _client(host: str, port: int, e, net, due: float,
+            results: Dict[int, dict],
+            errors: List[BaseException]) -> None:
+    """One request's closed-loop client: wait for the arrival time,
+    apply its network faults, bank what the socket delivered."""
+    try:
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        body = {"prompt": list(e.prompt),
+                "max_new_tokens": e.max_new_tokens,
+                "priority": e.priority,
+                "request_id": e.request_id}
+        if e.deadline_ms is not None:
+            body["deadline_ms"] = e.deadline_ms
+        if e.tpot_slo_ms is not None:
+            body["tpot_slo_ms"] = e.tpot_slo_ms
+        disconnect_at = slow_at = None
+        slow_s = 0.0
+        retried = 0
+        for spec in net:
+            if spec.kind == "conn_reset":
+                _torn_submit(host, port, body)
+                retried += 1
+            elif spec.kind == "client_disconnect":
+                disconnect_at = spec.at
+            elif spec.kind == "slow_reader":
+                slow_at = spec.at
+                slow_s = spec.delay_ms * 1e-3
+        res = _post_stream(host, port, body,
+                           disconnect_at=disconnect_at,
+                           slow_at=slow_at, slow_s=slow_s)
+        res["retried"] = retried
+        results[e.request_id] = res
+    except BaseException as exc:       # noqa: BLE001 — banked, re-raised
+        errors.append(exc)
+
+
+def replay_http(spec, trace):
+    """Replay ``trace`` through a localhost HTTP server over a fresh
+    threaded frontend; returns ``(outputs, stats, tracer, wall_s,
+    http_block)`` — the same surface as the in-process replay plus the
+    report's ``http`` block."""
+    from apex_tpu.serving.faults import FaultPlan
+    from apex_tpu.serving.frontend import ServingFrontend
+    from apex_tpu.serving.http import HttpServingServer
+    from apex_tpu.serving.kv_pool import free_page_count
+    from apex_tpu.serving.policy import PriorityDeadlinePolicy
+    from apex_tpu.serving.scenarios import runner
+
+    es = spec.engine
+    if es.replicas > 1:
+        raise ValueError("http replay is single-replica (the router-"
+                         "over-HTTP surface lives in serving/http.py's "
+                         "HttpReplicaClient; see tests/test_http.py)")
+    plan = FaultPlan(specs=tuple(spec.faults))
+    _, model, v = runner.build_model(es.model)
+    engine = runner._build_engine(spec, model, v)
+    pages_total = free_page_count(engine.cache)
+    policy = PriorityDeadlinePolicy(
+        preempt_on_priority=es.preempt_on_priority,
+        preempt_margin_ms=es.preempt_margin_ms)
+    frontend = ServingFrontend(
+        engine, policy=policy, fault_hook=plan.injector(0),
+        backpressure_window=es.backpressure_window)
+    frontend.start()
+    server = HttpServingServer(
+        frontend, sse_pad_bytes=es.sse_pad_bytes,
+        sndbuf=es.sndbuf).start()
+    results: Dict[int, dict] = {}
+    errors: List[BaseException] = []
+    t0 = time.perf_counter()
+    try:
+        threads = []
+        for e in trace.events:
+            due = t0 + e.arrival_ms * spec.time_scale * 1e-3
+            t = threading.Thread(
+                target=_client,
+                args=(server.host, server.port, e,
+                      plan.net_faults_for(e.request_id), due,
+                      results, errors),
+                name=f"scenario-http-client-{e.request_id}",
+                daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120.0)
+        if any(t.is_alive() for t in threads):
+            raise AssertionError(
+                f"scenario {spec.name!r}: HTTP client threads hung")
+        if errors:
+            raise AssertionError(
+                f"scenario {spec.name!r}: HTTP client failed: "
+                f"{errors[0]!r}") from errors[0]
+        server.drain(deadline_s=30.0)
+        wall_s = time.perf_counter() - t0
+        stats = frontend.stats()
+        deltas = server.http_counter_deltas()
+        # the no-pin/no-leak contract, checked in-band: once every
+        # stream resolved, every pool page is either free or parked in
+        # the radix cache — a socket pinned nothing. A disconnect's
+        # cancel retires at the pump's next sync boundary, so give the
+        # accounting a bounded moment to settle before declaring a leak
+        deadline = time.monotonic() + 10.0
+        while True:
+            cached = (len(engine.prefix) if engine.prefix is not None
+                      else 0)
+            free_after = free_page_count(engine.cache)
+            if free_after + cached == pages_total:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"scenario {spec.name!r}: page leak over HTTP — "
+                    f"{free_after} free + {cached} cached != "
+                    f"{pages_total} total")
+            time.sleep(0.01)
+        http_block = {
+            "streams": int(deltas["streams"]),
+            "tokens": int(deltas["tokens"]),
+            "disconnects": int(deltas["disconnects"]),
+            "rejected": int(deltas["rejected"]),
+            "errors": int(deltas["errors"]),
+            "conn_reset_retries": int(sum(
+                r.get("retried", 0) for r in results.values())),
+            "slow_reader_stalls": int(sum(
+                1 for r in results.values() if r.get("stalled"))),
+            "backpressure_spills": int(
+                stats.get("backpressure_spills", 0)),
+            "free_pages_recovered": int(free_after),
+        }
+        outputs = [np.asarray(results[e.request_id]["tokens"], np.int32)
+                   for e in trace.events]
+        return outputs, stats, frontend.tracer, wall_s, http_block
+    finally:
+        server.shutdown(deadline_s=10.0)
+        frontend.shutdown(deadline_s=10.0)

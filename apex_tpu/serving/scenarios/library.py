@@ -48,6 +48,18 @@ The catalog (docs/scenarios.md has the prose):
   (the greedy-identity amplifier proves recovery corrupts nothing).
 - ``chaos-pump-stall`` — a wedged-but-alive replica (injected pump
   stalls): latency, not death — nothing may hang, fail over, or leak.
+- ``chaos-slow-reader`` — the replay driven over real localhost HTTP
+  (``EngineSpec(http=True)``, scenarios/http_driver.py): clients stop
+  reading their SSE streams mid-generation, unconsumed tokens cross the
+  frontend's ``backpressure_window``, the slot spills into the radix
+  cache, and every stream still completes token-identically when the
+  reader resumes — the no-pin contract, banked (``http.
+  backpressure_spills``).
+- ``chaos-disconnect-storm`` — the HTTP replay under network chaos:
+  several clients drop their sockets for real mid-stream and two tear
+  their connections mid-request (RST) then retry; the server must
+  cancel, free every page, and keep serving — surviving outputs
+  token-identical, dropped ones exact prefixes.
 - ``router-affinity-ab`` — the multi-tenant workload over 2 replicas,
   replayed under affinity routing AND round-robin on the same trace:
   the aggregate prefix hit-rate delta is the banked proof affinity
@@ -306,6 +318,59 @@ def _chaos_pump_stall(seed: int) -> ScenarioSpec:
                           delay_ms=20.0),),
         description="injected pump stalls on one replica: latency, "
                     "not death")
+
+
+@register("chaos-slow-reader")
+def _chaos_slow_reader(seed: int) -> ScenarioSpec:
+    # over-the-wire replay with stalled readers: requests 0 and 1 read
+    # two tokens then stop reading for 700 ms with the socket open.
+    # The padded SSE frames + tiny kernel buffers (sndbuf/SO_RCVBUF)
+    # make the TCP window fill within a few events, writer.drain()
+    # parks, acks stop, and the pump — still generating — crosses the
+    # 6-token backpressure window: the slot spills into the radix cache
+    # instead of pinning pages for a socket. When the reader resumes,
+    # the stream completes token-identically (the identity amplifier
+    # proves the spill/resume cycle corrupted nothing). Outputs are
+    # pinned long (48 tokens) so the stall always lands mid-generation.
+    return ScenarioSpec(
+        name="chaos-slow-reader", seed=seed, n_requests=4,
+        arrival=Arrival(kind="poisson", rate_rps=200.0),
+        prompt_lens=Lengths(kind="uniform", lo=6, hi=12),
+        output_lens=Lengths(kind="uniform", lo=48, hi=48),
+        tenants=(Tenant("default", output_tokens=48),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=8,
+                          prefix_cache=True, http=True,
+                          backpressure_window=6, sse_pad_bytes=2048,
+                          sndbuf=4096),
+        faults=(FaultSpec(kind="slow_reader", at=2, count=2,
+                          delay_ms=700.0),),
+        description="stalled SSE readers cross the backpressure window:"
+                    " spill, resume, token-identical completion")
+
+
+@register("chaos-disconnect-storm")
+def _chaos_disconnect_storm(seed: int) -> ScenarioSpec:
+    # network chaos on the HTTP surface: requests 0-3 drop their
+    # sockets for real (shutdown(SHUT_RDWR)) after reading 3 tokens,
+    # and requests 0-1 additionally tear their submit mid-request with
+    # an RST (SO_LINGER 0) before retrying on a fresh connection. The
+    # server must notice every drop, cancel at the next sync boundary,
+    # free the pages (the driver's leak check), and keep streaming the
+    # survivors untouched. Outputs are pinned at 24 tokens so the drop
+    # always lands mid-generation; the greedy/scheduling checks accept
+    # exact PREFIXES for the dropped ids (runner._net_prefix_ids).
+    return ScenarioSpec(
+        name="chaos-disconnect-storm", seed=seed, n_requests=10,
+        arrival=Arrival(kind="poisson", rate_rps=300.0),
+        prompt_lens=Lengths(kind="uniform", lo=6, hi=16),
+        output_lens=Lengths(kind="uniform", lo=24, hi=24),
+        tenants=(Tenant("default", output_tokens=24),),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=3, page_size=8,
+                          prefix_cache=True, http=True),
+        faults=(FaultSpec(kind="client_disconnect", at=3, count=4),
+                FaultSpec(kind="conn_reset", count=2)),
+        description="mid-stream socket drops + torn submits: cancel, "
+                    "free pages, survivors token-identical")
 
 
 @register("router-affinity-ab")

@@ -23,8 +23,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["REPORT_SCHEMA", "SCENARIOS_SCHEMA", "AGGREGATE_FIELDS",
-           "TENANT_FIELDS", "ROUTER_FIELDS", "build_report",
-           "validate_report"]
+           "TENANT_FIELDS", "ROUTER_FIELDS", "HTTP_FIELDS",
+           "build_report", "validate_report"]
 
 REPORT_SCHEMA = "apex-tpu/scenario-report/v1"
 #: the multi-scenario CLI document wrapping one report per scenario
@@ -59,6 +59,14 @@ ROUTER_FIELDS = (
     "replica_deaths", "affinity_hit_rate",
 )
 
+#: pinned ``http`` block keys (present when the scenario replayed over
+#: the wire — ``EngineSpec(http=True)``, scenarios/http_driver.py)
+HTTP_FIELDS = (
+    "streams", "tokens", "disconnects", "rejected", "errors",
+    "conn_reset_retries", "slow_reader_stalls",
+    "backpressure_spills", "free_pages_recovered",
+)
+
 
 def _pct(vals: Sequence[float], q: float) -> float:
     return float(np.percentile(np.asarray(vals, np.float64), q)) \
@@ -87,11 +95,13 @@ def _latency_block(lifes: List[dict], missed: Dict[int, bool],
 
 def build_report(spec, trace, outputs, stats: dict, tracer,
                  wall_s: float, checks: Optional[dict] = None,
-                 router: Optional[dict] = None) -> dict:
+                 router: Optional[dict] = None,
+                 http: Optional[dict] = None) -> dict:
     """Assemble the pinned-schema report for one replayed scenario.
     ``router`` is the replicated-scenario block (``ROUTER_FIELDS``) —
-    failover/recovery facts and the affinity A/B; ``tracer`` may be the
-    router's cross-replica lifecycle adapter (same ``lifecycle``/
+    failover/recovery facts and the affinity A/B; ``http`` the
+    over-the-wire replay's block (``HTTP_FIELDS``); ``tracer`` may be
+    the router's cross-replica lifecycle adapter (same ``lifecycle``/
     ``spans`` surface as a :class:`~apex_tpu.obs.spans.SpanTracer`)."""
     events = trace.events
     lifes = [tracer.lifecycle(e.request_id) for e in events]
@@ -145,6 +155,8 @@ def build_report(spec, trace, outputs, stats: dict, tracer,
     }
     if router is not None:
         report["router"] = dict(router)
+    if http is not None:
+        report["http"] = dict(http)
     if checks is not None:
         report["checks"] = dict(checks)
     return report
@@ -176,3 +188,8 @@ def validate_report(report: dict) -> None:
                      if f not in report["router"]]
         if r_missing:
             raise ValueError(f"router block missing {r_missing}")
+    if "http" in report:
+        h_missing = [f for f in HTTP_FIELDS
+                     if f not in report["http"]]
+        if h_missing:
+            raise ValueError(f"http block missing {h_missing}")

@@ -128,7 +128,19 @@ class EngineSpec:
     a fresh round-robin router so the report's ``router`` block can
     bank both hit rates and their delta. ``ScenarioSpec.faults``
     injects deterministic chaos into the replicas
-    (``serving/faults.py``)."""
+    (``serving/faults.py``).
+
+    ``http=True`` replays the trace OVER THE WIRE: real
+    ``POST /v1/generate`` SSE streams against a localhost
+    :class:`~apex_tpu.serving.http.HttpServingServer`
+    (``scenarios/http_driver.py``), one client thread per request —
+    the outputs checked are what the clients read off their sockets,
+    and the NETWORK fault kinds (``client_disconnect``,
+    ``slow_reader``, ``conn_reset``) are delivered on the client side.
+    ``backpressure_window`` bounds unconsumed in-flight tokens per
+    stream (``ServingFrontend``'s spill-through-preemption window) and
+    ``sse_pad_bytes`` pads every SSE frame so socket backpressure
+    reaches that window quickly on tiny scenarios."""
 
     model: str = "gpt2-tiny"
     num_slots: int = 3
@@ -142,6 +154,12 @@ class EngineSpec:
     replicas: int = 1                    # >1 = ReplicaRouter DP serving
     routing: str = "affinity"            # router policy (replicas > 1)
     compare_round_robin: bool = False    # bank the affinity-vs-RR A/B
+    http: bool = False                   # replay over localhost HTTP/SSE
+    backpressure_window: Optional[int] = None  # frontend spill window
+    sse_pad_bytes: int = 0               # pad SSE frames (chaos knob)
+    sndbuf: Optional[int] = None         # shrink kernel send buffer
+    #                                      (socket backpressure reaches
+    #                                      the window fast; chaos knob)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -373,6 +391,14 @@ def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):
     from apex_tpu.serving.frontend import ServingFrontend
     from apex_tpu.serving.policy import PriorityDeadlinePolicy
 
+    if spec.engine.http and engine is None:
+        from apex_tpu.serving.scenarios.http_driver import replay_http
+
+        outputs, stats, tracer, wall_s, http_block = replay_http(
+            spec, trace)
+        stats = dict(stats)
+        stats["http"] = http_block       # run_scenario lifts this out
+        return outputs, stats, tracer, wall_s
     if spec.engine.replicas > 1 and engine is None:
         _, model, v = build_model(spec.engine.model)
         router = _build_router(spec, model, v)
@@ -413,14 +439,30 @@ def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):
     return outputs, frontend.stats(), frontend.tracer, wall_s
 
 
+def _net_prefix_ids(spec: ScenarioSpec) -> set:
+    """Request ids whose replayed output is a PREFIX by design: a
+    ``client_disconnect`` drops the stream after ``at`` tokens, so the
+    client banked only what it read before dropping (the server then
+    cancels at the next sync boundary — the amplifiers must tolerate
+    the truncation but still bind every delivered token)."""
+    ids: set = set()
+    for f in spec.faults:
+        if getattr(f, "kind", None) == "client_disconnect":
+            ids.update(range(f.count))
+    return ids
+
+
 def _check_greedy_identity(spec: ScenarioSpec, trace: Trace,
                            outputs: List[np.ndarray],
                            limit: int = 16) -> int:
     """Token identity vs lock-step ``generate`` for up to ``limit``
     replayed requests (tiny models — each re-derivation is one eager
-    prefill + scan). Raises AssertionError on the first mismatch."""
+    prefill + scan). Raises AssertionError on the first mismatch.
+    Disconnect-faulted ids (``_net_prefix_ids``) compare as prefixes —
+    every token the client read must still be the lock-step token."""
     from apex_tpu.models.generation import generate
 
+    prefix_ok = _net_prefix_ids(spec)
     _, model, v = build_model(spec.engine.model)
     n = min(len(trace.events), limit)
     for e, out in list(zip(trace.events, outputs))[:n]:
@@ -428,11 +470,14 @@ def _check_greedy_identity(spec: ScenarioSpec, trace: Trace,
         ref = np.asarray(generate(model, v, prompt[None],
                                   max_new_tokens=e.max_new_tokens))
         ref_gen = ref[0, prompt.shape[0]:]
-        if not np.array_equal(np.asarray(out), ref_gen):
+        got = np.asarray(out)
+        if e.request_id in prefix_ok:
+            ref_gen = ref_gen[:got.shape[0]]
+        if not np.array_equal(got, ref_gen):
             raise AssertionError(
                 f"scenario {spec.name!r} request {e.request_id}: "
                 f"replayed greedy output diverges from lock-step "
-                f"generate ({np.asarray(out)[:8]}... vs "
+                f"generate ({got[:8]}... vs "
                 f"{ref_gen[:8]}...)")
     return n
 
@@ -441,13 +486,19 @@ def _check_scheduling_invariance(spec: ScenarioSpec, trace: Trace,
                                  outputs: List[np.ndarray]) -> None:
     """Re-run the SAME trace as a fixed batch through ``engine.run`` at
     a different ``sync_every`` — greedy outputs must not depend on
-    arrival pacing, admission order, or chunk size."""
+    arrival pacing, admission order, or chunk size.
+    Disconnect-faulted ids compare as prefixes (the fixed batch runs
+    them to completion; the replay banked what the client read)."""
+    prefix_ok = _net_prefix_ids(spec)
     _, model, v = build_model(spec.engine.model)
     alt_sync = spec.engine.sync_every % 3 + 1     # always != sync_every
     engine = _build_engine(spec, model, v, sync_every=alt_sync)
     outs2, _ = engine.run(trace_requests(trace))
     for e, a, b in zip(trace.events, outputs, outs2):
-        if not np.array_equal(np.asarray(a), np.asarray(b)):
+        a, b = np.asarray(a), np.asarray(b)
+        if e.request_id in prefix_ok:
+            b = b[:a.shape[0]]
+        if not np.array_equal(a, b):
             raise AssertionError(
                 f"scenario {spec.name!r} request {e.request_id}: "
                 f"greedy output changed under a different schedule "
@@ -501,6 +552,8 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
     if trace is None:
         trace = materialize(spec)
     outputs, stats, tracer, wall_s = replay(spec, trace)
+    http_block = stats.pop("http", None) if isinstance(stats, dict) \
+        else None
     checks = None
     if check:
         n_checked = _check_greedy_identity(spec, trace, outputs)
@@ -511,7 +564,7 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
         if spec.engine.replicas > 1 else None
     rep = report_mod.build_report(spec, trace, outputs, stats, tracer,
                                   wall_s, checks=checks,
-                                  router=router_block)
+                                  router=router_block, http=http_block)
     report_mod.validate_report(rep)
     return ScenarioResult(spec=spec, trace=trace, outputs=outputs,
                           stats=stats, report=rep)

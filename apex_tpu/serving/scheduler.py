@@ -84,7 +84,8 @@ _RUN_COUNTERS = ("admitted", "retired", "decode_steps", "busy_slot_steps",
                  "prefix_hits", "prefill_tokens_total",
                  "prefill_tokens_computed", "evicted_pages",
                  "deferred_admissions", "defrag_runs",
-                 "preemptions", "resumes", "deadline_misses",
+                 "preemptions", "resumes", "backpressure_spills",
+                 "deadline_misses",
                  "tpot_slo_misses", "window_dropped_pages",
                  "spec_rounds", "spec_tokens", "chunked_prefills",
                  "prefill_chunks")

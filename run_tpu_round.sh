@@ -115,20 +115,29 @@ fi
 JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
   --bench "SCENARIOS_${TAG}.json"
 
-# CHAOS smoke (docs/router.md): replicated serving through an injected
-# mid-decode replica kill + the affinity-vs-round-robin A/B, on CPU
-# before the tunnel probe. --check is on: the greedy-identity amplifier
-# proves the failover corrupted no tokens. The banked router fields
+# CHAOS smoke (docs/router.md, docs/http.md): replicated serving through
+# an injected mid-decode replica kill + the affinity-vs-round-robin A/B,
+# plus the two NETWORK chaos entries — chaos-slow-reader (stalled SSE
+# readers cross the backpressure window: slot spills, stream resumes
+# token-identical) and chaos-disconnect-storm (real socket drops + torn
+# submits: pages freed, survivors identical) — both replayed over real
+# localhost HTTP (EngineSpec(http=True)), on CPU before the tunnel
+# probe. --check is on: the greedy-identity amplifier proves neither
+# failover nor the wire corrupted tokens. The banked router fields
 # (scenario.<name>.failover_recovered_rate, affinity_hit_rate /
 # round_robin_hit_rate / affinity_delta_hit_rate) band-gate against
-# the trajectory like the other rates (absolute ±0.25).
+# the trajectory like the other rates (absolute ±0.25); the network
+# scenarios' SLO percentiles band-gate too, while their
+# scenario.<name>.http_* counters (backpressure_spills, disconnects,
+# conn_reset_retries, ...) land as informational trajectory.
 if [ ! -f "CHAOS_${TAG}.json" ]; then
-  echo "[$(date +%H:%M:%S)] chaos smoke (replica kill + affinity A/B, CPU)..."
+  echo "[$(date +%H:%M:%S)] chaos smoke (replica kill + affinity A/B + network chaos, CPU)..."
   if ! JAX_PLATFORMS=cpu timeout 1800 python -m apex_tpu.serving.scenarios \
       --scenario chaos-replica-kill --scenario router-affinity-ab \
+      --scenario chaos-slow-reader --scenario chaos-disconnect-storm \
       --check --json "CHAOS_${TAG}.json" --seed 0; then
-    echo "[$(date +%H:%M:%S)] chaos smoke failed; replica failover is"
-    echo "  broken — fix before burning a tunnel window"
+    echo "[$(date +%H:%M:%S)] chaos smoke failed; replica failover or the"
+    echo "  HTTP surface is broken — fix before burning a tunnel window"
     exit 1
   fi
 fi
@@ -141,6 +150,36 @@ if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check \
 fi
 JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
   --bench "CHAOS_${TAG}.json"
+
+# HTTP smoke (docs/http.md): boot the asyncio HTTP/SSE server and drive
+# one catalogued scenario through the HTTP client driver (--http forces
+# EngineSpec(http=True): every request is a real POST /v1/generate SSE
+# stream over localhost), on CPU before the tunnel probe. --check is on
+# — greedy identity over the wire proves the transport corrupts no
+# tokens. bench-shared-prefix is banked NOWHERE else, so its
+# scenario.bench-shared-prefix.ttft_ms_p95 / tpot_ms_p95 /
+# deadline_miss_rate band-gate a transport-inclusive trajectory without
+# colliding with the in-process SCENARIOS_ baselines; the http_* stream/
+# disconnect counters ride along as informational trajectory.
+if [ ! -f "HTTP_${TAG}.json" ]; then
+  echo "[$(date +%H:%M:%S)] HTTP smoke (bench-shared-prefix over SSE, CPU)..."
+  if ! JAX_PLATFORMS=cpu timeout 1800 python -m apex_tpu.serving.scenarios \
+      --scenario bench-shared-prefix --http \
+      --check --json "HTTP_${TAG}.json" --seed 0; then
+    echo "[$(date +%H:%M:%S)] HTTP smoke failed; the network serving"
+    echo "  surface is broken — fix before burning a tunnel window"
+    exit 1
+  fi
+fi
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --check \
+    --costs "COSTS_${TAG}.json" --bench "HTTP_${TAG}.json"; then
+  echo "[$(date +%H:%M:%S)] perf ledger: HTTP-path SLO regression vs the"
+  echo "  trajectory; round marked failed — entry still appended so the"
+  echo "  regression itself is on record"
+  LEDGER_BENCH_RC=1
+fi
+JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
+  --bench "HTTP_${TAG}.json"
 
 # persistent XLA compilation cache: a window that dies after the 15-min
 # BERT-Large compile still banks the executable for the next window

@@ -274,6 +274,40 @@ FIXTURES = {
                 return x + 1
         """,
     ),
+    "conc-await-under-lock": (
+        """\
+        import asyncio
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def step(self):
+                with self._lock:
+                    await asyncio.sleep(0)
+        """,
+        # the good twin is ALSO the asyncio-primitive discrimination
+        # test: `async with asyncio.Lock()` suspends instead of
+        # blocking and must never register as a threading lock (if it
+        # did, the await under it would fire)
+        """\
+        import asyncio
+        import threading
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._alock = asyncio.Lock()
+
+            async def step(self):
+                with self._lock:
+                    n = 1
+                async with self._alock:
+                    await asyncio.sleep(0)
+                return n
+        """,
+    ),
 }
 
 
@@ -394,6 +428,32 @@ def test_router_guardedby_map_pinned():
             "FaultInjector._lock"
     assert guards[("ServingFrontend", "_accepting")] == \
         "ServingFrontend._ingest_lock"
+
+
+def test_asyncio_task_coloring_on_live_http_server():
+    """ISSUE 15: asyncio tasks are a thread color. The HTTP server's
+    per-connection callback (handed to ``asyncio.start_server``) roots
+    the ``asyncio`` color and it propagates through the whole
+    connection-handling chain, including the disconnect watcher spawned
+    via ``loop.create_task(...)``; the loop's own host thread keeps its
+    literal-name color."""
+    model, _ = build_model(_surface_sources())
+    colored = {k.qualname for k, v in model.colors.items()
+               if "asyncio" in v}
+    for fn in ("HttpServingServer._handle", "HttpServingServer._dispatch",
+               "HttpServingServer._generate",
+               "HttpServingServer._stream_tokens",
+               "HttpServingServer._watch_disconnect",
+               "HttpServingServer._sse"):
+        assert fn in colored, sorted(colored)
+    loop_thread = {k.qualname for k, v in model.colors.items()
+                   if "serving-http-loop" in v}
+    assert "HttpServingServer._run" in loop_thread, sorted(loop_thread)
+    # the client's per-request reader threads color the SSE parse chain
+    reader = {k.qualname for k, v in model.colors.items()
+              if "_stream" in v}
+    assert "HttpReplicaClient._stream" in reader
+    assert "_iter_sse" in reader
 
 
 def test_docs_thread_safety_contract_matches_inference():
@@ -592,8 +652,12 @@ def test_mutation_removed_lock_is_caught():
             if f.rule == "conc-unguarded-shared-field"
             and f.scope == "StreamHandle._push"]
     assert hits, [(f.rule, f.scope) for f in findings]
-    assert "_tokens" in hits[0].message
-    assert "StreamHandle._lock" in hits[0].message
+    # the unlocked _push body touches several guarded fields now
+    # (_tokens plus ISSUE 15's consumption-listener seam) — every one
+    # must be reported against the handle's lock
+    msgs = " ".join(h.message for h in hits)
+    assert "_tokens" in msgs
+    assert "StreamHandle._lock" in msgs
 
 
 def test_mutation_inverted_lock_order_is_caught():
@@ -611,6 +675,28 @@ def test_mutation_inverted_lock_order_is_caught():
     assert cycles, [(f.rule, f.scope) for f in findings]
     assert "_ingest_lock" in cycles[0].message
     assert "_order_lock" in cycles[0].message
+
+
+_HTTP = "apex_tpu/serving/http.py"
+_GEN_ANCHOR = ("        with self._lock:\n"
+               "            draining = self._draining\n")
+
+
+def test_mutation_await_under_lock_is_caught():
+    """ISSUE 15 acceptance: moving an ``await`` under the HTTP server's
+    connection lock in the live source fires conc-await-under-lock on
+    the coroutine — the rule is load-bearing against the real asyncio
+    surface, not just the fixture."""
+    sources = _surface_sources()
+    src = sources[_HTTP]
+    assert src.count(_GEN_ANCHOR) == 1, "http._generate anchor moved"
+    sources[_HTTP] = src.replace(
+        _GEN_ANCHOR, _GEN_ANCHOR + "            await asyncio.sleep(0)\n")
+    findings, _ = analyze_conc_sources(sources)
+    hits = [f for f in findings if f.rule == "conc-await-under-lock"
+            and f.scope == "HttpServingServer._generate"]
+    assert hits, [(f.rule, f.scope) for f in findings]
+    assert "HttpServingServer._lock" in hits[0].message
 
 
 def test_unmutated_frontend_scheduler_pair_is_clean():

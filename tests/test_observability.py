@@ -574,6 +574,62 @@ def test_healthz_endpoint_with_live_frontend():
         server.server_close()
 
 
+class _StubReplica:
+    """Just enough of router.py's replica record for health_doc."""
+
+    def __init__(self, index, alive, queue_depth, dead_reason=None):
+        self.index = index
+        self.alive = alive
+        self.draining = False
+        self.dead_reason = dead_reason
+
+        class _FE:
+            pump_alive = alive
+
+        _FE.queue_depth = queue_depth
+        self.frontend = _FE()
+
+
+def test_healthz_router_block(tmp_path):
+    """ISSUE 15 satellite: ``serve(router=)`` / ``health_doc(router=)``
+    add per-replica liveness + queue depth, and overall ``ok`` goes
+    false only when NO replica is alive."""
+    from apex_tpu.obs import export
+
+    class _StubRouter:
+        replicas = [_StubReplica(0, True, 3),
+                    _StubReplica(1, False, 0,
+                                 dead_reason=RuntimeError("killed"))]
+
+    doc = export.health_doc(router=_StubRouter())
+    r = doc["router"]
+    assert (r["replicas"], r["alive"], r["queue_depth"]) == (2, 1, 3)
+    assert doc["ok"] is True             # one survivor keeps us healthy
+    rows = {row["replica"]: row for row in r["per_replica"]}
+    assert rows[0]["alive"] and rows[0]["pump_alive"]
+    assert rows[0]["queue_depth"] == 3 and rows[0]["failure"] is None
+    assert not rows[1]["alive"] and rows[1]["queue_depth"] is None
+    assert "killed" in rows[1]["failure"]
+
+    class _DeadRouter:
+        replicas = [_StubReplica(0, False, 0,
+                                 dead_reason=RuntimeError("gone"))]
+
+    assert export.health_doc(router=_DeadRouter())["ok"] is False
+
+    server = serve(port=0, router=_StubRouter())
+    try:
+        host, port = server.server_address[:2]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz") as resp:
+            served = json.loads(resp.read())
+        assert served["router"]["alive"] == 1
+        assert len(served["router"]["per_replica"]) == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 def test_costs_endpoint_payload_shape():
     """/costs 404s until a snapshot is published, then serves the
     report with the pinned top-level shape."""

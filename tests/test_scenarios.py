@@ -172,17 +172,26 @@ def test_run_determinism_and_report_schema():
     assert r1.report["aggregate"]["tpot_ms_p95"] > 0
 
 
+@pytest.mark.slow
 def test_check_mode_amplifiers_pass():
     """check= re-derives every output via lock-step generate and re-runs
-    the trace at a different sync_every — both must agree."""
+    the trace at a different sync_every — both must agree. (Slow tier
+    since ISSUE 15 to hold the 870 s verify wall: tier-1 keeps a full
+    check=True path in test_chaos_slow_reader_scenario_spills_over_the_wire
+    — both amplifiers, over the wire — and CI's scenario/chaos/HTTP
+    smokes run --check on five catalog entries every round.)"""
     r = run_scenario(_SMALL, check=True)
     assert r.report["checks"]["greedy_identity_requests"] == 6
     assert r.report["checks"]["scheduling_invariance"] is True
 
 
+@pytest.mark.slow
 def test_saved_trace_replays_identically(tmp_path):
     """A trace saved to JSONL and replayed (the --trace path) yields the
-    same tokens as the materialized original."""
+    same tokens as the materialized original. (Slow tier since ISSUE 15
+    to hold the 870 s verify wall: the CLI --trace round-trip — save,
+    wrong-scenario refusal, seed provenance, sha pin — stays tier-1 in
+    test_cli_json_document_and_ledger_extraction.)"""
     r1 = run_scenario(_SMALL)
     path = tmp_path / "small.trace.jsonl"
     r1.trace.save(path)
@@ -283,13 +292,19 @@ def test_windowed_scenario_runs_and_recovers_the_pool():
 # --- ISSUE 11: chaos / router scenarios + the preemption-storm adversary -----
 
 
+@pytest.mark.slow
 def test_chaos_replica_kill_scenario_recovers_token_exact():
     """ISSUE 11 acceptance: the catalogued mid-decode replica kill
     completes every request — the greedy-identity amplifier proves the
     failover corrupted nothing — with the failure facts in the pinned
-    router block and both rates banked for the ledger. (Tier-1 runs an
-    n=8 override of the catalog entry; CI's chaos smoke replays the
-    full-size entry per round.)"""
+    router block and both rates banked for the ledger. (Slow tier since
+    ISSUE 15 to hold the 870 s verify wall: the kill bar stays tier-1
+    twice over — tests/test_router.py::
+    test_replica_kill_mid_decode_recovers_token_identical in-process and
+    tests/test_http.py::
+    test_router_over_http_replicas_kill_recovers_token_identical over
+    the wire — and CI's chaos smoke replays this full entry with
+    --check per round.)"""
     from apex_tpu.serving.scenarios.runner import _check_greedy_identity
 
     spec = scenario_spec("chaos-replica-kill", seed=0, n_requests=8)
@@ -426,6 +441,67 @@ def test_chaos_specs_roundtrip_with_faults():
     assert back == spec
     assert back.faults[0].kind == "kill_replica"
     assert back.engine.replicas == 2
+    # the HTTP tier's knobs round-trip too (and stay JSON-back-compat:
+    # specs that predate them load with the defaults)
+    spec = scenario_spec("chaos-slow-reader", seed=3)
+    back = ScenarioSpec.from_json(spec.to_json())
+    assert back == spec
+    assert back.engine.http and back.engine.backpressure_window == 6
+    assert back.engine.sse_pad_bytes == 2048
+    assert back.engine.sndbuf == 4096
+    assert back.faults[0].kind == "slow_reader"
+    doc = json.loads(_SMALL.to_json())
+    assert "http" not in json.dumps(doc) or not doc["engine"]["http"]
+    assert ScenarioSpec.from_json(_SMALL.to_json()).engine.http is False
+
+
+# --- ISSUE 15: the over-the-wire (HTTP/SSE) chaos tier -----------------------
+
+
+def test_chaos_slow_reader_scenario_spills_over_the_wire():
+    """ISSUE 15 acceptance: the catalogued slow-reader chaos replays
+    over a REAL localhost socket — stalled readers cross the
+    backpressure window, slots spill (never pinning pages for a
+    socket), and every stream still completes token-identically on
+    resume; the facts land in the report's pinned ``http`` block. (The
+    tier-1 single-request twin of the spill mechanics is
+    tests/test_http.py::test_backpressure_spill_resume_token_identical;
+    CI's HTTP smoke replays this entry per round and banks it.)"""
+    r = run_scenario(scenario_spec("chaos-slow-reader", seed=0),
+                     check=True)
+    hb = r.report["http"]
+    assert hb["streams"] == 4 and hb["errors"] == 0
+    assert hb["slow_reader_stalls"] == 2
+    assert hb["backpressure_spills"] >= 1        # the no-pin proof
+    assert hb["disconnects"] == 0
+    assert hb["free_pages_recovered"] > 0        # pool settled clean
+    assert r.report["checks"]["greedy_identity_requests"] == 4
+    assert r.report["checks"]["scheduling_invariance"] is True
+    validate_report(r.report)
+
+
+@pytest.mark.slow
+def test_chaos_disconnect_storm_prefixes_and_no_leak():
+    """ISSUE 15 acceptance: mid-stream socket drops + torn submits —
+    the server cancels and frees every page (the driver's in-band leak
+    check), survivors complete token-identically, and each dropped
+    stream's banked output is the exact prefix it read (the
+    prefix-tolerant identity amplifier). (Slow tier: the tier-1
+    disconnect-frees-pages twin is tests/test_http.py::
+    test_disconnect_cancels_and_frees_pages; CI's HTTP smoke replays
+    this full entry per round.)"""
+    r = run_scenario(scenario_spec("chaos-disconnect-storm", seed=0),
+                     check=True)
+    hb = r.report["http"]
+    assert hb["streams"] == 10 and hb["errors"] == 0
+    assert hb["disconnects"] == 4
+    assert hb["conn_reset_retries"] == 2
+    # 4 dropped streams read exactly at=3 tokens; 6 survivors run their
+    # pinned 24 out
+    assert sorted(len(np.asarray(o)) for o in r.outputs) \
+        == [3] * 4 + [24] * 6
+    assert r.report["checks"]["greedy_identity_requests"] == 10
+    validate_report(r.report)
 
 
 def test_ledger_extracts_router_fields(tmp_path):
@@ -443,7 +519,10 @@ def test_ledger_extracts_router_fields(tmp_path):
                "router": {"failover_recovered_rate": 1.0,
                           "affinity_hit_rate": 0.6,
                           "round_robin_hit_rate": 0.45,
-                          "affinity_delta_hit_rate": 0.15}}}}
+                          "affinity_delta_hit_rate": 0.15},
+               "http": {"backpressure_spills": 2, "disconnects": 4,
+                        "conn_reset_retries": 2,
+                        "slow_reader_stalls": 2, "errors": 0}}}}
     path = tmp_path / "CHAOS_test.json"
     path.write_text(json_mod.dumps(doc))
     m, meta = bench_metrics_from_file(path)
@@ -452,6 +531,11 @@ def test_ledger_extracts_router_fields(tmp_path):
     assert m["scenario.chaos-replica-kill.affinity_hit_rate"] == 0.6
     assert m["scenario.chaos-replica-kill.affinity_delta_hit_rate"] \
         == pytest.approx(0.15)
+    # the HTTP chaos block lands as informational (never band-gated)
+    # counters — the banked spill/disconnect proof per round
+    assert m["scenario.chaos-replica-kill.http_backpressure_spills"] \
+        == 2.0
+    assert m["scenario.chaos-replica-kill.http_disconnects"] == 4.0
     # direction classes: recovered/hit rates gate on the absolute rate
     # band as higher-better
     from apex_tpu.obs.ledger import check as ledger_check
@@ -509,3 +593,24 @@ def test_cli_json_document_and_ledger_extraction(tmp_path):
     assert doc2["seed"] == 4
     assert (doc2["scenarios"]["bench-mixed-length"]["trace_sha256"]
             == doc["scenarios"]["bench-mixed-length"]["trace_sha256"])
+
+
+@pytest.mark.slow
+def test_cli_http_flag_drives_the_wire(tmp_path):
+    """--http forces EngineSpec(http=True) on any catalog entry: the
+    replay goes over real localhost SSE and the banked document grows
+    the pinned http block — the flag CI's HTTP smoke
+    (run_tpu_round.sh, HTTP_<tag>.json) is built on."""
+    from apex_tpu.serving.scenarios.__main__ import main
+
+    out = tmp_path / "http.json"
+    rc = main(["--scenario", "bench-shared-prefix", "--http", "--check",
+               "--seed", "0", "--json", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    rep = doc["scenarios"]["bench-shared-prefix"]
+    validate_report(rep)
+    hb = rep["http"]
+    assert hb["streams"] == 8 and hb["errors"] == 0
+    assert hb["free_pages_recovered"] > 0
+    assert rep["checks"]["greedy_identity_requests"] == 8
