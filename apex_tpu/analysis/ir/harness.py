@@ -377,6 +377,66 @@ def _build_int8kv_engine_program(kind: str) -> CaseProgram:
                        variants=[args_for(93)], max_traces=1)
 
 
+def _build_wq_engine_program(kind: str, policy: str) -> CaseProgram:
+    """The QUANTIZED-WEIGHT engine programs (docs/serving.md "Quantized
+    weight streaming"): the ``sync_every``-step decode chunk and the
+    bucketed admission over a gpt2-small built with a
+    ``WeightPrecisionPolicy`` — every block linear stages the fused
+    dequant-matmul Pallas kernel (narrow weight + scale operands,
+    dequant in VMEM next to the contraction), embeddings/norms/head
+    stay fp. ``policy="int4"`` also drops the fp leaves to bf16 (the
+    documented aggressive pairing). Same compile-key contract as the fp
+    cases (two same-bucket admission variants, ``max_traces=1``);
+    ``obs/costs.py`` reads the decode chunk's abstract weight tree to
+    price the narrow stream (``cost.decode.w8.*`` / ``cost.decode.w4.*``
+    — per-LEAF dtype bytes, scale reads included)."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.ops.quant import WeightPrecisionPolicy
+    from apex_tpu.serving.scheduler import (PagedDecodeEngine,
+                                            prompt_bucket)
+
+    extra = {"param_dtype": jnp.bfloat16} if policy == "int4" else {}
+    cfg = gpt2_small_config(dtype=jnp.bfloat16,
+                            weight_policy=WeightPrecisionPolicy(policy),
+                            **extra)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, sync_every=4)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    dvars = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((4, 8), jnp.int32)))
+    i32 = jnp.int32
+    if kind == "decode":
+        args = (cache_abs, dvars,
+                jax.ShapeDtypeStruct((4,), i32),           # tok
+                jax.ShapeDtypeStruct((4,), jnp.bool_),     # done
+                jax.ShapeDtypeStruct((4,), i32),           # n_left
+                jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
+                jax.ShapeDtypeStruct((4,), i32))           # samp_i
+        return CaseProgram(fn=engine._step_fn(), args=args)
+    assert kind == "admit"
+
+    def args_for(s0: int) -> tuple:
+        bucket = prompt_bucket(s0, engine.page_size,
+                               cfg.max_position_embeddings)
+        return (cache_abs, dvars,
+                jax.ShapeDtypeStruct((1, bucket), i32),   # padded ids
+                jax.ShapeDtypeStruct((), i32),            # s0
+                jax.ShapeDtypeStruct((), i32),            # slot
+                jax.ShapeDtypeStruct((), i32),            # n_pages
+                jax.ShapeDtypeStruct((2,), jnp.uint32),   # req_key
+                jax.ShapeDtypeStruct((), i32))            # samp0
+    bucket = prompt_bucket(90, engine.page_size,
+                           cfg.max_position_embeddings)
+    return CaseProgram(fn=engine._admit_fn(bucket), args=args_for(90),
+                       variants=[args_for(93)], max_traces=1)
+
+
 def _build_frontend_program(kind: str) -> CaseProgram:
     """The serving FRONT-END's programs, bound through its own accessors
     (``ServingFrontend.admission_program`` / ``decode_program``) rather
@@ -476,7 +536,8 @@ def _build_llama_windowed_program(kind: str) -> CaseProgram:
                        variants=[args_for(22)], max_traces=1)
 
 
-def _build_tp_engine_program(kind: str, kv_dtype=None) -> CaseProgram:
+def _build_tp_engine_program(kind: str, kv_dtype=None,
+                             weight_policy=None) -> CaseProgram:
     """The TENSOR-PARALLEL serving programs (serving/tp.py,
     docs/tp_serving.md): the tp=2 engine's shard_map-wrapped admission
     and ``sync_every``-step decode chunk, traced over a deviceless
@@ -497,7 +558,12 @@ def _build_tp_engine_program(kind: str, kv_dtype=None) -> CaseProgram:
                                      infer_variable_specs)
 
     tp = 2
-    cfg = gpt2_small_config(dtype=jnp.bfloat16, tensor_parallel_size=tp)
+    pol = None
+    if weight_policy is not None:
+        from apex_tpu.ops.quant import WeightPrecisionPolicy
+        pol = WeightPrecisionPolicy(weight_policy)
+    cfg = gpt2_small_config(dtype=jnp.bfloat16, tensor_parallel_size=tp,
+                            weight_policy=pol)
     model = GPTModel(cfg)
     engine = TensorParallelPagedEngine(
         model, variables=None, mesh=abstract_tp_mesh(tp), num_slots=4,
@@ -621,6 +687,18 @@ def analysis_cases(root) -> List[AnalysisCase]:
     cases.append(AnalysisCase(
         "tp2_int8kv_engine_decode_chunk", "serving",
         lambda: _build_tp_engine_program("decode", kv_dtype="int8")))
+    cases.append(AnalysisCase(
+        "gpt2s_w8_engine_decode_chunk", "serving",
+        lambda: _build_wq_engine_program("decode", "int8")))
+    cases.append(AnalysisCase(
+        "gpt2s_w8_engine_admit_bucketed", "serving",
+        lambda: _build_wq_engine_program("admit", "int8")))
+    cases.append(AnalysisCase(
+        "gpt2s_w4_engine_decode_chunk", "serving",
+        lambda: _build_wq_engine_program("decode", "int4")))
+    cases.append(AnalysisCase(
+        "tp2_w8_engine_decode_chunk", "serving",
+        lambda: _build_tp_engine_program("decode", weight_policy="int8")))
     cases.append(AnalysisCase(
         "optim_sgd_momentum_buffer", "optimizers",
         lambda: _build_optimizer_update("sgd")))

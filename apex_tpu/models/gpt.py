@@ -79,12 +79,16 @@ class GPTConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0    # ST-MoE router z-loss
     expert_parallel: bool = False
-    # int8 W8A8 serving (ops/quant.py): block linears store int8 weights
-    # + per-channel scales and run on the int8 MXU dot. Inference-only —
-    # embeddings/tied head stay fp; convert a trained checkpoint with
-    # models/quantize.quantize_model_params. Does not compose with MoE
-    # (expert weights would silently stay fp — the model raises)
+    # quantized weight streaming (ops/quant.py): block linears store
+    # narrow weights + scales and run the fused dequant-matmul kernel.
+    # Inference-only — embeddings/norms/biases/tied head stay fp; convert
+    # a trained checkpoint with models/quantize.quantize_model_params.
+    # Does not compose with MoE (expert weights would silently stay fp —
+    # the model raises). ``quantize_int8`` is the back-compat alias for
+    # the int8-everywhere policy; ``weight_policy`` picks the per-layer-
+    # class precision (WeightPrecisionPolicy: int8 / fp8 / int4-grouped)
     quantize_int8: bool = False
+    weight_policy: Any = None            # Optional[WeightPrecisionPolicy]
     # activation rematerialization: recompute each decoder block in
     # backward instead of saving its activations (flax nn.remat, the
     # lifted jax.checkpoint; in pipeline stages: jax.checkpoint around the
@@ -96,6 +100,15 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    def weight_quant(self):
+        """The resolved ``WeightPrecisionPolicy`` (or None for fp
+        serving) — the ONE seam the block linears read their precision
+        from (named error on a quantize_int8/weight_policy conflict)."""
+        from apex_tpu.ops.quant import WeightPrecisionPolicy
+
+        return WeightPrecisionPolicy.resolve(self.weight_policy,
+                                             self.quantize_int8)
 
 
 def gpt2_small_config(**overrides) -> GPTConfig:
@@ -135,13 +148,17 @@ class ParallelDecoderBlock(nn.Module):
         d = cfg.head_dim
         b, s, _ = x.shape
 
+        pol = cfg.weight_quant()
+        qmode = pol.linears if pol else False
+        qgs = pol.group_size if pol else 128
+
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="input_norm")(x)
         h = h.astype(dt)
         # QKV column-parallel: local output is the local heads' q,k,v
         qkv = ColumnParallelLinear(
             e, 3 * e, gather_output=False, world_size=tp,
-            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-            name="qkv")(h)
+            params_dtype=cfg.param_dtype, quantize=qmode,
+            quantize_group_size=qgs, name="qkv")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def to_bhsd(t):
@@ -192,8 +209,8 @@ class ParallelDecoderBlock(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, input_is_parallel=True, world_size=tp,
-            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-            name="out_proj")(ctx)
+            params_dtype=cfg.param_dtype, quantize=qmode,
+            quantize_group_size=qgs, name="out_proj")(ctx)
         x = x + attn_out.astype(x.dtype)
 
         h = FusedLayerNorm(e, eps=cfg.layernorm_eps, name="post_norm")(x)
@@ -206,13 +223,13 @@ class ParallelDecoderBlock(nn.Module):
         else:
             h = ColumnParallelLinear(
                 e, 4 * e, gather_output=False, world_size=tp,
-                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-                name="mlp_in")(h)
+                params_dtype=cfg.param_dtype, quantize=qmode,
+                quantize_group_size=qgs, name="mlp_in")(h)
             h = jax.nn.gelu(h, approximate=True)
             mlp_out = RowParallelLinear(
                 4 * e, e, input_is_parallel=True, world_size=tp,
-                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-                name="mlp_out")(h)
+                params_dtype=cfg.param_dtype, quantize=qmode,
+                quantize_group_size=qgs, name="mlp_out")(h)
         out = x + mlp_out.astype(x.dtype)
         return out if cache is None else (out, cache)
 
@@ -230,10 +247,11 @@ class GPTModel(nn.Module):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
-        if cfg.quantize_int8 and cfg.num_experts > 0:
+        if cfg.weight_quant() and cfg.num_experts > 0:
             raise NotImplementedError(
-                "quantize_int8 does not cover MoE expert weights; the "
-                "combination would silently serve fp experts")
+                "weight quantization (quantize_int8/weight_policy) does "
+                "not cover MoE expert weights; the combination would "
+                "silently serve fp experts")
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, world_size=cfg.tensor_parallel_size,
             params_dtype=cfg.param_dtype, name="word_embeddings")

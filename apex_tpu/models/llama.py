@@ -92,15 +92,26 @@ class LlamaConfig:
     moe_aux_loss_coeff: float = 1e-2
     moe_z_loss_coeff: float = 0.0
     expert_parallel: bool = False
-    # int8 W8A8 serving for the block linears (same as GPTConfig;
-    # lm_head/embedding stay fp)
+    # quantized weight streaming for the block linears (same contract as
+    # GPTConfig: quantize_int8 = int8-everywhere alias, weight_policy =
+    # WeightPrecisionPolicy for int8/fp8/int4-grouped;
+    # lm_head/embedding/norms stay fp)
     quantize_int8: bool = False
+    weight_policy: Any = None            # Optional[WeightPrecisionPolicy]
     # activation rematerialization per decoder block (same as GPTConfig)
     remat: bool = False
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    def weight_quant(self):
+        """Resolved ``WeightPrecisionPolicy`` (or None) — same seam as
+        ``GPTConfig.weight_quant``."""
+        from apex_tpu.ops.quant import WeightPrecisionPolicy
+
+        return WeightPrecisionPolicy.resolve(self.weight_policy,
+                                             self.quantize_int8)
 
 
 def llama_tiny_config(**overrides) -> LlamaConfig:
@@ -157,16 +168,20 @@ class LlamaDecoderBlock(nn.Module):
         d = cfg.head_dim
         b, s, _ = x.shape
 
+        pol = cfg.weight_quant()
+        qmode = pol.linears if pol else False
+        qgs = pol.group_size if pol else 128
+
         h = FusedRMSNorm(e, eps=cfg.rms_eps, name="input_norm")(x)
         h = h.astype(dt)
         q = ColumnParallelLinear(
             e, cfg.num_heads * d, bias=False, gather_output=False,
             world_size=tp, params_dtype=cfg.param_dtype,
-            quantize=cfg.quantize_int8, name="q_proj")(h)
+            quantize=qmode, quantize_group_size=qgs, name="q_proj")(h)
         kv = ColumnParallelLinear(
             e, 2 * cfg.num_kv_heads * d, bias=False, gather_output=False,
             world_size=tp, params_dtype=cfg.param_dtype,
-            quantize=cfg.quantize_int8, name="kv_proj")(h)
+            quantize=qmode, quantize_group_size=qgs, name="kv_proj")(h)
         k, v = jnp.split(kv, 2, axis=-1)
 
         def to_shd(t, nh):  # (b, s, nh*d) -> (s, b, nh, d): rope layout
@@ -241,8 +256,8 @@ class LlamaDecoderBlock(nn.Module):
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h_local * d)
         attn_out = RowParallelLinear(
             e, e, bias=False, input_is_parallel=True, world_size=tp,
-            params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-            name="o_proj")(ctx)
+            params_dtype=cfg.param_dtype, quantize=qmode,
+            quantize_group_size=qgs, name="o_proj")(ctx)
         x = x + attn_out.astype(x.dtype)
 
         h = FusedRMSNorm(e, eps=cfg.rms_eps, name="post_norm")(x)
@@ -261,13 +276,13 @@ class LlamaDecoderBlock(nn.Module):
             gate_up = ColumnParallelLinear(
                 e, 2 * cfg.intermediate_size, bias=False,
                 gather_output=False, world_size=tp,
-                params_dtype=cfg.param_dtype, quantize=cfg.quantize_int8,
-                name="gate_up_proj")(h)
+                params_dtype=cfg.param_dtype, quantize=qmode,
+                quantize_group_size=qgs, name="gate_up_proj")(h)
             gate, up = jnp.split(gate_up, 2, axis=-1)
             mlp_out = RowParallelLinear(
                 cfg.intermediate_size, e, bias=False, input_is_parallel=True,
                 world_size=tp, params_dtype=cfg.param_dtype,
-                quantize=cfg.quantize_int8,
+                quantize=qmode, quantize_group_size=qgs,
                 name="down_proj")(jax.nn.silu(gate) * up)
         out = x + mlp_out.astype(x.dtype)
         return out if cache is None else (out, cache)
@@ -285,10 +300,11 @@ class LlamaModel(nn.Module):
         cfg = self.config
         dt = resolve_compute_dtype(cfg.dtype)
         b, s = input_ids.shape
-        if cfg.quantize_int8 and cfg.num_experts > 0:
+        if cfg.weight_quant() and cfg.num_experts > 0:
             raise NotImplementedError(
-                "quantize_int8 does not cover MoE expert weights; the "
-                "combination would silently serve fp experts")
+                "weight quantization (quantize_int8/weight_policy) does "
+                "not cover MoE expert weights; the combination would "
+                "silently serve fp experts")
         emb = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size,
             world_size=cfg.tensor_parallel_size,

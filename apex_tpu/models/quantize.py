@@ -1,14 +1,16 @@
-"""fp checkpoint -> int8-serving param tree (models with quantize_int8).
+"""fp checkpoint -> quantized-serving param tree (per-layer precision).
 
-Beyond reference (apex has no quantization story). The quantized models
-(``quantize_int8=True`` on ``GPTConfig``/``LlamaConfig``/``T5Config``)
-expect each block linear's ``weight`` as int8 plus a per-output-channel
-``scale`` (transformer/tensor_parallel/layers.py); this module produces
-that tree from a TRAINED fp tree — post-training quantization, the
-ordinary serving flow:
+Beyond reference (apex has no quantization story; PAPER.md's ``apex.amp``
+opt levels are the per-layer-class precedent). A model built with a
+``WeightPrecisionPolicy`` (``ops/quant.py``) — or the back-compat
+``quantize_int8=True`` alias — expects each block linear's ``weight``
+narrow (int8 / fp8 e4m3 per-channel, or int4 packed nibbles) with a
+sibling ``scale``; this module produces that tree from a TRAINED fp tree
+— post-training quantization, the ordinary serving flow:
 
     fp_vars = model_fp.init(...)          # or an HF-converted checkpoint
-    qmodel = GPTModel(dataclasses.replace(cfg, quantize_int8=True))
+    qmodel = GPTModel(dataclasses.replace(
+        cfg, weight_policy=WeightPrecisionPolicy("int4")))
     qparams = quantize_model_params(qmodel, fp_vars, example_ids)
     generate(qmodel, {"params": qparams}, prompt, ...)
 
@@ -21,23 +23,50 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.quant import quantize_weight
+from apex_tpu.ops.quant import (WeightPrecisionPolicy, quantize_weight,
+                                quantize_weight_fp8, quantize_weight_int4)
+
+__all__ = ["WeightPrecisionPolicy", "quantize_params_like",
+           "quantize_model_params", "assert_quantized_loaded"]
+
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+
+
+def _target_kind(tgt):
+    """The storage kind a (weight, scale) target pair asks for, by its
+    weight dtype: int8 / fp8 per-channel, uint8 = packed int4 nibbles."""
+    dt = tgt["weight"].dtype
+    if dt == jnp.int8:
+        return "int8"
+    if _FP8 is not None and dt == _FP8:
+        return "fp8"
+    if dt == jnp.uint8:
+        return "int4"
+    return None
 
 
 def quantize_params_like(target_shapes, params_fp):
-    """Build the quantized tree: wherever ``target_shapes`` holds an int8
-    ``weight`` with a sibling ``scale``, quantize the fp source weight
-    per-output-channel; everything else passes through."""
+    """Build the quantized tree: wherever ``target_shapes`` holds a
+    narrow ``weight`` with a sibling ``scale``, quantize the fp source
+    weight to that kind (the int4 group size is read off the target
+    scale's group axis); everything else passes through untouched."""
     def walk(tgt, src):
         if isinstance(tgt, dict):
             out = {}
-            wants_q = ("weight" in tgt and "scale" in tgt
-                       and tgt["weight"].dtype == jnp.int8)
+            kind = ("weight" in tgt and "scale" in tgt
+                    and _target_kind(tgt)) or None
             for k in tgt:
-                if wants_q and k == "weight":
-                    out["weight"], out["scale"] = quantize_weight(
-                        src["weight"])
-                elif wants_q and k == "scale":
+                if kind and k == "weight":
+                    w = src["weight"]
+                    if kind == "int8":
+                        out["weight"], out["scale"] = quantize_weight(w)
+                    elif kind == "fp8":
+                        out["weight"], out["scale"] = quantize_weight_fp8(w)
+                    else:
+                        gs = w.shape[1] // tgt["scale"].shape[0]
+                        out["weight"], out["scale"] = quantize_weight_int4(
+                            w, group_size=gs)
+                elif kind and k == "scale":
                     continue  # produced with the weight
                 else:
                     out[k] = walk(tgt[k], src[k])
@@ -49,7 +78,8 @@ def quantize_params_like(target_shapes, params_fp):
 
 def quantize_model_params(qmodel, fp_variables, *example_args):
     """fp ``{"params": ...}`` (trained or HF-converted) -> the param tree
-    of ``qmodel`` (a model constructed with ``quantize_int8=True``)."""
+    of ``qmodel`` (a model constructed with a weight policy /
+    ``quantize_int8=True``)."""
     target = jax.eval_shape(
         lambda: qmodel.init(jax.random.PRNGKey(0), *example_args))["params"]
     return quantize_params_like(target, fp_variables["params"])
@@ -58,24 +88,28 @@ def quantize_model_params(qmodel, fp_variables, *example_args):
 def assert_quantized_loaded(params) -> None:
     """Fail loud if a quantized tree still holds its ``init()`` placeholders.
 
-    A model built with ``quantize_int8=True`` init()s every block linear to
-    all-zero int8 weights (real values come from ``quantize_model_params``
-    on a trained checkpoint) — serving such a tree silently produces zero
-    logits from every block linear (ADVICE r4). Call this before serving;
-    it raises ``ValueError`` naming the first all-zero int8 weight."""
+    A quantized model init()s every block linear to all-zero narrow
+    weights (real values come from ``quantize_model_params`` on a trained
+    checkpoint) — serving such a tree silently produces garbage from
+    every block linear (ADVICE r4). Call this before serving; it raises
+    ``ValueError`` naming the first all-zero quantized weight."""
     leaves = jax.tree_util.tree_flatten_with_path(params)[0]
     from apex_tpu.optimizers.common import path_name
 
+    narrow = {jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8)}
+    if _FP8 is not None:
+        narrow.add(jnp.dtype(_FP8))
     checked = 0
     for path, leaf in leaves:
-        if getattr(leaf, "dtype", None) == jnp.int8:
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.dtype(dt) in narrow:
             checked += 1
-            if not bool(jnp.any(leaf != 0)):
+            if not bool(jnp.any(leaf.astype(jnp.float32) != 0)):
                 raise ValueError(
-                    f"int8 weight {path_name(path)!r} is all zeros — this "
-                    "tree looks like init() placeholders; load real values "
-                    "with quantize_model_params() before serving")
+                    f"quantized weight {path_name(path)!r} is all zeros — "
+                    "this tree looks like init() placeholders; load real "
+                    "values with quantize_model_params() before serving")
     if checked == 0:
         raise ValueError(
-            "no int8 leaves found — was this model built with "
-            "quantize_int8=True?")
+            "no int8/fp8/int4 leaves found — was this model built with a "
+            "weight policy (or quantize_int8=True)?")

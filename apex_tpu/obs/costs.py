@@ -488,6 +488,10 @@ def decode_split(prog) -> dict:
     import jax
 
     cache, dvars = prog.args[0], prog.args[1]
+    # per-LEAF bytes at each leaf's ACTUAL dtype (_aval_bytes), never
+    # param-count x model dtype: a quantized tree (int8/fp8 weights, f32
+    # scale rows, uint8 int4 nibbles) reports its true stream, scale
+    # reads included — the w8/w4 ratio pins divide these numbers
     weight_bytes = sum(_aval_bytes(leaf)
                       for leaf in jax.tree.leaves(dvars))
     num_slots, max_pages = cache["block_tables"].shape
@@ -619,6 +623,9 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     spec_split = None
     int8kv_split = None
     int8kv_tp_split = None
+    w8_split = None
+    w4_split = None
+    w8_tp_split = None
     for c in cases:
         try:
             ir = build_case_ir(c)
@@ -640,6 +647,15 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
                 int8kv_split = decode_split(ir.prog)
             if c.name == "tp2_int8kv_engine_decode_chunk":
                 int8kv_tp_split = tp_decode_split(ir.prog, prof)
+            if c.name == "gpt2s_w8_engine_decode_chunk":
+                # split over the QUANTIZED weight tree: int8 block
+                # linears + f32 scale rows, fp everything else — the
+                # per-leaf dtype bytes ARE the narrow stream
+                w8_split = decode_split(ir.prog)
+            if c.name == "gpt2s_w4_engine_decode_chunk":
+                w4_split = decode_split(ir.prog)
+            if c.name == "tp2_w8_engine_decode_chunk":
+                w8_tp_split = tp_decode_split(ir.prog, prof)
         except Exception as e:       # noqa: BLE001 — report, don't crash
             errors.append({"case": c.name,
                            "error": f"{type(e).__name__}: {e}"})
@@ -664,6 +680,9 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             "spec_decode_split": spec_split,
             "int8kv_decode_split": int8kv_split,
             "int8kv_tp_decode_split": int8kv_tp_split,
+            "w8_decode_split": w8_split,
+            "w4_decode_split": w4_split,
+            "w8_tp_decode_split": w8_tp_split,
             "errors": errors}
 
 
@@ -718,6 +737,36 @@ def ledger_metrics(report: dict) -> Dict[str, float]:
             m[f"cost.tp_decode.int8_kv.kv_bytes_per_chip_per_step_tp"
               f"{tp}"] = float(slot["kv_bytes_per_chip_per_step_max"])
             m[f"cost.tp_decode.int8_kv.weight_fraction_tp{tp}"] = \
+                float(slot["weight_fraction"])
+    wsplit = report.get("w8_decode_split")
+    if wsplit:
+        m["cost.decode.w8.weight_bytes_per_step"] = \
+            float(wsplit["weight_bytes_per_step"])
+        m["cost.decode.w8.weight_fraction"] = \
+            float(wsplit["weight_fraction"])
+        if split:
+            # the PR's acceptance number: the quantized tree's per-step
+            # weight stream as a fraction of the fp tree's (<= 0.55
+            # pinned by tests/test_quantized_weights.py)
+            m["cost.decode.w8.weight_bytes_ratio_vs_bf16"] = \
+                float(wsplit["weight_bytes_per_step"]) / \
+                float(split["weight_bytes_per_step"])
+    w4split = report.get("w4_decode_split")
+    if w4split:
+        m["cost.decode.w4.weight_bytes_per_step"] = \
+            float(w4split["weight_bytes_per_step"])
+        if split:
+            # int4 nibbles + per-group scale reads, vs the same fp tree
+            # (<= 0.35 pinned by tests/test_quantized_weights.py)
+            m["cost.decode.w4.weight_bytes_ratio_vs_bf16"] = \
+                float(w4split["weight_bytes_per_step"]) / \
+                float(split["weight_bytes_per_step"])
+    wtsplit = report.get("w8_tp_decode_split")
+    if wtsplit:
+        for tp, slot in sorted(wtsplit["per_tp"].items()):
+            m[f"cost.tp_decode.w8.hbm_bytes_per_chip_per_step_tp{tp}"] = \
+                float(slot["hbm_bytes_per_chip_per_step"])
+            m[f"cost.tp_decode.w8.weight_fraction_tp{tp}"] = \
                 float(slot["weight_fraction"])
     ssplit = report.get("spec_decode_split")
     if ssplit:

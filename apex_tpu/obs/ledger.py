@@ -183,6 +183,9 @@ _BENCH_FIELDS = (
     "gpt2_frontend_chunked_ttft_ms_p50", "gpt2_frontend_chunked_ttft_ms_p95",
     "gpt2_frontend_monolithic_ttft_ms_p50",
     "gpt2_frontend_monolithic_ttft_ms_p95",
+    # ISSUE 16: quantized weight streaming (int8 policy, fused dequant)
+    "gpt2_w8_paged_decode_ttft_ms_p50", "gpt2_w8_paged_decode_ttft_ms_p95",
+    "weight_bytes_ratio_vs_fp",
 )
 
 

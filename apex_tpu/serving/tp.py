@@ -35,6 +35,17 @@ all-gather over a size-1 axis are identity); TP=2 greedy decode is
 pinned token-identical to the single-chip engine on the forced
 8-CPU-device mesh in ``tests/test_tp_serving.py``.
 
+Quantized-weight trees (``docs/serving.md`` "Quantized weight
+streaming") shard through this module UNCHANGED: int8/fp8 leaves slice
+along the same output/input channel axes as their fp counterparts, each
+scale follows its weight's output-channel axis (replicated where the
+weight is row-parallel), and int4's group-local nibble packing makes a
+contiguous slice of whole groups along the packed axis exactly the
+packed form of that shard — so ``infer_variable_specs`` /
+``shard_model_variables`` need no quantization cases, and TP=2 over the
+int8 tree is pinned token-identical to the single-chip int8 engine
+(``tests/test_quantized_weights.py``).
+
 Construction::
 
     cfg    = gpt2_small_config(tensor_parallel_size=2)

@@ -91,6 +91,34 @@ def _shard_init(base_init: Callable, axis_name: str) -> Callable:
     return init
 
 
+def _quantized_params(mod, qkind: str, out_features: int, in_features: int,
+                      group_size: int, scale_init: Callable):
+    """Declare the (weight, scale) param pair of a quantized linear.
+
+    int8/fp8: weight ``(out, in)`` at the storage dtype, per-channel
+    scale ``(out,)``. int4: weight ``(out, in//2)`` uint8 (two nibbles
+    per byte, group-local packing — ops/quant.py), scale
+    ``(in//group_size, out)`` — group axis major so row-parallel shards
+    slice whole groups; out axis minor so it shards with the output
+    channels. All inits are placeholders (zeros weight / ones scale);
+    real values come from models/quantize.quantize_params_like."""
+    from apex_tpu.ops.quant import validate_int4_group, weight_storage_dtype
+
+    if qkind == "int4":
+        validate_int4_group(in_features, group_size)
+        w = mod.param("weight", nn.initializers.zeros,
+                      (out_features, in_features // 2), jnp.uint8)
+        scale = mod.param("scale", scale_init,
+                          (in_features // group_size, out_features),
+                          jnp.float32)
+    else:
+        w = mod.param("weight", nn.initializers.zeros,
+                      (out_features, in_features),
+                      weight_storage_dtype(qkind))
+        scale = mod.param("scale", scale_init, (out_features,), jnp.float32)
+    return w, scale
+
+
 class ColumnParallelLinear(nn.Module):
     """Y = X A^T + b with A split along its OUTPUT dim over ``model``.
 
@@ -115,10 +143,14 @@ class ColumnParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     world_size: Optional[int] = None      # default: tp size of the global mesh
     axis_name: str = MODEL_AXIS
-    # int8 W8A8 serving path (ops/quant.py): weight stored int8 with a
-    # per-output-channel "scale" param; matmul runs on the int8 MXU dot.
-    # Inference-only (round has zero gradient)
-    quantize: bool = False
+    # quantized weight streaming (ops/quant.py): False/None fp, True/"int8"
+    # per-channel int8, "fp8" e4m3, "int4" packed nibbles with
+    # per-(out-channel, group) scales. The matmul runs the fused
+    # dequant-matmul Pallas kernel (weight-only quantization — dequant in
+    # VMEM next to the contraction). Inference-only (round has zero
+    # gradient)
+    quantize: Any = False
+    quantize_group_size: int = 128        # int4 grouping (power of two)
 
     def _world(self) -> int:
         if self.world_size is not None:
@@ -132,18 +164,20 @@ class ColumnParallelLinear(nn.Module):
         world = self._world()
         out_local = divide(self.output_size, world)
         init = self.init_method or nn.initializers.lecun_normal()
-        if self.quantize:
+        from apex_tpu.ops.quant import resolve_weight_dtype
+
+        qkind = resolve_weight_dtype(self.quantize)
+        if qkind:
             if self.gradient_accumulation_fusion:
                 raise ValueError(
                     "quantize is an inference path; it cannot combine with "
                     "gradient_accumulation_fusion")
             # init is a placeholder: real values come from
             # models/quantize.quantize_params_like on a trained checkpoint
-            w = self.param("weight", nn.initializers.zeros,
-                           (out_local, self.input_size), jnp.int8)
-            w_scale = self.param("scale", _shard_init(nn.initializers.ones,
-                                                      self.axis_name),
-                                 (out_local,), jnp.float32)
+            w, w_scale = _quantized_params(
+                self, qkind, out_local, self.input_size,
+                self.quantize_group_size,
+                _shard_init(nn.initializers.ones, self.axis_name))
         else:
             # weight layout matches the reference: (out_local, in)
             w = self.param("weight", _shard_init(init, self.axis_name),
@@ -161,10 +195,10 @@ class ColumnParallelLinear(nn.Module):
             else:
                 x = mappings.copy_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        if self.quantize:
-            from apex_tpu.ops.quant import int8_matmul
+        if qkind:
+            from apex_tpu.ops.quant import fused_dequant_matmul
 
-            y = int8_matmul(x, w, w_scale)
+            y = fused_dequant_matmul(x, w, w_scale)
         elif self.gradient_accumulation_fusion:
             y = fp32_wgrad_matmul(x, w)
         else:
@@ -211,10 +245,13 @@ class RowParallelLinear(nn.Module):
     sequence_parallel_enabled: bool = False
     world_size: Optional[int] = None
     axis_name: str = MODEL_AXIS
-    # int8 W8A8 serving path — see ColumnParallelLinear.quantize. Each
-    # rank quantizes its OWN (out, in_local) shard, so dequant happens
-    # before the partial-sum reduction (per-rank scales are exact)
-    quantize: bool = False
+    # quantized weight streaming — see ColumnParallelLinear.quantize.
+    # Dequant happens inside each rank's fused kernel BEFORE the
+    # partial-sum reduction, so per-channel (int8/fp8) scales span the
+    # full row and int4 group scales slice with the input shard —
+    # either way the reduction sums already-dequantized partials
+    quantize: Any = False
+    quantize_group_size: int = 128
 
     def _world(self) -> int:
         if self.world_size is not None:
@@ -228,15 +265,17 @@ class RowParallelLinear(nn.Module):
         world = self._world()
         in_local = divide(self.input_size, world)
         init = self.init_method or nn.initializers.lecun_normal()
-        if self.quantize:
+        from apex_tpu.ops.quant import resolve_weight_dtype
+
+        qkind = resolve_weight_dtype(self.quantize)
+        if qkind:
             if self.gradient_accumulation_fusion:
                 raise ValueError(
                     "quantize is an inference path; it cannot combine with "
                     "gradient_accumulation_fusion")
-            w = self.param("weight", nn.initializers.zeros,
-                           (self.output_size, in_local), jnp.int8)
-            w_scale = self.param("scale", nn.initializers.ones,
-                                 (self.output_size,), jnp.float32)
+            w, w_scale = _quantized_params(
+                self, qkind, self.output_size, in_local,
+                self.quantize_group_size, nn.initializers.ones)
         else:
             w = self.param("weight", _shard_init(init, self.axis_name),
                            (self.output_size, in_local), self.params_dtype)
@@ -254,10 +293,10 @@ class RowParallelLinear(nn.Module):
             if bound:
                 x = mappings.scatter_to_tensor_model_parallel_region(
                     x, self.axis_name)
-        if self.quantize:
-            from apex_tpu.ops.quant import int8_matmul
+        if qkind:
+            from apex_tpu.ops.quant import fused_dequant_matmul
 
-            y = int8_matmul(x, w, w_scale)
+            y = fused_dequant_matmul(x, w, w_scale)
         elif self.gradient_accumulation_fusion:
             y = fp32_wgrad_matmul(x, w)
         else:

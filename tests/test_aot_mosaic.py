@@ -39,6 +39,8 @@ CASE_NAMES = [
     "gpt2s_paged_spec_verify",        # s=4 query block: spec verify step
     "gpt2s_chunked_prefill_step",     # chunked prefill through the s>1 path
     "gpt2s_paged_decode_int8kv",      # quantized pool: in-kernel dequant
+    "gpt2s_paged_decode_w8",          # w8 policy: fused dequant-matmul
+    "gpt2s_fused_dequant_w4",         # int4 nibbles + grouped scales
 ]
 
 
@@ -125,7 +127,8 @@ def test_multichip_tp_paged_serving_compiles_for_tpu(topo):
     # unsharded pool (lane-exact tiles, so these bytes are physical)
     assert tpu_aot.tp_serving_pool_bytes() > tpu_aot.HBM_BUDGET
 
-    names = ["tp4_paged_engine_admit", "tp4_paged_engine_decode_chunk"]
+    names = ["tp4_paged_engine_admit", "tp4_paged_engine_decode_chunk",
+             "tp4_paged_engine_decode_w8"]
     r = tpu_aot.multichip_aot(topo, only=names)
     pool_shard = tpu_aot.tp_serving_pool_bytes() // tpu_aot.TP_SERVING_TP
     for name in names:
@@ -138,6 +141,12 @@ def test_multichip_tp_paged_serving_compiles_for_tpu(topo):
         # the sharded pool is genuinely in the program: the per-chip
         # argument bytes carry at least this chip's shard of it
         assert c["argument_bytes"] >= pool_shard, c
+    # quantized weight streaming (docs/serving.md): the w8 decode chunk
+    # carries the SAME sharded pool but int8 block-linear weights — the
+    # per-chip footprint must genuinely drop vs the bf16 program
+    fp, w8 = r["tp4_paged_engine_decode_chunk"], r["tp4_paged_engine_decode_w8"]
+    assert w8["argument_bytes"] < fp["argument_bytes"], (fp, w8)
+    assert w8["peak_estimate_bytes"] < fp["peak_estimate_bytes"], (fp, w8)
 
 
 def test_tight_headdim_compiles(mesh):

@@ -49,7 +49,8 @@ def test_bench_sharded_over_8_cpu_devices():
 
 def test_decode_bench_smoke_emits_json(tmp_path):
     """tpu_decode_bench.py in smoke mode prints its parseable JSON
-    records (lock-step, paged, int8-kv paged, tp=2, prefix-cached,
+    records (lock-step, paged, int8-kv paged, w8 weight-streaming,
+    tp=2, prefix-cached,
     async frontend, speculative, chunked-prefill TTFT A/B), the paged
     record carries the TTFT/decode-step percentile fields (ISSUE 4), the
     frontend record carries the open-loop TTFT/TPOT/deadline-miss fields
@@ -110,6 +111,24 @@ def test_decode_bench_smoke_emits_json(tmp_path):
     assert (q8["gpt2_int8kv_paged_decode_ttft_ms_p95"]
             >= q8["gpt2_int8kv_paged_decode_ttft_ms_p50"])
     assert q8["tpot_ms_p50"] > 0
+
+    # the quantized WEIGHT-streaming record (ISSUE 16, docs/serving.md
+    # "Quantized weight streaming"): throughput parses, the weight-tree
+    # byte telemetry shows the quantized tree genuinely below the fp
+    # tree, and — asserted inside the bench itself — every request's
+    # shape and first token match the fp paged engine (fixed-seed pin;
+    # tolerance parity lives in tests/test_quantized_weights.py)
+    w8 = recs["gpt2_w8_paged_decode_tokens_per_sec_per_chip"]
+    assert w8["value"] > 0
+    assert w8["unit"] == "tokens/s/chip"
+    assert w8["weight_dtype"] == "int8"
+    assert w8["generated_tokens"] > 0
+    assert w8["w8_weight_bytes"] < w8["fp_weight_bytes"]
+    assert 0.0 < w8["weight_bytes_ratio_vs_fp"] < 1.0
+    assert w8["gpt2_w8_paged_decode_ttft_ms_p50"] > 0
+    assert (w8["gpt2_w8_paged_decode_ttft_ms_p95"]
+            >= w8["gpt2_w8_paged_decode_ttft_ms_p50"])
+    assert w8["tpot_ms_p50"] > 0
 
     # the tensor-parallel paged engine's record (ISSUE 10,
     # docs/tp_serving.md): the tp=2 run must have actually happened

@@ -403,6 +403,40 @@ def kernel_cases():
            [pcache_abs, dvars, _sds((1, 16), i32), _sds((), i32),
             _sds((), i32), _sds((2,), jnp.uint32), _sds((), i32)])
 
+    # -- quantized weight streaming (docs/serving.md "Quantized weight
+    # streaming"): the paged decode chunk over a gpt2-small built with
+    # the int8 WeightPrecisionPolicy — every block linear stages the
+    # fused dequant-matmul kernel (int8 weight + f32 scale operands,
+    # dequant in VMEM next to the contraction) alongside the paged
+    # attention gather. The new Mosaic surfaces: int8 weight tiles at
+    # (block_out, in) and the degenerate (1, block_out) scale blocks.
+    from apex_tpu.ops.quant import WeightPrecisionPolicy
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    wmodel = GPTModel(dataclasses.replace(
+        dcfg, weight_policy=WeightPrecisionPolicy("int8")))
+    wengine = PagedDecodeEngine(wmodel, variables=None, num_slots=8,
+                                page_size=16, num_pages=513,
+                                max_pages_per_seq=32, sync_every=4)
+    wcache_abs = jax.tree.map(lambda x: _sds(x.shape, x.dtype),
+                              wengine.cache)
+    wvars = jax.eval_shape(
+        lambda: wmodel.init(jax.random.PRNGKey(0), jnp.zeros((8, 8), i32)))
+
+    yield ("gpt2s_paged_decode_w8", wengine._step_fn(),
+           [wcache_abs, wvars, _sds((8,), i32), _sds((8,), jnp.bool_),
+            _sds((8,), i32), _sds((8, 2), jnp.uint32), _sds((8,), i32)])
+
+    # -- the int4 half of the same kernel, raw, at the gpt2s block-linear
+    # shape: packed nibbles (out, in/2) uint8 + per-(group, out) f32
+    # scales — gates the nibble-extract widening and the sub-sublane
+    # (n_groups, block_out) scale block under Mosaic's tiling rules.
+    from apex_tpu.ops.quant import fused_dequant_matmul
+
+    yield ("gpt2s_fused_dequant_w4", fused_dequant_matmul,
+           [_sds((8, 768), bf16), _sds((768, 384), jnp.uint8),
+            _sds((6, 768), f32)])
+
 
 def tight_headdim_cases():
     """The compile half of the tight-head-dim gate (VERDICT r4 next #3):
@@ -572,6 +606,7 @@ MULTICHIP_CASE_NAMES = (
     "pp2_tp2_1f1b_pipeline_step",
     "tp4_paged_engine_admit",
     "tp4_paged_engine_decode_chunk",
+    "tp4_paged_engine_decode_w8",
 )
 
 #: the tensor-parallel serving acceptance shape (docs/tp_serving.md):
@@ -595,16 +630,23 @@ TP_SERVING_MAX_PAGES_PER_SEQ = 32
 TP_SERVING_TP = 4
 
 
-def tp_serving_config():
+def tp_serving_config(weight_policy=None):
     """The acceptance model: GPT-2-small depth at hidden 1024 / 8 heads
-    (head_dim 128 — lane-exact page tiles), tp=4, bf16."""
+    (head_dim 128 — lane-exact page tiles), tp=4, bf16. Pass
+    ``weight_policy="int8"`` for the quantized-weight-streaming variant
+    (every block linear narrow + scale, fused in-kernel dequant)."""
     import jax.numpy as jnp
 
     from apex_tpu.models.gpt import gpt2_small_config
 
+    pol = None
+    if weight_policy is not None:
+        from apex_tpu.ops.quant import WeightPrecisionPolicy
+        pol = WeightPrecisionPolicy(weight_policy)
     return gpt2_small_config(hidden_size=1024, num_heads=8,
                              dtype=jnp.bfloat16,
-                             tensor_parallel_size=TP_SERVING_TP)
+                             tensor_parallel_size=TP_SERVING_TP,
+                             weight_policy=pol)
 
 
 def tp_serving_pool_bytes() -> int:
@@ -779,7 +821,7 @@ def multichip_cases(topo):
         return mesh, pipe_step, [stacked_s, _sds(mbs.shape, i32),
                                  _sds(labels.shape, i32)]
 
-    def _build_tp_serving(kind):
+    def _build_tp_serving(kind, weight_policy=None):
         # the tensor-parallel PAGED SERVING programs (serving/tp.py):
         # the tp=TP_SERVING_TP engine's shard_map admission + decode
         # chunk with the pool's kv-head axis REALLY sharded over the
@@ -795,7 +837,7 @@ def multichip_cases(topo):
 
         mesh = Mesh(np.asarray(topo.devices[:TP_SERVING_TP]),
                     (MODEL_AXIS,))
-        cfg = tp_serving_config()
+        cfg = tp_serving_config(weight_policy=weight_policy)
         model = GPTModel(cfg)
         engine = TensorParallelPagedEngine(
             model, variables=None, mesh=mesh, abstract=True,
@@ -835,9 +877,18 @@ def multichip_cases(topo):
     def build_tp_paged_decode():
         return _build_tp_serving("decode")
 
+    def build_tp_paged_decode_w8():
+        # the quantized-weight variant of the decode chunk: same sharded
+        # pool, but every block linear's weight rides int8 (+ f32 scale)
+        # through the fused dequant-matmul kernel — the per-chip peak
+        # bytes must DROP vs the bf16 case (tests/test_aot_mosaic.py
+        # asserts the inequality)
+        return _build_tp_serving("decode", weight_policy="int8")
+
     builders = (build_cp_ring, build_cp_zigzag, build_tp_megatron,
                 build_tp_t5, build_moe, build_pipeline,
-                build_tp_paged_admit, build_tp_paged_decode)
+                build_tp_paged_admit, build_tp_paged_decode,
+                build_tp_paged_decode_w8)
     for name, build in zip(MULTICHIP_CASE_NAMES, builders):
         yield name, build
 

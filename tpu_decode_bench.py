@@ -36,6 +36,16 @@ asserts per-request shapes and first tokens match the fp engine (full
 token-level parity is tolerance-pinned in tests/test_quantized_kv.py)
 and the >= 1.9x capacity ratio.
 
+After the int8-KV line: the QUANTIZED WEIGHT-STREAMING engine — the
+same workload with the int8 ``WeightPrecisionPolicy`` model (block
+linears int8 + per-channel f32 scales, fused in-kernel dequant;
+docs/serving.md "Quantized weight streaming"), emitting
+{"metric": "gpt2_w8_paged_decode_tokens_per_sec_per_chip", ...} with
+TTFT/TPOT percentiles and the weight-tree byte split; the smoke run
+asserts per-request shapes, first-token identity vs the fp paged engine
+(fixed-seed pin — prefill runs the quantized weights), and that the
+quantized tree's bytes genuinely drop below the fp tree's.
+
 Between the paged and prefix-cached lines: the TENSOR-PARALLEL paged
 engine (serving/tp.py, docs/tp_serving.md) — the same mixed-length
 workload through a tp=2 ``TensorParallelPagedEngine`` (head-sharded
@@ -313,6 +323,73 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(q_rec), flush=True)
+
+    # --- quantized WEIGHT streaming serving metric --------------------------
+    # the SAME mixed-length workload through the paged engine over the
+    # int8-policy model (docs/serving.md "Quantized weight streaming"):
+    # every block linear's weight lives in HBM as int8 with a per-channel
+    # f32 scale and dequantizes inside the fused dequant-matmul kernel,
+    # next to the contraction — decode is weight-fetch bound, so the
+    # per-step weight stream roughly halves (cost.decode.w8.*). Unlike
+    # the KV record above, prefill itself runs the quantized weights, so
+    # the first-token identity asserted here is an empirical fixed-seed
+    # pin (deterministic per build), not a structural guarantee; the
+    # tolerance-pinned parity lives in tests/test_quantized_weights.py.
+    def _tree_bytes(tree):
+        return int(sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree)))
+
+    w8_engine = PagedDecodeEngine(qmodel, {"params": qparams},
+                                  num_slots=num_slots, page_size=page_size)
+    w8_engine.run(requests)                              # compile + warm
+    t0 = time.perf_counter()
+    w8_outs, w8_stats = w8_engine.run(requests)
+    w8_elapsed = time.perf_counter() - t0
+    w8_tokens = int(sum(o.shape[0] for o in w8_outs))
+    fp_weight_bytes = _tree_bytes(v["params"])
+    w8_weight_bytes = _tree_bytes(qparams)
+    if smoke:
+        for i, (a, b) in enumerate(zip(outs, w8_outs)):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape != b.shape:
+                raise SystemExit(
+                    f"w8 engine changed request {i}'s output shape: "
+                    f"{b.shape} vs fp {a.shape}")
+            if a.shape[0] and a[0] != b[0]:
+                raise SystemExit(
+                    f"w8 engine flipped request {i}'s FIRST token "
+                    f"({b[0]} vs fp {a[0]}) — the fixed-seed first-token "
+                    f"pin regressed (tests/test_quantized_weights.py "
+                    f"holds the tolerance parity)")
+        if w8_weight_bytes >= fp_weight_bytes:
+            raise SystemExit(
+                f"w8 weight stream regressed: {w8_weight_bytes} quantized "
+                f"tree bytes >= {fp_weight_bytes} fp bytes")
+    w8_rec = {
+        "metric": "gpt2_w8_paged_decode_tokens_per_sec_per_chip",
+        "value": round(w8_tokens / max(w8_elapsed, 1e-9), 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,  # no reference analog (apex ships no inference)
+        "requests": n_req, "num_slots": num_slots, "page_size": page_size,
+        "weight_dtype": "int8",
+        "generated_tokens": w8_tokens,
+        "decode_steps": w8_stats["decode_steps"],
+        "fp_tokens_per_sec": prec["value"],
+        # streaming telemetry: each tree's bytes at its ACTUAL leaf
+        # dtypes (scales included) — the gpt2s ratio is pinned exactly
+        # by the cost model (cost.decode.w8.weight_bytes_ratio_vs_bf16)
+        "fp_weight_bytes": fp_weight_bytes,
+        "w8_weight_bytes": w8_weight_bytes,
+        "weight_bytes_ratio_vs_fp": round(
+            w8_weight_bytes / max(fp_weight_bytes, 1), 3),
+        "gpt2_w8_paged_decode_ttft_ms_p50": round(
+            w8_stats["ttft_ms_p50"], 3),
+        "gpt2_w8_paged_decode_ttft_ms_p95": round(
+            w8_stats["ttft_ms_p95"], 3),
+        "tpot_ms_p50": round(w8_stats["tpot_ms_p50"], 3),
+        "device": dev.device_kind, "platform": dev.platform,
+    }
+    print(json.dumps(w8_rec), flush=True)
 
     # --- tensor-parallel paged serving metric -------------------------------
     # the SAME mixed-length workload through a tp=2
