@@ -248,8 +248,13 @@ def check_blocking_under_lock(model: ConcModel) -> Iterator[Finding]:
 # 4-5. resource pairing (pages / prefix refs / spans, and bare locks)
 # --------------------------------------------------------------------------
 
-_POOL_ACQ = {"alloc_slot", "alloc_slot_shared"}
-_POOL_REL = {"release_slot", "free_slot"}
+# promote_pages pops device pages off the free stack exactly like an
+# allocation (the frontend calls it through its compiled `_promote_jit`
+# wrapper); the obligation discharges when insert_promoted grafts the
+# page into the radix tree, which owns its refcount from then on.
+_POOL_ACQ = {"alloc_slot", "alloc_slot_shared", "promote_pages",
+             "_promote_jit"}
+_POOL_REL = {"release_slot", "free_slot", "insert_promoted"}
 
 #: event kinds the pairing walk understands
 _ACQ, _REL, _ESC = "acq", "rel", "esc"

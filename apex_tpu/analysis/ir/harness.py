@@ -281,6 +281,57 @@ def _build_prefill_chunk_program() -> CaseProgram:
                        max_traces=1)
 
 
+def _build_host_tier_program(kind: str) -> CaseProgram:
+    """The tiered KV pool's two device programs (ISSUE 17): the
+    demote-side ``gather_pages`` (a pure READ — the cache is NOT
+    donated; donating it would free the pool out from under the engine,
+    which the aliasing rule must be able to see) and the promote-side
+    ``promote_pages`` (cache donated, like every pool-mutating
+    program). Both take a fixed null-padded ``HOST_COPY_CHUNK`` page
+    row plus a traced count: demote/promote DEPTH is data, never a
+    compile key — the two variants build their rows at different depths
+    the way the frontend does and must collapse to one jaxpr, so a
+    refactor that sizes the row by depth trips
+    ir-compile-key-cardinality."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models.gpt import GPTModel, gpt2_small_config
+    from apex_tpu.serving import kv_pool
+    from apex_tpu.serving.scheduler import PagedDecodeEngine
+
+    cfg = gpt2_small_config(dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    engine = PagedDecodeEngine(model, variables=None, num_slots=4,
+                               page_size=16, num_pages=33,
+                               max_pages_per_seq=16, prefix_cache=True,
+                               host_tier_bytes=1 << 24)
+    sds = lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)  # noqa: E731
+    cache_abs = jax.tree.map(sds, engine.cache)
+    C = kv_pool.HOST_COPY_CHUNK
+
+    def row_for(depth: int):
+        row = np.zeros((C,), np.int32)
+        row[:depth] = np.arange(1, depth + 1)
+        return jnp.asarray(row)
+
+    if kind == "gather":
+        return CaseProgram(fn=engine._gather_jit,
+                           args=(cache_abs, row_for(3)),
+                           variants=[(cache_abs, row_for(7))],
+                           max_traces=1)
+    tiles_abs = jax.tree.map(sds, jax.eval_shape(
+        kv_pool.gather_pages, cache_abs, row_for(3)))
+
+    def args_for(depth: int) -> tuple:
+        return (cache_abs, row_for(depth), jnp.int32(depth), tiles_abs)
+
+    return CaseProgram(fn=engine._promote_jit, args=args_for(3),
+                       variants=[args_for(7)], donate=(0,),
+                       max_traces=1)
+
+
 def _build_admit_bucketed() -> CaseProgram:
     """The engine's prompt-admission program, traced at two prompt
     lengths that land in the SAME bucket under the ENGINE'S OWN
@@ -678,6 +729,12 @@ def analysis_cases(root) -> List[AnalysisCase]:
     cases.append(AnalysisCase(
         "tp2_engine_admit_bucketed", "serving",
         lambda: _build_tp_engine_program("admit")))
+    cases.append(AnalysisCase(
+        "gpt2s_host_tier_gather", "serving",
+        lambda: _build_host_tier_program("gather")))
+    cases.append(AnalysisCase(
+        "gpt2s_host_tier_promote", "serving",
+        lambda: _build_host_tier_program("promote")))
     cases.append(AnalysisCase(
         "gpt2s_int8kv_engine_decode_chunk", "serving",
         lambda: _build_int8kv_engine_program("decode")))

@@ -51,8 +51,8 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = ["ChipProfile", "PROFILES", "EqnCost", "CaseCost",
            "cost_of_jaxpr", "cost_report", "decode_split",
-           "tp_decode_split", "spec_decode_split", "ledger_metrics",
-           "main"]
+           "tp_decode_split", "spec_decode_split", "host_tier_split",
+           "ledger_metrics", "main"]
 
 GIB = 1024 ** 3
 
@@ -66,12 +66,17 @@ class ChipProfile:
     """Peak rates for one accelerator. ``flops_per_sec`` is keyed by the
     model's dtype classes (``bf16`` covers fp16 too, ``int8`` the 8-bit
     integer MXU path, ``f32`` everything wider); unknown dtypes price at
-    the f32 rate — conservative for the roofline."""
+    the f32 rate — conservative for the roofline.
+    ``host_link_bytes_per_sec`` is the host<->device DMA stream (PCIe
+    for the inference parts) the tiered KV pool's demote/promote copies
+    ride — two orders of magnitude under HBM, which is exactly why the
+    tier only ever moves whole pages at sync boundaries."""
 
     name: str
     flops_per_sec: Dict[str, float]
     hbm_bytes_per_sec: float
     hbm_bytes: int
+    host_link_bytes_per_sec: float = 32e9
 
     def peak_flops(self, dtype_key: str) -> float:
         return self.flops_per_sec.get(dtype_key,
@@ -79,17 +84,21 @@ class ChipProfile:
 
 
 #: pluggable profile registry (``--profile``); numbers are the public
-#: per-chip peak specs
+#: per-chip peak specs (host link: PCIe gen3 x16 ~32 GB/s on v5e/v4
+#: hosts, gen4 x16 ~64 GB/s on v5p)
 PROFILES: Dict[str, ChipProfile] = {
     "v5e": ChipProfile("v5e",
                        {"bf16": 394e12, "f32": 197e12, "int8": 788e12},
-                       hbm_bytes_per_sec=819e9, hbm_bytes=16 * GIB),
+                       hbm_bytes_per_sec=819e9, hbm_bytes=16 * GIB,
+                       host_link_bytes_per_sec=32e9),
     "v5p": ChipProfile("v5p",
                        {"bf16": 459e12, "f32": 229e12, "int8": 918e12},
-                       hbm_bytes_per_sec=2765e9, hbm_bytes=95 * GIB),
+                       hbm_bytes_per_sec=2765e9, hbm_bytes=95 * GIB,
+                       host_link_bytes_per_sec=64e9),
     "v4": ChipProfile("v4",
                       {"bf16": 275e12, "f32": 137e12, "int8": 275e12},
-                      hbm_bytes_per_sec=1228e9, hbm_bytes=32 * GIB),
+                      hbm_bytes_per_sec=1228e9, hbm_bytes=32 * GIB,
+                      host_link_bytes_per_sec=32e9),
 }
 
 
@@ -598,6 +607,38 @@ def spec_decode_split(prog, profile: ChipProfile) -> dict:
     }
 
 
+def host_tier_split(prog, profile: ChipProfile) -> dict:
+    """The tiered KV pool's host-link DMA stream (ISSUE 17): one
+    demote (``gather_pages``) or promote (``promote_pages``) moves a
+    null-padded ``HOST_COPY_CHUNK`` batch of pages' K/V tiles — plus
+    per-(page, kv_head) scale rows on quantized pools — across the
+    host link, priced against ``profile.host_link_bytes_per_sec``
+    rather than HBM. ``prog`` is the ``gpt2s_host_tier_gather``
+    CaseProgram (args: cache, page row); the chunk bytes are the
+    gather's output tree evaluated abstractly off the cache leaves, so
+    the number tracks the pool dtype (an int8 pool moves narrow tiles
+    and f32 scales). The chunk time is what one promote adds to the
+    admission it extends — the banded ledger metric
+    ``host_tier.promote_chunk_predicted_ms``."""
+    import jax
+
+    from apex_tpu.serving import kv_pool
+
+    cache, row = prog.args[0], prog.args[1]
+    tiles = jax.eval_shape(kv_pool.gather_pages, cache, row)
+    chunk_bytes = sum(_aval_bytes(leaf)
+                      for leaf in jax.tree.leaves(tiles))
+    chunk_pages = int(row.shape[0])
+    dma_ms = chunk_bytes / profile.host_link_bytes_per_sec * 1e3
+    return {
+        "chunk_pages": chunk_pages,
+        "chunk_bytes": int(chunk_bytes),
+        "bytes_per_page": int(chunk_bytes // chunk_pages),
+        "host_link_bytes_per_sec": float(profile.host_link_bytes_per_sec),
+        "predicted_chunk_dma_ms": dma_ms,
+    }
+
+
 # --------------------------------------------------------------------------
 # whole-registry report
 # --------------------------------------------------------------------------
@@ -626,6 +667,7 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
     w8_split = None
     w4_split = None
     w8_tp_split = None
+    host_split = None
     for c in cases:
         try:
             ir = build_case_ir(c)
@@ -656,6 +698,9 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
                 w4_split = decode_split(ir.prog)
             if c.name == "tp2_w8_engine_decode_chunk":
                 w8_tp_split = tp_decode_split(ir.prog, prof)
+            if c.name == "gpt2s_host_tier_gather":
+                # the demote/promote DMA chunk over the host link
+                host_split = host_tier_split(ir.prog, prof)
         except Exception as e:       # noqa: BLE001 — report, don't crash
             errors.append({"case": c.name,
                            "error": f"{type(e).__name__}: {e}"})
@@ -683,6 +728,7 @@ def cost_report(root, *, profile: str = "v5e", case: Optional[str] = None,
             "w8_decode_split": w8_split,
             "w4_decode_split": w4_split,
             "w8_tp_decode_split": w8_tp_split,
+            "host_tier_split": host_split,
             "errors": errors}
 
 
@@ -768,6 +814,17 @@ def ledger_metrics(report: dict) -> Dict[str, float]:
                 float(slot["hbm_bytes_per_chip_per_step"])
             m[f"cost.tp_decode.w8.weight_fraction_tp{tp}"] = \
                 float(slot["weight_fraction"])
+    hsplit = report.get("host_tier_split")
+    if hsplit:
+        m["cost.decode.host_tier.chunk_bytes"] = \
+            float(hsplit["chunk_bytes"])
+        m["cost.decode.host_tier.bytes_per_page"] = \
+            float(hsplit["bytes_per_page"])
+        # same banding rationale as tp2.paged_decode above: the promote
+        # chunk's host-link DMA span is a headline ms and gates on the
+        # direction-aware band, not the exact-match ratchet
+        m["host_tier.promote_chunk_predicted_ms"] = \
+            float(hsplit["predicted_chunk_dma_ms"])
     ssplit = report.get("spec_decode_split")
     if ssplit:
         m["cost.spec_decode.k"] = float(ssplit["k"])

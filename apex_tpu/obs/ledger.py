@@ -155,6 +155,15 @@ _SCENARIO_ROUTER_FIELDS = ("failover_recovered_rate",
                            "affinity_hit_rate", "round_robin_hit_rate",
                            "affinity_delta_hit_rate")
 
+#: per-scenario HOST-TIER fields (the tiered KV pool's churn A/B,
+#: docs/serving.md "Tiered KV pool"): extracted from a report's
+#: ``host_tier`` block as ``scenario.<name>.<field>``. The hit-rate
+#: trio and ``promote_hit_rate`` gate on the absolute rate band as
+#: higher-better; ``tier_delta_hit_rate`` is the tier-beats-reprefill
+#: proof (strictly positive at a thrash-sized pool)
+_SCENARIO_HOST_TIER_FIELDS = ("tier_on_hit_rate", "tier_off_hit_rate",
+                              "tier_delta_hit_rate", "promote_hit_rate")
+
 #: per-scenario HTTP fields (the over-the-wire chaos tier,
 #: docs/http.md): extracted from a report's ``http`` block as
 #: ``scenario.<name>.http_<field>``. Counters, so informational —
@@ -186,6 +195,9 @@ _BENCH_FIELDS = (
     # ISSUE 16: quantized weight streaming (int8 policy, fused dequant)
     "gpt2_w8_paged_decode_ttft_ms_p50", "gpt2_w8_paged_decode_ttft_ms_p95",
     "weight_bytes_ratio_vs_fp",
+    # ISSUE 17: tiered KV pool (host-RAM spill under the device pool)
+    "host_tier_demotes", "host_tier_promotes",
+    "host_tier_promote_hit_rate",
 )
 
 
@@ -202,6 +214,11 @@ def _scenario_metrics(doc: dict) -> Dict[str, float]:
         router = rep.get("router", {}) if isinstance(rep, dict) else {}
         for field in _SCENARIO_ROUTER_FIELDS:
             v = router.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"scenario.{name}.{field}"] = float(v)
+        tier = rep.get("host_tier", {}) if isinstance(rep, dict) else {}
+        for field in _SCENARIO_HOST_TIER_FIELDS:
+            v = tier.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"scenario.{name}.{field}"] = float(v)
         http = rep.get("http", {}) if isinstance(rep, dict) else {}

@@ -26,6 +26,16 @@ This package replaces that with the two serving-stack staples:
   al. 2023). Opt-in via ``PagedDecodeEngine(..., prefix_cache=True)`` /
   ``generate(..., paged=True, prefix_cache=True)``.
 
+- **Tiered KV pool** (``host_tier``): a byte-budgeted host-RAM LRU
+  under the device pool — refcount-0 radix pages evicted under pressure
+  DEMOTE (async gather to pinned host memory, raw pool-dtype bytes +
+  scales) instead of dropping, and a later hit on a host-resident node
+  PROMOTES into freshly popped pages instead of re-prefilling; a
+  preemption spill's pages ride the same path, so a resume promotes
+  instead of recomputing. Opt-in via ``PagedDecodeEngine(...,
+  host_tier_bytes=...)`` (requires ``prefix_cache=True``;
+  docs/serving.md "Tiered KV pool").
+
 - **Async front-end** (``frontend`` + ``policy``): streaming ingest
   (``submit()`` returns a per-token :class:`StreamHandle`), a
   priority/deadline admission policy, preemption that spills a victim's
@@ -83,6 +93,7 @@ from apex_tpu.serving.frontend import (  # noqa: F401
     ServingFrontend,
     StreamHandle,
 )
+from apex_tpu.serving.host_tier import HostPageTier  # noqa: F401
 from apex_tpu.serving.kv_pool import (  # noqa: F401
     alloc_slot,
     alloc_slot_shared,

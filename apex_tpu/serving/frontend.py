@@ -86,6 +86,25 @@ __all__ = ["ServingError", "ServingFrontend", "StreamHandle"]
 _END = object()
 
 
+def _stack_tiles(payloads, chunk: int):
+    """Stack per-page host-tier payloads (per-layer dicts of one page's
+    K/V tiles + scales) into one ``kv_pool.promote_pages`` tile batch:
+    per-layer arrays of leading dim ``chunk``, zero-padded past the live
+    pages (the padded rows scatter to the null-page sink)."""
+    out = []
+    for li in range(len(payloads[0])):
+        lc = {}
+        for name in payloads[0][li]:
+            a = np.stack([p[li][name] for p in payloads])
+            if a.shape[0] < chunk:
+                a = np.concatenate(
+                    [a, np.zeros((chunk - a.shape[0],) + a.shape[1:],
+                                 a.dtype)])
+            lc[name] = a
+        out.append(lc)
+    return out
+
+
 class ServingError(RuntimeError):
     """Terminal serving failure delivered to a :class:`StreamHandle`:
     the pump died (engine fault, injected kill, scheduler deadlock), the
@@ -611,6 +630,12 @@ class ServingFrontend:
                 self._bubble.set(bubble_ms)
                 self._per_run["pump.bubble_ms"].append(bubble_ms)
                 self._last_ready = None
+        if eng.host_tier is not None:
+            # demote copies dispatched at earlier boundaries ride the
+            # double-buffered host-work slot: the next chunk is already
+            # in flight above, so converting the gathered tiles to host
+            # entries here overlaps the device, not the pipeline
+            eng.host_tier.drain()
         if prev is not None:
             self._harvest(prev)
         self._backpressure_spill()
@@ -1139,6 +1164,122 @@ class ServingFrontend:
         self._preempt(victim_slot)
         return True
 
+    # --- tiered pool (docs/serving.md "Tiered KV pool") ---------------------
+
+    def _demote(self, victims) -> None:
+        """Dispatch the device->host gather of evicted pages about to be
+        pushed onto the free stack: ``victims`` is the eviction sink's
+        ``(path_keys, page)`` list. Each ``HOST_COPY_CHUNK`` batch is one
+        async ``gather_pages`` call (null-padded row — depth is data);
+        the tiles land in the tier as PENDING device arrays and convert
+        to host entries at the pump's next host-work slot."""
+        eng = self.engine
+        C = kv_pool.HOST_COPY_CHUNK
+        for i in range(0, len(victims), C):
+            grp = victims[i:i + C]
+            row = np.zeros((C,), np.int32)
+            row[:len(grp)] = [page for _, page in grp]
+            tiles = eng._gather_jit(eng.cache, jnp.asarray(row))
+            eng.host_tier.put_pending([path for path, _ in grp], tiles,
+                                      n=len(grp))
+
+    def _try_promote(self, entry: _Entry, nodes: list) -> list:
+        """Extend ``entry``'s tree match with consecutive host-resident
+        pages: scatter their demoted bytes into freshly popped pages
+        (``kv_pool.promote_pages`` — bit-stable, never a re-prefill) and
+        graft them into the radix tree, returning the extended node path
+        for the ordinary shared admission. The match FLOOR is computed
+        first (a resume matches at its exact written depth, a cold
+        admission at the power-of-two bucket) so only pages that survive
+        the floor promote — a promoted-then-floored page would be a
+        wasted copy. When the free stack cannot cover both the promoted
+        pages and the admission's remaining private need, the tier
+        SWAPS: refcount-0 LRU pages evict (demoting through the same
+        sink — the matched path is pinned around the walk so the LRU
+        cannot eat it) to make room. If eviction still leaves the stack
+        short, the promotion skips (tier entries untouched) and the
+        admission proceeds as if the tier had missed."""
+        eng = self.engine
+        tier = eng.host_tier
+        ps = eng.page_size
+        prompt, s0 = entry.prompt, entry.s0
+        tier.drain()                     # pending demotes become hits
+        floor = (lambda d: d) if entry.resume else _bucket_match_pages
+        m0 = len(nodes)
+        cap = max(s0 - 1, 0) // ps       # match()'s own depth cap
+        if m0 >= cap:
+            return nodes[:floor(m0)]
+        base = tuple(n.key for n in nodes)
+        keys = [tuple(int(t) for t in prompt[j * ps:(j + 1) * ps])
+                for j in range(m0, cap)]
+        r = tier.run_length(base, keys)
+        target = floor(m0 + r)
+        if target <= m0:
+            return nodes[:target]
+        h = target - m0
+        # the pool read below syncs the stream — stamp the in-flight
+        # chunk first (same discipline as the admission's free read)
+        if self._inflight is not None:
+            self._materialize(self._inflight)
+        free = int(kv_pool.free_page_count(eng.cache))
+        need_after = kv_pool.pages_for(s0 + entry.seg_new, ps) - target
+        if free < h + need_after:
+            # the tier swap: in a thrashing pool the stack is never
+            # free-handed, so evict cold refcount-0 pages (they demote
+            # through the same sink) to make room for the hot ones. Pin
+            # the matched path first — it is not acquired yet, and the
+            # LRU walk must not evict it out from under the promotion.
+            eng.prefix.acquire(nodes)
+            victims: List[tuple] = []
+            pages = eng.prefix.evict(
+                h + need_after - free,
+                sink=lambda path_, page: victims.append((path_, page)))
+            eng.prefix.release(nodes)
+            if victims:
+                self._demote(victims)
+            if pages:
+                max_pages = eng.cache["block_tables"].shape[1]
+                row = np.zeros((max_pages,), np.int32)
+                row[:len(pages)] = pages
+                eng.cache = eng._evict_jit(eng.cache, jnp.asarray(row),
+                                           jnp.int32(len(pages)))
+                self._C["evicted_pages"].inc(len(pages))
+                eng.events.emit("evict", request=entry.idx,
+                                pages=len(pages))
+                free += len(pages)
+            if free < h + need_after:
+                return nodes[:floor(m0)]
+        payloads = []
+        path = base
+        for i in range(h):
+            path = path + (keys[i],)
+            payloads.append(tier.pop(path))  # ownership: tier -> pool
+        # destinations: the top h free-stack entries, host-read in the
+        # same pop order alloc_slot uses — promote_pages decrements
+        # free_top by exactly these pages
+        stack = np.asarray(eng.cache["free_stack"])
+        page_ids = stack[free - h:free][::-1].astype(np.int32)
+        C = kv_pool.HOST_COPY_CHUNK
+        t0 = self.clock()
+        for i in range(0, h, C):
+            n_g = min(C, h - i)
+            row = np.zeros((C,), np.int32)
+            row[:n_g] = page_ids[i:i + n_g]
+            eng.cache = eng._promote_jit(
+                eng.cache, jnp.asarray(row), jnp.int32(n_g),
+                _stack_tiles(payloads[i:i + n_g], C))
+        # block on the promoted pool's scalar: the measured span is the
+        # host->device copy the admission program would wait on anyway
+        np.asarray(eng.cache["free_top"])
+        tier.observe_promote_ms((self.clock() - t0) * 1e3)
+        for i in range(h):
+            nodes.append(eng.prefix.insert_promoted(nodes, keys[i],
+                                                    int(page_ids[i])))
+        self.tracer.event(entry.idx, "promote", pages=h)
+        eng.events.emit("promote", request=entry.idx, pages=h)
+        self._pool_dirty = True
+        return nodes
+
     # --- admission ----------------------------------------------------------
 
     def _try_admit(self, entry: _Entry, slot: int, now: float) -> bool:
@@ -1160,7 +1301,12 @@ class ServingFrontend:
         # not allocated, so they shrink the demand. Acquire immediately —
         # eviction below must see them pinned, not as LRU victims
         nodes = eng.prefix.match(prompt) if eng.prefix is not None else []
-        if not entry.resume:
+        if eng.host_tier is not None:
+            # tiered pool: extend the tree match with host-resident
+            # pages (promote instead of re-prefill); applies the match
+            # floor itself, so the plain floor below is the tier-off path
+            nodes = self._try_promote(entry, nodes)
+        elif not entry.resume:
             nodes = nodes[:_bucket_match_pages(len(nodes))]
         if nodes:
             eng.prefix.acquire(nodes)
@@ -1173,7 +1319,15 @@ class ServingFrontend:
             self._materialize(self._inflight)
         free = int(kv_pool.free_page_count(eng.cache))
         if free < need and eng.prefix is not None:
-            pages = eng.prefix.evict(need - free)
+            victims: List[tuple] = []
+            sink = ((lambda path, page: victims.append((path, page)))
+                    if eng.host_tier is not None else None)
+            pages = eng.prefix.evict(need - free, sink=sink)
+            if victims:
+                # demote BEFORE the stack push: the gather is queued on
+                # the device stream ahead of any program that could
+                # re-allocate (and overwrite) the evicted pages
+                self._demote(victims)
             if pages:
                 row = np.zeros((max_pages,), np.int32)
                 row[:len(pages)] = pages
@@ -1533,6 +1687,12 @@ class ServingFrontend:
             "chunked_prefills": int(d["chunked_prefills"]),
             "prefill_chunks": int(d["prefill_chunks"]),
         }
+        # tiered pool (docs/serving.md "Tiered KV pool"): lifetime
+        # demote/promote totals + the promote-hit rate, pool.host_tier_*
+        # instruments' stats()-shape view
+        stats["host_tier_enabled"] = eng.host_tier is not None
+        if eng.host_tier is not None:
+            stats.update(eng.host_tier.stats())
         # pump pipeline attribution + the recompile window
         # (docs/frontend.md "Measuring the pump"): bubble is the mean
         # device-idle gap per handoff — ~0 when double-buffering hides

@@ -460,6 +460,68 @@ def drop_slot_pages(cache, slot, upto):
     return out
 
 
+#: pages moved per gather/promote program call (docs/serving.md "Tiered
+#: KV pool"): the fixed tile-batch shape keeps both programs at ONE
+#: compile each — a demote/promote of any depth is a loop of these
+HOST_COPY_CHUNK = 8
+
+
+def tile_specs(config, axis_name: str = MODEL_AXIS, *, kv_dtype=None):
+    """PartitionSpec pytree for one gather/promote tile batch (the
+    ``gather_pages`` result / ``promote_pages`` operand): per-layer
+    ``(HOST_COPY_CHUNK, kv, page_size, d)`` K/V tiles shard along the
+    kv-HEAD axis (dim 1) exactly like the pool pages they were cut from,
+    so under TP each chip gathers/scatters its own head-shard and the
+    host tier holds the pages at FULL head width (``serving/tp.py``
+    maps the ``"tiles"`` compile role to this tree)."""
+    kv = PartitionSpec(None, axis_name)
+    layer = {"k_pages": kv, "v_pages": kv}
+    if kv_dtype is not None:
+        layer.update({"k_scales": kv, "v_scales": kv})
+    return [dict(layer) for _ in range(config.num_layers)]
+
+
+def gather_pages(cache, pages):
+    """Read ``HOST_COPY_CHUNK`` pages' K/V tiles (and, quantized pools,
+    their per-``(page, kv_head)`` scales) out of the pool — the demote
+    half of the tiered pool (docs/serving.md "Tiered KV pool"): the
+    frontend dispatches this BEFORE ``evict_pages`` returns the ids to
+    the free stack, so program order on the device stream guarantees the
+    copy reads the pages before any re-allocation overwrites them.
+    ``pages`` is a fixed ``(HOST_COPY_CHUNK,)`` int32 row, null-padded —
+    a null entry gathers page 0's garbage, which the caller discards.
+    Pure read: the cache is NOT donated (it stays live)."""
+    pages = jnp.asarray(pages, jnp.int32)
+    return [{key: lc[key][pages] for key in lc} for lc in cache["layers"]]
+
+
+def promote_pages(cache, pages, n, tiles):
+    """Scatter ``n`` host-resident page tiles into freshly popped pages
+    — the promote half of the tiered pool. ``pages`` holds the physical
+    destinations (the caller host-reads the top ``n`` free-stack entries,
+    exactly the pages this op's ``free_top -= n`` retires from the free
+    set — the same pop discipline as ``alloc_slot``, with the ids read
+    host-side so the tile write and the stack accounting cannot
+    disagree); entries past ``n`` sink to the null page like every other
+    masked pool write. The tiles are the raw pool-dtype bytes (and f32
+    scales) ``gather_pages`` demoted, written back verbatim — promote is
+    bit-stable by construction, never a requantization. The promoted
+    pages carry ``page_ref == 0``: they become prefix-cache property
+    (the radix tree grafts them via ``insert_promoted``), and sharers
+    refcount them through ``alloc_slot_shared`` as usual."""
+    pages = jnp.asarray(pages, jnp.int32)
+    n = jnp.asarray(n, jnp.int32)
+    idx = jnp.arange(pages.shape[0], dtype=jnp.int32)
+    dst = jnp.where(idx < n, pages, 0)
+    out = dict(cache)
+    out["layers"] = [
+        {key: lc[key].at[dst].set(tile[key].astype(lc[key].dtype))
+         for key in lc}
+        for lc, tile in zip(cache["layers"], tiles)]
+    out["free_top"] = cache["free_top"] - n
+    return out
+
+
 def evict_pages(cache, pages_row, n):
     """Push the first ``n`` entries of ``pages_row`` back onto the free
     stack — the prefix cache evicting refcount-0 pages it owns. The CALLER

@@ -137,11 +137,19 @@ class PrefixCache:
         """Process-stable identity of a node's full token path (root →
         node) — how a re-insertion of a previously EVICTED path is
         recognized (the churn signature; a fresh path is just growth)."""
+        return hash(self._path_keys(node))
+
+    def _path_keys(self, node: _Node):
+        """A node's full token path (root → node) as the tuple of its
+        ancestors' page keys — the radix-node identity the host tier
+        (``serving/host_tier.py``) files demoted pages under: token
+        content, not physical page id, so defrag/realloc never
+        invalidates a tier entry."""
         parts = []
         while node is not None and node.key is not None:
             parts.append(node.key)
             node = node.parent
-        return hash(tuple(reversed(parts)))
+        return tuple(reversed(parts))
 
     # --- admission ----------------------------------------------------------
 
@@ -251,14 +259,21 @@ class PrefixCache:
 
     # --- eviction -----------------------------------------------------------
 
-    def evict(self, n: int) -> List[int]:
+    def evict(self, n: int, *, sink=None) -> List[int]:
         """Evict up to ``n`` pages — LRU first, leaves only, refcount-0
         only — removing their nodes and returning the physical page ids
         for ``kv_pool.evict_pages``. Evicting a leaf can expose its parent
         as the next candidate, so candidates heap by ``last_used`` and a
         parent enters the heap the moment its last child leaves —
         O((candidates + n) log candidates), no per-victim rescans. Pinned
-        (refcount > 0) or interior pages never leave."""
+        (refcount > 0) or interior pages never leave.
+
+        ``sink``: optional ``sink(path_keys, page)`` callback invoked per
+        victim BEFORE its page id is returned for the free-stack push —
+        the host tier's demote hook (the frontend dispatches the page
+        gather against these ids first, so the device-stream copy reads
+        the page before any re-allocation can overwrite it). ``path_keys``
+        is the victim's full root→node token path (its tier identity)."""
         out: List[int] = []
         heap = [(nd.last_used, id(nd), nd) for nd in self._nodes
                 if not nd.children and nd.refs == 0]
@@ -276,6 +291,8 @@ class PrefixCache:
             if len(self._evicted_keys) >= _EVICTED_KEYS_CAP:
                 self._evicted_keys.clear()
             self._evicted_keys.add(self._path_hash(victim))
+            if sink is not None:
+                sink(self._path_keys(victim), victim.page)
             del parent.children[victim.key]
             self._nodes.remove(victim)
             out.append(victim.page)
@@ -287,6 +304,33 @@ class PrefixCache:
                         labels=self._metrics_labels).inc(len(out))
         self._observe()
         return out
+
+    # --- promotion (host tier -> tree) --------------------------------------
+
+    def insert_promoted(self, matched: Sequence[_Node], key,
+                        page: int) -> _Node:
+        """Graft one PROMOTED page under the matched path: the host tier
+        held this key's bytes, the frontend scattered them into freshly
+        popped page ``page`` (``kv_pool.promote_pages``), and the node
+        now names it exactly as if the page had never left — refcount 0
+        until the admission ``acquire``s the extended path. The caller
+        (the frontend's promote walk, which runs strictly between
+        ``match`` and ``acquire`` on the single pump thread) guarantees
+        ``key`` is not already a child — ``match`` just proved the walk
+        ended above it. Promotion is NOT a churn re-insert: the path came
+        back without recompute, so its evicted-path marker just clears."""
+        parent = matched[-1] if matched else self.root
+        assert key not in parent.children, \
+            "promote collided with a live child (match should have hit it)"
+        node = _Node(key=key, page=int(page), parent=parent)
+        node.last_used = self._tick
+        parent.children[key] = node
+        self._nodes.add(node)
+        self._evicted_keys.discard(self._path_hash(node))
+        metrics.counter("prefix_cache.promoted_pages",
+                        labels=self._metrics_labels).inc()
+        self._observe()
+        return node
 
     # --- maintenance --------------------------------------------------------
 

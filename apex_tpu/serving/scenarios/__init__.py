@@ -47,6 +47,7 @@ from apex_tpu.serving.scenarios.library import (  # noqa: F401
 )
 from apex_tpu.serving.scenarios.report import (  # noqa: F401
     AGGREGATE_FIELDS,
+    HOST_TIER_FIELDS,
     HTTP_FIELDS,
     REPORT_SCHEMA,
     ROUTER_FIELDS,

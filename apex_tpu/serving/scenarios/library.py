@@ -25,6 +25,11 @@ The catalog (docs/scenarios.md has the prose):
 - ``eviction-churn`` — the adversary: more cacheable header pages than
   the pool holds, so admissions evict each other's headers and the tree
   thrashes (``prefix_cache.churn`` / ``evicted_reinserted`` light up).
+- ``host-tier-churn`` — eviction-churn with a host-RAM spill tier
+  under the same thrash-sized pool (``EngineSpec.host_tier_bytes``):
+  churned hits promote instead of re-prefilling, and the report's
+  ``host_tier`` block banks the tier-on-vs-off hit-rate A/B (strictly
+  positive delta is the acceptance bar).
 - ``priority-flood`` — a low-priority flood pinning every slot while a
   high-priority deadline stream arrives: preempt-and-spill under
   ``preempt_on_priority``, priority-inversion bounded.
@@ -184,6 +189,26 @@ def _eviction_churn(seed: int) -> ScenarioSpec:
                           prefix_cache=True, num_pages=24),
         description="adversarial header set > pool capacity: radix "
                     "thrash")
+
+
+@register("host-tier-churn")
+def _host_tier_churn(seed: int) -> ScenarioSpec:
+    ps = 8
+    # the eviction-churn adversary with a host-RAM spill tier under the
+    # same thrash-sized pool: every churned header eviction demotes and
+    # every revisit promotes, so the banked host_tier block's
+    # tier-on-vs-off hit-rate delta must be strictly positive
+    return ScenarioSpec(
+        name="host-tier-churn", seed=seed, n_requests=32,
+        arrival=Arrival(kind="closed", users=4, think_ms=4.0),
+        prompt_lens=Lengths(kind="uniform", lo=1, hi=8),
+        output_lens=Lengths(kind="uniform", lo=2, hi=6),
+        tenants=churn_tenants(8, 4, ps),
+        engine=EngineSpec(model="gpt2-tiny", num_slots=2, page_size=ps,
+                          prefix_cache=True, num_pages=24,
+                          host_tier_bytes=1 << 24),
+        description="eviction-churn with a host spill tier: churned "
+                    "hits promote instead of re-prefilling")
 
 
 @register("priority-flood")

@@ -24,7 +24,7 @@ import numpy as np
 
 __all__ = ["REPORT_SCHEMA", "SCENARIOS_SCHEMA", "AGGREGATE_FIELDS",
            "TENANT_FIELDS", "ROUTER_FIELDS", "HTTP_FIELDS",
-           "build_report", "validate_report"]
+           "HOST_TIER_FIELDS", "build_report", "validate_report"]
 
 REPORT_SCHEMA = "apex-tpu/scenario-report/v1"
 #: the multi-scenario CLI document wrapping one report per scenario
@@ -57,6 +57,16 @@ ROUTER_FIELDS = (
     "failovers", "failover_requests", "failover_recovered",
     "failover_recovered_rate", "shed_requests", "migrations",
     "replica_deaths", "affinity_hit_rate",
+)
+
+#: pinned ``host_tier`` block keys (present on tiered scenarios only —
+#: ``EngineSpec(host_tier_bytes > 0)``; the A/B keys come from the same
+#: trace re-replayed with the tier off, docs/serving.md "Tiered KV
+#: pool")
+HOST_TIER_FIELDS = (
+    "budget_bytes", "demotes", "promotes", "host_evicted_pages",
+    "promote_hit_rate", "tier_on_hit_rate", "tier_off_hit_rate",
+    "tier_delta_hit_rate",
 )
 
 #: pinned ``http`` block keys (present when the scenario replayed over
@@ -96,13 +106,16 @@ def _latency_block(lifes: List[dict], missed: Dict[int, bool],
 def build_report(spec, trace, outputs, stats: dict, tracer,
                  wall_s: float, checks: Optional[dict] = None,
                  router: Optional[dict] = None,
-                 http: Optional[dict] = None) -> dict:
+                 http: Optional[dict] = None,
+                 host_tier: Optional[dict] = None) -> dict:
     """Assemble the pinned-schema report for one replayed scenario.
     ``router`` is the replicated-scenario block (``ROUTER_FIELDS``) —
     failover/recovery facts and the affinity A/B; ``http`` the
-    over-the-wire replay's block (``HTTP_FIELDS``); ``tracer`` may be
-    the router's cross-replica lifecycle adapter (same ``lifecycle``/
-    ``spans`` surface as a :class:`~apex_tpu.obs.spans.SpanTracer`)."""
+    over-the-wire replay's block (``HTTP_FIELDS``); ``host_tier`` the
+    tiered-pool block (``HOST_TIER_FIELDS``) — demote/promote facts and
+    the tier-on/off A/B; ``tracer`` may be the router's cross-replica
+    lifecycle adapter (same ``lifecycle``/``spans`` surface as a
+    :class:`~apex_tpu.obs.spans.SpanTracer`)."""
     events = trace.events
     lifes = [tracer.lifecycle(e.request_id) for e in events]
     # per-request deadline facts: carried by the trace (who had one) and
@@ -157,6 +170,8 @@ def build_report(spec, trace, outputs, stats: dict, tracer,
         report["router"] = dict(router)
     if http is not None:
         report["http"] = dict(http)
+    if host_tier is not None:
+        report["host_tier"] = dict(host_tier)
     if checks is not None:
         report["checks"] = dict(checks)
     return report
@@ -193,3 +208,8 @@ def validate_report(report: dict) -> None:
                      if f not in report["http"]]
         if h_missing:
             raise ValueError(f"http block missing {h_missing}")
+    if "host_tier" in report:
+        ht_missing = [f for f in HOST_TIER_FIELDS
+                      if f not in report["host_tier"]]
+        if ht_missing:
+            raise ValueError(f"host_tier block missing {ht_missing}")

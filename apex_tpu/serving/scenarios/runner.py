@@ -118,6 +118,13 @@ class EngineSpec:
     token-comparable to the single-chip engine and to lock-step
     ``generate`` (the ``check=True`` amplifiers bind exactly that).
 
+    ``host_tier_bytes > 0`` gives the engine a host-RAM spill tier of
+    that byte budget under the device pool (docs/serving.md "Tiered KV
+    pool"): evicted/spilled refcount-0 pages demote instead of
+    dropping, and churned hits promote instead of re-prefilling. The
+    report then carries a ``host_tier`` block with the tier-on vs
+    tier-off hit-rate A/B (the same trace re-replayed tier-off).
+
     ``replicas > 1`` serves the trace through a
     :class:`~apex_tpu.serving.router.ReplicaRouter` over that many
     frontend+engine replicas (docs/router.md): ``routing`` picks the
@@ -148,6 +155,7 @@ class EngineSpec:
     sync_every: int = 1
     prefix_cache: bool = True
     num_pages: Optional[int] = None      # None = worst-case pool
+    host_tier_bytes: int = 0             # >0 = host-RAM spill tier budget
     preempt_on_priority: bool = False
     preempt_margin_ms: float = 50.0
     tensor_parallel: int = 1             # >1 = TP mesh engine
@@ -308,7 +316,8 @@ def _build_engine(spec: ScenarioSpec, model, variables, *,
               num_pages=es.num_pages,
               sync_every=sync_every if sync_every is not None
               else es.sync_every,
-              prefix_cache=es.prefix_cache)
+              prefix_cache=es.prefix_cache,
+              host_tier_bytes=es.host_tier_bytes or None)
     if es.tensor_parallel > 1:
         from apex_tpu.serving.tp import TensorParallelPagedEngine
 
@@ -540,6 +549,34 @@ def _router_block(spec: ScenarioSpec, trace: Trace,
     return block
 
 
+def _host_tier_block(spec: ScenarioSpec, trace: Trace,
+                     stats: dict) -> dict:
+    """The report's ``host_tier`` block for a tiered scenario
+    (``engine.host_tier_bytes > 0``): the tier's demote/promote facts
+    plus the tier-on vs tier-off hit-rate A/B — the same trace
+    re-replayed through a fresh engine with the tier OFF, so the banked
+    delta measures what demote/promote earned, not workload luck. The
+    acceptance bar (docs/scenarios.md): at a thrash-sized pool the
+    delta must be strictly positive."""
+    tier_on_rate = round(float(stats.get("prefix_hit_rate", 0.0)), 4)
+    off_spec = dataclasses.replace(
+        spec, engine=dataclasses.replace(spec.engine, host_tier_bytes=0))
+    _, off_stats, _, _ = replay(off_spec, trace)
+    tier_off_rate = round(float(off_stats.get("prefix_hit_rate", 0.0)), 4)
+    return {
+        "budget_bytes": int(spec.engine.host_tier_bytes),
+        "demotes": int(stats.get("host_tier_demotes", 0)),
+        "promotes": int(stats.get("host_tier_promotes", 0)),
+        "host_evicted_pages": int(stats.get("host_tier_evicted_pages",
+                                            0)),
+        "promote_hit_rate":
+            round(float(stats.get("host_tier_promote_hit_rate", 0.0)), 4),
+        "tier_on_hit_rate": tier_on_rate,
+        "tier_off_hit_rate": tier_off_rate,
+        "tier_delta_hit_rate": round(tier_on_rate - tier_off_rate, 4),
+    }
+
+
 def run_scenario(spec: ScenarioSpec, *, check: bool = False,
                  trace: Optional[Trace] = None) -> ScenarioResult:
     """Materialize (unless a saved ``trace`` is injected), replay, and
@@ -548,7 +585,10 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
     their outcome under ``report["checks"]`` (raising on divergence).
     Replicated scenarios (``engine.replicas > 1``) add the ``router``
     block — failover/recovery facts and, with
-    ``compare_round_robin``, the affinity-vs-round-robin hit-rate A/B."""
+    ``compare_round_robin``, the affinity-vs-round-robin hit-rate A/B.
+    Tiered scenarios (``engine.host_tier_bytes > 0``) add the
+    ``host_tier`` block — demote/promote facts and the tier-on vs
+    tier-off hit-rate A/B on the same trace."""
     if trace is None:
         trace = materialize(spec)
     outputs, stats, tracer, wall_s = replay(spec, trace)
@@ -562,9 +602,12 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
                   "scheduling_invariance": True}
     router_block = _router_block(spec, trace, stats) \
         if spec.engine.replicas > 1 else None
+    host_tier_block = _host_tier_block(spec, trace, stats) \
+        if spec.engine.host_tier_bytes > 0 else None
     rep = report_mod.build_report(spec, trace, outputs, stats, tracer,
                                   wall_s, checks=checks,
-                                  router=router_block, http=http_block)
+                                  router=router_block, http=http_block,
+                                  host_tier=host_tier_block)
     report_mod.validate_report(rep)
     return ScenarioResult(spec=spec, trace=trace, outputs=outputs,
                           stats=stats, report=rep)

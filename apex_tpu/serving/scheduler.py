@@ -75,6 +75,7 @@ from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.ops.quant import resolve_kv_dtype
 from apex_tpu.serving import kv_pool
+from apex_tpu.serving.host_tier import HostPageTier
 from apex_tpu.serving.prefix_cache import PrefixCache
 
 #: run() counters in the instrument registry (``serving.<name>``); the
@@ -309,7 +310,8 @@ class PagedDecodeEngine:
                  prefix_cache: bool = False,
                  draft_model=None, draft_variables=None, draft_len: int = 0,
                  prefill_chunk: Optional[int] = None, kv_dtype=None,
-                 draft_kv_dtype="match"):
+                 draft_kv_dtype="match",
+                 host_tier_bytes: Optional[int] = None):
         cfg = model.config
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -448,6 +450,24 @@ class PagedDecodeEngine:
         self.prefix = (PrefixCache(page_size,
                                    metrics_labels=self.obs_labels)
                        if prefix_cache else None)
+        # tiered pool (docs/serving.md "Tiered KV pool"): a host-RAM
+        # byte-budgeted LRU under the device pool — evicted radix pages
+        # demote (gather -> host) instead of dropping, and a later hit
+        # promotes into fresh pages instead of re-prefilling. Keyed by
+        # radix-node identity, so it REQUIRES the prefix cache: without
+        # the tree there is no name to file a demoted page under.
+        if host_tier_bytes is not None and host_tier_bytes > 0:
+            if self.prefix is None:
+                raise ValueError(
+                    "host_tier_bytes requires prefix_cache=True: the "
+                    "tier files demoted pages under radix-node identity "
+                    "(their token path), which only the prefix cache "
+                    "names")
+            self.host_tier = HostPageTier(host_tier_bytes,
+                                          page_size=page_size,
+                                          metrics_labels=self.obs_labels)
+        else:
+            self.host_tier = None
         self._admit_jit = {}             # prompt bucket -> compiled admit
         self._shared_admit_jit = {}      # (t_start, tail_bucket) -> admit
         self._spec_admit_jit = {}        # prompt bucket -> spec admit
@@ -468,6 +488,17 @@ class PagedDecodeEngine:
         self._drop_jit = self._compile(
             kv_pool.drop_slot_pages, ("cache", "rep", "rep"), ("cache",),
             donate)
+        if self.host_tier is not None:
+            # the tiered pool's two device programs, each ONE compile:
+            # demote depth and promote depth are DATA (a null-padded
+            # HOST_COPY_CHUNK page row + a traced count), never a compile
+            # key. The gather is a pure READ — donating the cache to it
+            # would free the pool out from under the engine.
+            self._gather_jit = self._compile(
+                kv_pool.gather_pages, ("cache", "rep"), ("tiles",))
+            self._promote_jit = self._compile(
+                kv_pool.promote_pages, ("cache", "rep", "rep", "tiles"),
+                ("cache",), donate)
         if draft_len > 0:
             # draft-pool mirrors of the maintenance programs, compiled
             # through the same seam under the draft roles so TP shards

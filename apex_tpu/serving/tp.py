@@ -273,6 +273,12 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
         # bytes stay 1/tp of the (already ~2x smaller) global pool
         self._cache_specs = kv_pool.cache_specs(
             cfg, axis_name=axis, kv_dtype=kwargs.get("kv_dtype"))
+        # tiered pool (docs/serving.md "Tiered KV pool"): gather/promote
+        # tile batches shard along the kv-head axis with the pages they
+        # were cut from — each chip demotes/promotes its own head-shard,
+        # and the host tier holds every page at FULL head width
+        self._tile_specs = kv_pool.tile_specs(
+            cfg, axis_name=axis, kv_dtype=kwargs.get("kv_dtype"))
         _, self._var_specs = infer_variable_specs(model, axis_name=axis)
         # speculative decode: the draft pool and draft variables shard
         # over the SAME mesh (the draft model's own head/column layout),
@@ -316,7 +322,8 @@ class TensorParallelPagedEngine(PagedDecodeEngine):
         false."""
         spec_of = {"cache": self._cache_specs, "vars": self._var_specs,
                    "draft_cache": self._draft_cache_specs,
-                   "draft_vars": self._draft_var_specs, "rep": P()}
+                   "draft_vars": self._draft_var_specs,
+                   "tiles": self._tile_specs, "rep": P()}
         in_specs = tuple(spec_of[r] for r in in_roles)
         out_specs = tuple(spec_of[r] for r in out_roles)
         if len(out_specs) == 1:

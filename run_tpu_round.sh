@@ -83,7 +83,10 @@ JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
 # still leaves the round's scenario evidence. The per-scenario SLO
 # fields (scenario.<name>.ttft_ms_p95 / tpot_ms_p95 /
 # deadline_miss_rate) band-gate against the trajectory like the other
-# wall-time metrics — check BEFORE append (checking after would compare
+# wall-time metrics, and host-tier-churn's host_tier block banks the
+# tier-on-vs-off hit-rate A/B (scenario.host-tier-churn.
+# tier_delta_hit_rate — the strictly-positive proof the spill tier
+# earns its copies, docs/serving.md "Tiered KV pool") — check BEFORE append (checking after would compare
 # the round to itself); a regression marks the round failed at exit
 # with the entry still banked.
 if [ ! -f "SCENARIOS_${TAG}.json" ]; then
@@ -95,7 +98,7 @@ if [ ! -f "SCENARIOS_${TAG}.json" ]; then
       XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
       timeout 1800 python -m apex_tpu.serving.scenarios \
       --scenario steady-poisson --scenario multi-tenant-shared-prefix \
-      --scenario tp-shared-prefix \
+      --scenario tp-shared-prefix --scenario host-tier-churn \
       --json "SCENARIOS_${TAG}.json" --seed 0; then
     echo "[$(date +%H:%M:%S)] scenario smoke failed; the workload layer"
     echo "  is broken — fix before burning a tunnel window"

@@ -41,7 +41,15 @@ CASE_NAMES = [
     "gpt2s_paged_decode_int8kv",      # quantized pool: in-kernel dequant
     "gpt2s_paged_decode_w8",          # w8 policy: fused dequant-matmul
     "gpt2s_fused_dequant_w4",         # int4 nibbles + grouped scales
+    "gpt2s_host_tier_gather",         # tiered pool: demote-side page read
+    "gpt2s_host_tier_promote",        # tiered pool: promote-side scatter
 ]
+
+#: ISSUE 17: the tiered pool's copy programs are plain XLA data movers
+#: by design — the pin is INVERTED (zero tpu_custom_call sites). A
+#: Mosaic kernel appearing here must be acknowledged by moving the name
+#: out of this set.
+NO_MOSAIC_CASES = {"gpt2s_host_tier_gather", "gpt2s_host_tier_promote"}
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -87,8 +95,14 @@ def test_kernel_compiles_to_mosaic_under_budget(name, mesh, cases):
     fn, structs, donate = cases[name]
     r = tpu_aot.case_result(mesh, fn, structs, donate)
     assert r["ok"]
-    assert r["tpu_custom_call_sites"] >= 1, (
-        "kernel lowered without a tpu_custom_call — interpret-mode leak?")
+    if name in NO_MOSAIC_CASES:
+        assert r["tpu_custom_call_sites"] == 0, (
+            "a Mosaic kernel appeared in a plain-XLA copy program — "
+            "move the name out of NO_MOSAIC_CASES to acknowledge it")
+    else:
+        assert r["tpu_custom_call_sites"] >= 1, (
+            "kernel lowered without a tpu_custom_call — interpret-mode "
+            "leak?")
     assert r["under_16gib_budget"], r
     # static perf-lint: no copy/transpose result over 256 MiB (the r3
     # 86 GB relayout class is visible in compiled text)

@@ -50,7 +50,7 @@ def test_bench_sharded_over_8_cpu_devices():
 def test_decode_bench_smoke_emits_json(tmp_path):
     """tpu_decode_bench.py in smoke mode prints its parseable JSON
     records (lock-step, paged, int8-kv paged, w8 weight-streaming,
-    tp=2, prefix-cached,
+    tp=2, prefix-cached, host-tier churn,
     async frontend, speculative, chunked-prefill TTFT A/B), the paged
     record carries the TTFT/decode-step percentile fields (ISSUE 4), the
     frontend record carries the open-loop TTFT/TPOT/deadline-miss fields
@@ -148,6 +148,22 @@ def test_decode_bench_smoke_emits_json(tmp_path):
 
     pc = recs["gpt2_prefix_cached_decode_tokens_per_sec_per_chip"]
     assert pc["ttft_ms_p50"] > 0 and pc["decode_step_ms_p50"] > 0
+
+    # the tiered KV pool's record (ISSUE 17, docs/serving.md "Tiered KV
+    # pool"): the churn workload at a thrash-sized pool actually
+    # demoted AND promoted, the promote-hit rate parses, and — asserted
+    # inside the bench itself — the tier-on run is token-identical to
+    # the tier-off engine with strictly more prefix hits
+    ht = recs["gpt2_host_tier_decode_tokens_per_sec_per_chip"]
+    assert ht["value"] > 0
+    assert ht["unit"] == "tokens/s/chip"
+    assert ht["host_tier_enabled"] is True
+    assert ht["host_tier_budget_bytes"] > 0
+    assert ht["host_tier_demotes"] > 0
+    assert ht["host_tier_promotes"] > 0
+    assert 0.0 < ht["host_tier_promote_hit_rate"] <= 1.0
+    assert ht["evicted_pages"] > 0            # the pool really thrashed
+    assert ht["prefill_tokens_skipped"] > 0
 
     # the async front-end's open-loop record (docs/frontend.md): TTFT /
     # TPOT percentiles + deadline accounting parse, and the adversarial

@@ -364,6 +364,12 @@ def test_pump_thread_coloring_on_live_frontend():
     for fn in ("ServingFrontend.pump", "ServingFrontend._harvest",
                "ServingFrontend._try_admit", "StreamHandle._push"):
         assert fn in colored, sorted(colored)
+    # ISSUE 17: the host-tier copy drain rides the pump's host-work
+    # slot — no new thread, so the demote/promote chain must inherit
+    # the pump color (the field rule sees tier-adjacent engine state
+    # as pump-confined, same as the admission path)
+    for fn in ("ServingFrontend._demote", "ServingFrontend._try_promote"):
+        assert fn in colored, sorted(colored)
     # the /metrics endpoint's handler colors the exporter/registry reads
     http = {k.qualname for k, v in model.colors.items()
             if "http-handler" in v}
@@ -428,6 +434,51 @@ def test_router_guardedby_map_pinned():
             "FaultInjector._lock"
     assert guards[("ServingFrontend", "_accepting")] == \
         "ServingFrontend._ingest_lock"
+
+
+def test_host_tier_guardedby_map_pinned():
+    """ISSUE 17: the host spill tier is one single-lock object shared
+    between the pump (demote / drain / promote) and arbitrary caller
+    threads reading ``stats()`` — the inference must recover
+    ``HostPageTier._lock`` for every piece of tier state."""
+    model, _ = build_model(_surface_sources())
+    guards = {(f[1], f[2]): lock.display()
+              for f, (lock, _, _) in model.inferred_guards().items()}
+    for field in ("_entries", "_pending", "_resident_bytes"):
+        assert guards[("HostPageTier", field)] == \
+            "HostPageTier._lock", (field, guards.get(
+                ("HostPageTier", field)))
+
+
+def test_promote_pairing_catches_dropped_promotion():
+    """ISSUE 17: ``promote_pages`` pops device pages off the free stack
+    exactly like an allocation; the obligation discharges when
+    ``insert_promoted`` grafts the page into the radix tree. A path
+    that promotes but exits before the graft silently leaks device
+    pages — the conc-resource-leak pairing table must catch it."""
+    bad = """\
+        from apex_tpu.serving import kv_pool
+
+        def promote(cache, tree, nodes, key, pages, n, tiles, ok):
+            cache = kv_pool.promote_pages(cache, pages, n, tiles)
+            if not ok:
+                return cache
+            tree.insert_promoted(nodes, key, int(pages[0]))
+            return cache
+    """
+    findings, _ = _run(bad)
+    assert [f.rule for f in findings] == ["conc-resource-leak"], \
+        [(f.rule, f.message) for f in findings]
+    good = """\
+        from apex_tpu.serving import kv_pool
+
+        def promote(cache, tree, nodes, key, pages, n, tiles):
+            cache = kv_pool.promote_pages(cache, pages, n, tiles)
+            tree.insert_promoted(nodes, key, int(pages[0]))
+            return cache
+    """
+    findings, _ = _run(good)
+    assert not findings, [(f.rule, f.message) for f in findings]
 
 
 def test_asyncio_task_coloring_on_live_http_server():

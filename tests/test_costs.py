@@ -270,6 +270,35 @@ def test_spec_decode_split_beats_decode_at_acceptance_two(
     assert f"spec_decode.predicted_step_ms_a{k}" in m
 
 
+def test_host_tier_split_prices_the_dma_chunk(registry_report):
+    """ISSUE 17 acceptance: the tiered pool's demote/promote chunk is
+    priced against the chip's HOST LINK, not HBM — the chunk bytes are
+    the registered gather case's output tree (HOST_COPY_CHUNK pages'
+    K/V tiles), and the exact/banded ledger metric pair is emitted."""
+    from apex_tpu.serving import kv_pool
+
+    hsplit = registry_report["host_tier_split"]
+    assert hsplit is not None
+    assert hsplit["chunk_pages"] == kv_pool.HOST_COPY_CHUNK
+    assert hsplit["chunk_bytes"] == \
+        hsplit["bytes_per_page"] * kv_pool.HOST_COPY_CHUNK
+    assert hsplit["host_link_bytes_per_sec"] == \
+        PROF.host_link_bytes_per_sec
+    # the reason the tier exists as a *spill* tier and not a peer: the
+    # host link is far under HBM bandwidth on every profile
+    for prof in costs.PROFILES.values():
+        assert prof.host_link_bytes_per_sec \
+            < 0.1 * prof.hbm_bytes_per_sec
+    assert hsplit["predicted_chunk_dma_ms"] == pytest.approx(
+        hsplit["chunk_bytes"] / PROF.host_link_bytes_per_sec * 1e3)
+    m = costs.ledger_metrics(registry_report)
+    assert m["cost.decode.host_tier.chunk_bytes"] == \
+        float(hsplit["chunk_bytes"])
+    assert m["cost.decode.host_tier.bytes_per_page"] == \
+        float(hsplit["bytes_per_page"])
+    assert "host_tier.promote_chunk_predicted_ms" in m
+
+
 def test_cli_single_case_and_text_report(tmp_path, capsys):
     rc = costs.main(["--case", "layer_norm_fwd",
                      "--json", str(tmp_path / "r.json")])

@@ -1,0 +1,328 @@
+"""Tiered KV page pool (apex_tpu/serving/host_tier.py + the kv_pool
+gather/promote ops + the frontend demote/promote wiring).
+
+Invariant tier (no model): HostPageTier budget-LRU semantics (insert /
+run_length / pop / oldest-first eviction over a byte budget) and the
+``gather_pages`` -> host -> ``promote_pages`` roundtrip restoring page
+bytes (and quantized scales) EXACTLY.
+
+Engine tier (tiny GPT): the acceptance bars — a thrashing pool that
+previously re-prefilled on every churned hit now PROMOTES (strictly more
+prefix hits tier-on than tier-off, token-identical outputs vs tier-off
+and vs the all-HBM pool), preemption spill -> demote -> promote-resume
+identity, defrag composing with resident tier entries (keys are token
+paths, nothing to remap), an int8 pool demoting losslessly, and TP=2
+token identity with the tier on — plus the zero-leak bar: after the
+churn every non-cached page is back on the free stack and no refcount
+survives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.generation import generate
+from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+from apex_tpu.serving import (HostPageTier, PagedDecodeEngine,
+                              PriorityDeadlinePolicy, Request,
+                              free_page_count, init_paged_cache)
+from apex_tpu.serving import kv_pool
+from apex_tpu.serving.frontend import ServingFrontend
+
+PS = 8
+
+
+def _model():
+    cfg = gpt_tiny_config()
+    model = GPTModel(cfg)
+    v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return cfg, model, v
+
+
+def _lockstep(model, v, req):
+    return np.asarray(generate(model, v, np.asarray(req.prompt)[None],
+                               max_new_tokens=req.max_new_tokens)
+                      )[0, np.asarray(req.prompt).shape[0]:]
+
+
+def _churn_reqs(rng, cfg, *, tenants=3, header_pages=3, n=9):
+    """Round-robin over ``tenants`` shared headers, each ``header_pages``
+    pages long: at ``num_pages=8`` (7 usable) the headers cannot all stay
+    device-resident, so every revisit is a churned hit — the workload the
+    tier exists for."""
+    headers = [rng.integers(0, cfg.vocab_size,
+                            (header_pages * PS,)).astype(np.int32)
+               for _ in range(tenants)]
+    reqs = []
+    for i in range(n):
+        tail = rng.integers(0, 100, (2 + i % 4,)).astype(np.int32)
+        reqs.append(Request(
+            prompt=np.concatenate([headers[i % tenants], tail]),
+            max_new_tokens=3))
+    return reqs
+
+
+def _run_seq(engine, reqs):
+    """One request at a time (keeps the churn order deterministic);
+    returns (outputs, per-run stats summed across the sequence)."""
+    outs, total = [], {}
+    for r in reqs:
+        (o,), stats = engine.run([r])
+        outs.append(np.asarray(o))
+        for k, val in stats.items():
+            if isinstance(val, (int, float)):
+                total[k] = total.get(k, 0) + val
+    return outs, total
+
+
+def _assert_no_leak(engine):
+    """Free-stack + refcount hygiene: every page is either on the free
+    stack or named by the radix tree, and no slot refcount survives."""
+    usable = engine.cache["free_stack"].shape[0] - 1
+    assert int(free_page_count(engine.cache)) + len(engine.prefix) == usable
+    assert int(np.asarray(engine.cache["page_ref"]).sum()) == 0
+
+
+# --- invariant tier ----------------------------------------------------------
+
+
+def test_tier_budget_lru_and_run_length():
+    """Budget-LRU semantics without a model: oldest entries evict when
+    the byte budget overflows, run_length bumps recency (a re-hit page
+    survives an eviction that takes a colder one), and pop removes."""
+    page = {"k_pages": np.zeros((4, 1, PS, 4), np.float32),
+            "v_pages": np.zeros((4, 1, PS, 4), np.float32)}
+    per_page = 2 * 1 * PS * 4 * 4
+    tier = HostPageTier(3 * per_page, page_size=PS)
+
+    keys = [((i,) * PS,) for i in range(4)]
+    tier.put_pending(keys[:3], [page], 3)
+    tier.drain()
+    assert len(tier) == 3 and tier.resident_bytes == 3 * per_page
+
+    # recency: touch key 0 so the NEXT eviction takes key 1, not 0
+    assert tier.run_length((), [keys[0][0]]) == 1
+    tier.put_pending(keys[3:], [{k: a[:1] for k, a in page.items()}], 1)
+    tier.drain()
+    st = tier.stats()
+    assert st["host_tier_evicted_pages"] == 1
+    assert tier.run_length((), [keys[1][0]]) == 0      # evicted (coldest)
+    assert tier.run_length((), [keys[0][0]]) == 1      # survived
+
+    # run_length walks CONSECUTIVE residency from the base path
+    assert tier.run_length((), [keys[1][0], keys[2][0]]) == 0
+    payload = tier.pop(keys[2])
+    assert payload is not None and tier.pop(keys[2]) is None
+    st = tier.stats()
+    assert st["host_tier_promotes"] == 1
+    assert 0.0 < st["host_tier_promote_hit_rate"] < 1.0
+
+    # an entry bigger than the whole budget is dropped, not inserted
+    tiny = HostPageTier(per_page - 1, page_size=PS)
+    tiny.put_pending(keys[:1], [{k: a[:1] for k, a in page.items()}], 1)
+    tiny.drain()
+    assert len(tiny) == 0
+
+    with pytest.raises(ValueError):
+        HostPageTier(0, page_size=PS)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_gather_promote_roundtrip_bitexact(rng, kv_dtype):
+    """demote -> host -> promote restores the page bytes (and, quantized,
+    the per-(page, kv_head) scales) EXACTLY — promote never requantizes,
+    so the PR 14 full-page bit-stability invariant survives the tier."""
+    cfg = gpt_tiny_config()
+    cache = init_paged_cache(cfg, num_slots=1, num_pages=8, page_size=PS,
+                             kv_dtype=kv_dtype)
+    layers = []
+    for lc in cache["layers"]:
+        lc = dict(lc)
+        for name, arr in lc.items():
+            vals = rng.integers(-100, 100, arr.shape)
+            lc[name] = jnp.asarray(vals, arr.dtype)
+        layers.append(lc)
+    cache = dict(cache, layers=layers)
+
+    pages = jnp.asarray([3, 5, 2, 0], jnp.int32)      # row is null-padded
+    tiles = kv_pool.gather_pages(cache, pages)
+    host = [{k: np.asarray(a) for k, a in lc.items()} for lc in tiles]
+
+    # scribble over the source pages, then promote the host copy back
+    # into the SAME physical ids (popped off a stack arranged to yield
+    # them) — every byte must round-trip
+    wiped = [{k: a.at[pages[:3]].set(jnp.zeros_like(a[pages[:3]]))
+              for k, a in lc.items()} for lc in cache["layers"]]
+    stack = np.asarray(cache["free_stack"]).copy()
+    stack[5:8] = [2, 5, 3]                # alloc pops stack[top-1] first
+    cache2 = dict(cache, layers=wiped,
+                  free_stack=jnp.asarray(stack),
+                  free_top=jnp.asarray(8, jnp.int32))
+    cache2 = kv_pool.promote_pages(
+        cache2, pages, jnp.asarray(3, jnp.int32),
+        [{k: jnp.asarray(a) for k, a in lc.items()} for lc in host])
+    assert int(cache2["free_top"]) == 5
+    for lc0, lc2 in zip(cache["layers"], cache2["layers"]):
+        for name in lc0:
+            np.testing.assert_array_equal(
+                np.asarray(lc0[name][pages[:3]]),
+                np.asarray(lc2[name][pages[:3]]), err_msg=name)
+
+
+# --- engine tier -------------------------------------------------------------
+
+
+def test_churned_hits_promote_not_reprefill(rng):
+    """THE acceptance bar: at a pool size where round-robin tenants thrash
+    the radix cache, the tier turns every churned re-prefill into a
+    promote — strictly more prefix hits than tier-off, matching the
+    all-HBM pool's hit count, token-identical outputs across all three,
+    and zero device pages leaked after the churn."""
+    cfg, model, v = _model()
+    reqs = _churn_reqs(rng, cfg)
+    kw = dict(num_slots=1, page_size=PS, prefix_cache=True)
+
+    e_tier = PagedDecodeEngine(model, v, num_pages=8,
+                               host_tier_bytes=1 << 24, **kw)
+    e_off = PagedDecodeEngine(model, v, num_pages=8, **kw)
+    e_big = PagedDecodeEngine(model, v, num_pages=64, **kw)
+    (o_t, st), (o_o, so), (o_b, sb) = (_run_seq(e, reqs)
+                                       for e in (e_tier, e_off, e_big))
+
+    for a, b, c in zip(o_t, o_o, o_b):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+    assert st["prefix_hits"] > so["prefix_hits"]
+    assert st["prefix_hits"] == sb["prefix_hits"]
+    assert st["prefill_tokens_skipped"] > so["prefill_tokens_skipped"]
+
+    ht = e_tier.host_tier.stats()
+    assert ht["host_tier_demotes"] > 0 and ht["host_tier_promotes"] > 0
+    assert ht["host_tier_promote_hit_rate"] > 0
+    assert e_off.host_tier is None
+    _assert_no_leak(e_tier)
+    _assert_no_leak(e_off)
+
+
+def test_preempt_spill_demotes_then_resume_promotes(rng):
+    """Preemption under POOL pressure: the high-priority admission evicts
+    the victim's freshly spilled refcount-0 pages, which now DEMOTE; the
+    resume finds them host-resident and promotes instead of re-prefilling
+    — and every request stays token-identical to its lock-step run."""
+    cfg, model, v = _model()
+    low = [Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                       ).astype(np.int32),
+                   max_new_tokens=12, priority=0) for _ in range(2)]
+    hi = Request(prompt=rng.integers(0, cfg.vocab_size, (24,)
+                                     ).astype(np.int32),
+                 max_new_tokens=8, priority=5)
+    engine = PagedDecodeEngine(model, v, num_slots=2, page_size=PS,
+                               num_pages=12, prefix_cache=True,
+                               host_tier_bytes=1 << 24)
+    fe = ServingFrontend(
+        engine, policy=PriorityDeadlinePolicy(preempt_on_priority=True))
+    handles = [fe.submit(r, request_id=i) for i, r in enumerate(low)]
+    while fe.queue_depth:
+        fe.pump()
+    for _ in range(3):
+        fe.pump()
+    handles.append(fe.submit(hi, request_id=len(low)))
+    fe.drain()
+
+    stats = fe.stats()
+    assert stats["preemptions"] >= 1 and stats["resumes"] >= 1
+    assert stats["host_tier_demotes"] > 0
+    assert stats["host_tier_promotes"] > 0
+    for h, req in zip(handles, low + [hi]):
+        np.testing.assert_array_equal(h.result(), _lockstep(model, v, req))
+    _assert_no_leak(engine)
+
+
+def test_defrag_composes_with_resident_tier(rng):
+    """The tier keys pages by TOKEN PATHS, so a defrag between demote and
+    promote has nothing to remap: demote a header, leak the free stack so
+    the next admission must defrag, and the follow-up hit still promotes
+    into (compaction-renamed) fresh pages token-identically."""
+    cfg, model, v = _model()
+    sys_p = rng.integers(0, cfg.vocab_size, (2 * PS,)).astype(np.int32)
+    engine = PagedDecodeEngine(model, v, num_slots=1, page_size=PS,
+                               num_pages=10, prefix_cache=True,
+                               host_tier_bytes=1 << 24)
+
+    def _hdr_req(tail_len, max_new):
+        tail = rng.integers(0, 100, (tail_len,)).astype(np.int32)
+        return Request(prompt=np.concatenate([sys_p, tail]),
+                       max_new_tokens=max_new)
+
+    def _fat_req():
+        return Request(prompt=rng.integers(0, cfg.vocab_size,
+                                           (8 * PS,)).astype(np.int32),
+                       max_new_tokens=4)
+
+    engine.run([_hdr_req(4, 4)])          # seed: 2 header pages cached
+    engine.run([_fat_req()])              # 9 pages: evicts+demotes header
+    assert engine.host_tier.stats()["host_tier_demotes"] >= 2
+
+    # leak a free page, then another fat admission: eviction demotes the
+    # previous fat's cached pages but stays one short -> defrag recovers
+    # the leaked page at the sync boundary, tier entries untouched
+    engine.cache["free_top"] = engine.cache["free_top"] - 1
+    (out_y,), stats = engine.run([(req_y := _fat_req())])
+    np.testing.assert_array_equal(out_y, _lockstep(model, v, req_y))
+    assert stats["defrag_runs"] >= 1
+
+    # the post-defrag hit still promotes the header, token-identically —
+    # the tier keys by tokens, so compaction renamed nothing it holds
+    req = _hdr_req(5, 4)
+    (out,), _ = engine.run([req])
+    np.testing.assert_array_equal(out, _lockstep(model, v, req))
+    assert engine.host_tier.stats()["host_tier_promotes"] >= 2
+    _assert_no_leak(engine)
+
+
+def test_quantized_pool_demote_is_lossless(rng):
+    """int8 pool: pages demote as raw int8 bytes + their f32 scales and
+    promote without requantizing, so the tiered engine is token-identical
+    to the all-HBM int8 engine (same match depths, same stored bytes —
+    the structural identity a lossy demote could not give)."""
+    cfg, model, v = _model()
+    reqs = _churn_reqs(rng, cfg)
+    kw = dict(num_slots=1, page_size=PS, prefix_cache=True,
+              kv_dtype="int8")
+    e_tier = PagedDecodeEngine(model, v, num_pages=8,
+                               host_tier_bytes=1 << 24, **kw)
+    e_big = PagedDecodeEngine(model, v, num_pages=64, **kw)
+    for a, b in zip(_run_seq(e_tier, reqs)[0], _run_seq(e_big, reqs)[0]):
+        np.testing.assert_array_equal(a, b)
+    ht = e_tier.host_tier.stats()
+    assert ht["host_tier_promotes"] > 0
+    # the resident payloads really are quantized: int8 page bytes + f32
+    # scales, not dequantized fp copies
+    payload = next(iter(e_tier.host_tier._entries.values()))[0]
+    assert payload[0]["k_pages"].dtype == np.int8
+    assert payload[0]["k_scales"].dtype == np.float32
+    _assert_no_leak(e_tier)
+
+
+def test_tp2_tier_token_identity(rng):
+    """TP=2 with the tier on: each chip demotes its kv-head shard through
+    the same shard_map'd gather, and outputs stay token-identical to the
+    single-chip tiered engine (which is itself churn-verified above)."""
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 devices")
+    from apex_tpu.serving.tp import (TensorParallelPagedEngine,
+                                     shard_model_variables, tp_mesh)
+    cfg, model, v = _model()
+    cfg2 = gpt_tiny_config(tensor_parallel_size=2)
+    m2 = GPTModel(cfg2)
+    mesh = tp_mesh(2)
+    v2, _ = shard_model_variables(m2, v, mesh)
+    reqs = _churn_reqs(rng, cfg, n=6)
+    kw = dict(num_slots=1, page_size=PS, num_pages=8, prefix_cache=True,
+              host_tier_bytes=1 << 24)
+    e_tp = TensorParallelPagedEngine(m2, v2, mesh=mesh, **kw)
+    e_1 = PagedDecodeEngine(model, v, **kw)
+    for a, b in zip(_run_seq(e_tp, reqs)[0], _run_seq(e_1, reqs)[0]):
+        np.testing.assert_array_equal(a, b)
+    assert e_tp.host_tier.stats()["host_tier_promotes"] > 0
+    _assert_no_leak(e_tp)

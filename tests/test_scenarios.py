@@ -547,6 +547,60 @@ def test_ledger_extracts_router_fields(tmp_path):
     assert not ledger_check(dict(m), entries)
 
 
+def test_host_tier_churn_scenario_beats_tier_off():
+    """ISSUE 17 acceptance: at the eviction-churn pool size the host
+    spill tier turns churned re-prefills into promotes — the report's
+    host_tier block banks a STRICTLY positive tier-on-vs-off hit-rate
+    delta on the same trace, with the identity amplifiers green (the
+    tier changed nothing about WHAT was generated, only how its K/V
+    came back)."""
+    r = run_scenario(scenario_spec("host-tier-churn", seed=0),
+                     check=True)
+    ht = r.report["host_tier"]
+    assert ht["demotes"] > 0 and ht["promotes"] > 0
+    assert ht["tier_on_hit_rate"] > ht["tier_off_hit_rate"]
+    assert ht["tier_delta_hit_rate"] == pytest.approx(
+        ht["tier_on_hit_rate"] - ht["tier_off_hit_rate"], abs=1e-3)
+    assert ht["promote_hit_rate"] > 0
+    assert r.report["checks"]["scheduling_invariance"] is True
+
+
+def test_ledger_extracts_host_tier_fields(tmp_path):
+    """A scenarios/v1 document with a host_tier block yields the
+    band-gated scenario.<name>.tier_*_hit_rate / promote_hit_rate
+    metrics (all end in hit_rate: absolute rate band, higher-better)."""
+    import json as json_mod
+
+    from apex_tpu.obs.ledger import bench_metrics_from_file
+
+    doc = {"schema": "apex-tpu/scenarios/v1", "seed": 0,
+           "scenarios": {"host-tier-churn": {
+               "aggregate": {"ttft_ms_p95": 9.0},
+               "host_tier": {"tier_on_hit_rate": 0.75,
+                             "tier_off_hit_rate": 0.625,
+                             "tier_delta_hit_rate": 0.125,
+                             "promote_hit_rate": 0.33,
+                             "demotes": 42, "promotes": 16}}}}
+    path = tmp_path / "SCENARIOS_test.json"
+    path.write_text(json_mod.dumps(doc))
+    m, _ = bench_metrics_from_file(path)
+    assert m["scenario.host-tier-churn.tier_on_hit_rate"] == 0.75
+    assert m["scenario.host-tier-churn.tier_off_hit_rate"] == 0.625
+    assert m["scenario.host-tier-churn.tier_delta_hit_rate"] \
+        == pytest.approx(0.125)
+    assert m["scenario.host-tier-churn.promote_hit_rate"] \
+        == pytest.approx(0.33)
+
+    # a tier-delta collapse gates as a regression (higher-better rate)
+    from apex_tpu.obs.ledger import check as ledger_check
+    entries = [{"metrics": m, "tag": "base", "git_rev": "x"}]
+    worse = dict(m)
+    worse["scenario.host-tier-churn.tier_on_hit_rate"] = 0.3
+    regs = ledger_check(worse, entries)
+    assert any("tier_on_hit_rate" in r.metric for r in regs)
+    assert not ledger_check(dict(m), entries)
+
+
 # --- CLI + ledger integration ------------------------------------------------
 
 

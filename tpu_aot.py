@@ -437,6 +437,24 @@ def kernel_cases():
            [_sds((8, 768), bf16), _sds((768, 384), jnp.uint8),
             _sds((6, 768), f32)])
 
+    # -- tiered KV pool (ISSUE 17): the demote-side page gather (pure
+    # read — cache NOT donated) and the promote-side scatter (cache
+    # donated, pops the free stack like an allocation). Both are plain
+    # XLA data movers by design — no Mosaic kernel, a fixed null-padded
+    # HOST_COPY_CHUNK page row, depth as a traced scalar — so the pin
+    # is the inverse of the others: zero tpu_custom_call sites, and no
+    # giant-copy flags (a relayout sneaking into the copy path would be
+    # pure overhead on the host-link DMA).
+    chunk_row = _sds((_kv_pool.HOST_COPY_CHUNK,), i32)
+    tiles_abs = jax.eval_shape(_kv_pool.gather_pages, pcache_abs,
+                               chunk_row)
+
+    yield ("gpt2s_host_tier_gather", _kv_pool.gather_pages,
+           [pcache_abs, chunk_row])
+
+    yield ("gpt2s_host_tier_promote", _kv_pool.promote_pages,
+           [pcache_abs, chunk_row, _sds((), i32), tiles_abs], (0,))
+
 
 def tight_headdim_cases():
     """The compile half of the tight-head-dim gate (VERDICT r4 next #3):

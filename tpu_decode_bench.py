@@ -64,6 +64,15 @@ with the radix-cache hit rate and prefill-tokens-skipped counters next to
 the total. The smoke run asserts the reduction: every request past the
 first concurrent wave must skip the full shared-header prefill.
 
+After it: the TIERED KV POOL (docs/serving.md "Tiered KV pool") — the
+catalogued ``host-tier-churn`` workload (more cacheable header pages
+than the thrash-sized pool holds) through the engine with
+``host_tier_bytes`` set, emitting
+{"metric": "gpt2_host_tier_decode_tokens_per_sec_per_chip", ...} with
+the demote/promote counters and promote-hit rate next to the total. The
+smoke run asserts promotes > 0, strictly more prefix hits than the
+tier-off engine at the same pool, and token identity vs tier-off.
+
 Fourth line: the ASYNC FRONT-END (docs/frontend.md) — an open-loop
 Poisson arrival stream with mixed priorities and TTFT deadlines through
 ``ServingFrontend``, closed by an adversarial burst that forces the
@@ -532,6 +541,86 @@ def main():
         "device": dev.device_kind, "platform": dev.platform,
     }
     print(json.dumps(pc_rec), flush=True)
+
+    # --- tiered (host-RAM spill) KV pool serving metric ---------------------
+    # the catalogued ``host-tier-churn`` workload (docs/serving.md
+    # "Tiered KV pool"): more cacheable header pages than the pool
+    # holds, so tier-off every revisited header re-prefills while
+    # tier-on demotes it to host RAM and promotes it back over the host
+    # link. The smoke run asserts promotes actually fired AND that the
+    # tier changed no output token vs the tier-off engine at the same
+    # thrash-sized pool.
+    from apex_tpu.serving.scenarios.tenants import churn_tenants
+
+    if smoke:
+        ht_spec = scenario_spec("host-tier-churn", seed=3)
+    else:
+        ht_base = scenario_spec("host-tier-churn", seed=3)
+        ht_spec = _dc.replace(
+            ht_base, n_requests=3 * batch,
+            output_lens=Lengths(kind="uniform", lo=16, hi=64),
+            tenants=churn_tenants(8, 4, 16),
+            engine=_dc.replace(ht_base.engine, model="gpt2-small",
+                               num_slots=num_slots, page_size=16,
+                               num_pages=24, host_tier_bytes=1 << 30))
+    ht_es = ht_spec.engine
+    ht_trace = materialize(ht_spec)
+    ht_requests = trace_requests(ht_trace)
+    n_ht = len(ht_requests)
+
+    ht_engine = PagedDecodeEngine(model, v, num_slots=ht_es.num_slots,
+                                  page_size=ht_es.page_size,
+                                  num_pages=ht_es.num_pages,
+                                  prefix_cache=True,
+                                  host_tier_bytes=ht_es.host_tier_bytes)
+    ht_engine.run(ht_requests)          # compile + populate tier
+    t0 = time.perf_counter()
+    ht_outs, ht_stats = ht_engine.run(ht_requests)
+    ht_elapsed = time.perf_counter() - t0
+    ht_tokens = int(sum(o.shape[0] for o in ht_outs))
+    tier = ht_engine.host_tier.stats()
+    if smoke:
+        if tier["host_tier_promotes"] < 1:
+            raise SystemExit(
+                "host tier regressed: the churn workload never promoted "
+                f"a demoted page ({tier})")
+        off_engine = PagedDecodeEngine(model, v,
+                                       num_slots=ht_es.num_slots,
+                                       page_size=ht_es.page_size,
+                                       num_pages=ht_es.num_pages,
+                                       prefix_cache=True)
+        off_engine.run(ht_requests)
+        off_outs, off_stats = off_engine.run(ht_requests)
+        for i, (a, b) in enumerate(zip(ht_outs, off_outs)):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise SystemExit(
+                    f"host tier regressed: request {i} diverged from the "
+                    "tier-off engine (promote must be bit-stable)")
+        if ht_stats["prefix_hits"] <= off_stats["prefix_hits"]:
+            raise SystemExit(
+                f"host tier regressed: {ht_stats['prefix_hits']} hits "
+                f"tier-on <= {off_stats['prefix_hits']} tier-off on the "
+                "churn workload")
+    ht_rec = {
+        "metric": "gpt2_host_tier_decode_tokens_per_sec_per_chip",
+        "value": round(ht_tokens / max(ht_elapsed, 1e-9), 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,  # no reference analog (apex ships no inference)
+        "requests": n_ht, "num_slots": ht_es.num_slots,
+        "page_size": ht_es.page_size, "num_pages": ht_es.num_pages,
+        "host_tier_budget_bytes": ht_es.host_tier_bytes,
+        "generated_tokens": ht_tokens,
+        # lifetime tier counters (both runs): the churn evidence
+        "host_tier_demotes": tier["host_tier_demotes"],
+        "host_tier_promotes": tier["host_tier_promotes"],
+        "host_tier_promote_hit_rate":
+            round(tier["host_tier_promote_hit_rate"], 3),
+        "host_tier_resident_bytes": tier["host_tier_resident_bytes"],
+        **{k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in ht_stats.items()},
+        "device": dev.device_kind, "platform": dev.platform,
+    }
+    print(json.dumps(ht_rec), flush=True)
 
     # --- open-loop async frontend workload (Poisson arrivals) ---------------
     # the serving FRONT-END under an open arrival stream (docs/frontend.md):
