@@ -173,10 +173,11 @@ def analyze_paths(paths: Sequence[str] = (), *,
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="apex-tpu-lint",
-        description="AST + jaxpr-IR + host-concurrency static analysis "
-                    "for jit/Pallas/serving hazards (three tiers: "
-                    "source, staged jaxprs, and the host threading/"
-                    "lock/resource discipline of the serving stack)")
+        description="AST + jaxpr-IR + host-concurrency + memory-budget "
+                    "static analysis for jit/Pallas/serving hazards "
+                    "(four tiers: source, staged jaxprs, the host "
+                    "threading/lock/resource discipline of the serving "
+                    "stack, and per-chip HBM/VMEM fit proofs)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: apex_tpu/, "
                         "tpu_*.py, bench*.py under --root)")
@@ -207,12 +208,22 @@ def _build_parser() -> argparse.ArgumentParser:
                         "coloring, lockset/GuardedBy inference, lock-"
                         "order cycles, blocking-under-lock, resource-"
                         "lifecycle pairing over the whole surface")
+    p.add_argument("--mem", action="store_true",
+                   help="run the memory-budget tier instead: trace every "
+                        "registered case (plus the AOT acceptance "
+                        "meshes) on CPU and prove per-chip HBM/VMEM fit "
+                        "at tiled-padded sizes, plus shard_map sharding "
+                        "contracts")
+    p.add_argument("--mem-case", default=None, metavar="NAME",
+                   help="mem tier for ONE registered case (implies "
+                        "--mem)")
     p.add_argument("--diff", default=None, metavar="BASE_REV",
                    help="fail only on findings introduced relative to "
-                        "this git rev (AST module rules + the conc "
-                        "tier; both are source-only, so the base rev "
-                        "is analyzable) — the base rev's findings act "
-                        "as the baseline")
+                        "this git rev. Default: AST module rules + the "
+                        "conc tier (source-only, so the base rev is "
+                        "analyzable from git history). With --mem: the "
+                        "mem tier on both sides — the base side runs in "
+                        "a temporary worktree of the base rev")
     return p
 
 
@@ -334,16 +345,105 @@ def _run_diff(args, root: Path, select) -> int:
     return 1 if new else 0
 
 
+def _mem_base_findings(root: Path, rev: str) -> "Counter":
+    """Baseline-key counts of the mem tier at ``rev``. Unlike the
+    source-only tiers, the mem tier TRACES live programs, so a source
+    snapshot is not enough — the base rev is materialized in a
+    temporary ``git worktree`` and its own ``--mem`` runs there as a
+    subprocess (apex_tpu resolves from the working directory, so the
+    worktree's code analyzes the worktree's cases). A base rev that
+    predates the tier (usage error / unknown flag, exit 2) contributes
+    no findings: everything the new tier reports is new."""
+    import json as _json
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    from collections import Counter
+
+    tmp = Path(tempfile.mkdtemp(prefix="tpu-lint-mem-base-"))
+    wt = tmp / "base"
+    add = subprocess.run(
+        ["git", "-C", str(root), "worktree", "add", "--detach",
+         str(wt), rev], capture_output=True, text=True)
+    if add.returncode != 0:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise ValueError(f"git worktree add {rev} failed: "
+                         f"{add.stderr.strip() or add.stdout.strip()}")
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(wt)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # a baseline path that does not exist at the base rev: the diff
+        # wants the base's RAW findings, not what its checked-in
+        # baseline had already absorbed (render_json reports absorbed
+        # findings too, but raw keeps the two sides symmetric)
+        proc = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.analysis", "--mem",
+             "--format", "json",
+             "--baseline", str(wt / "_mem_diff_no_baseline.json")],
+            cwd=str(wt), env=env, capture_output=True, text=True,
+            timeout=1800)
+        try:
+            data = _json.loads(proc.stdout) \
+                if proc.returncode in (0, 1) else None
+        except ValueError:
+            data = None
+        if data is None:
+            # exit 2 (pre-mem CLI rejects the flag), a crashed import
+            # (the growth seed has no package at all), or junk output:
+            # the tier didn't exist there, so nothing can be absorbed
+            print(f"tpu-lint: base rev {rev} has no working --mem tier "
+                  f"(exit {proc.returncode}); treating every mem "
+                  f"finding as new", file=sys.stderr)
+            return Counter()
+        keys = [f"{f['path']}::{f['rule']}::{f.get('scope', '<module>')}"
+                for f in data.get("findings", [])
+                + data.get("baselined", [])]
+        return Counter(keys)
+    finally:
+        subprocess.run(["git", "-C", str(root), "worktree", "remove",
+                        "--force", str(wt)], capture_output=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_mem_diff(args, root: Path, select) -> int:
+    """``--diff BASE --mem``: the mem tier on both sides, the base
+    side's findings acting as the baseline (same key arithmetic as
+    ``_run_diff``). The base side always runs ALL mem rules — a
+    --select'ed current side still diffs against the full base so a
+    narrowed run cannot misreport pre-existing findings as new."""
+    from apex_tpu.analysis.mem import analyze_mem
+
+    base = Baseline(_mem_base_findings(root, args.diff))
+    findings, suppressed, _ = analyze_mem(root, select=select)
+    new, absorbed = base.split(findings)
+    if args.format == "json":
+        print(report.render_json(new, absorbed, suppressed))
+    else:
+        print(report.render_text(new, absorbed, suppressed,
+                                 show_baselined=args.show_baselined))
+        if new:
+            print(f"tpu-lint: the mem findings above are NEW relative "
+                  f"to {args.diff} ({len(absorbed)} pre-existing "
+                  f"absorbed)")
+    return 1 if new else 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = _build_parser().parse_args(argv)
     if args.ir_case:
         args.ir = True
+    if args.mem_case:
+        args.mem = True
     if args.list_rules:
         from apex_tpu.analysis.conc.conc_rules import CONC_RULES
         from apex_tpu.analysis.ir.ir_rules import IR_RULES
+        from apex_tpu.analysis.mem.mem_rules import MEM_RULES
 
         width = max(len(n) for n in
-                    list(RULES) + list(IR_RULES) + list(CONC_RULES))
+                    list(RULES) + list(IR_RULES) + list(CONC_RULES)
+                    + list(MEM_RULES))
         for name, r in sorted(RULES.items()):
             kind = "project" if r.project else "module"
             print(f"{name:<{width}}  {r.severity:<7} ast:{kind:<7} "
@@ -354,6 +454,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for name, r in sorted(CONC_RULES.items()):
             print(f"{name:<{width}}  {r.severity:<7} conc:host   "
                   f"{r.summary}")
+        for name, r in sorted(MEM_RULES.items()):
+            print(f"{name:<{width}}  {r.severity:<7} mem:budget  "
+                  f"{r.summary}")
         return 0
 
     root = Path(args.root)
@@ -362,9 +465,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
-    if args.ir and args.conc:
-        print("error: --ir and --conc are separate tiers; run them "
-              "in separate invocations", file=sys.stderr)
+    if sum((args.ir, args.conc, args.mem)) > 1:
+        print("error: --ir, --conc and --mem are separate tiers; run "
+              "them in separate invocations", file=sys.stderr)
         return 2
     if args.diff is not None:
         if args.ir:
@@ -391,6 +494,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "the explicit paths", file=sys.stderr)
             return 2
         try:
+            if args.mem:
+                from apex_tpu.analysis.mem.mem_rules import MEM_RULES
+
+                if select:
+                    unknown = set(select) - set(MEM_RULES)
+                    if unknown:
+                        raise ValueError("unknown mem rule(s): "
+                                         + ", ".join(sorted(unknown)))
+                return _run_mem_diff(args, root, select)
             if select:
                 from apex_tpu.analysis.conc.conc_rules import CONC_RULES
 
@@ -423,6 +535,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from apex_tpu.analysis.conc import analyze_conc
 
             findings, suppressed = analyze_conc(root, select=select)
+        elif args.mem:
+            if args.paths:
+                print("error: --mem lints registered entry points, not "
+                      "paths (use --mem-case NAME to narrow)",
+                      file=sys.stderr)
+                return 2
+            from apex_tpu.analysis.mem import analyze_mem
+
+            findings, suppressed, _ = analyze_mem(
+                root, select=select, case=args.mem_case)
         else:
             findings, suppressed = analyze_paths(
                 args.paths, root=root, select=select)
@@ -450,7 +572,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # other: a write from one tier keeps every other tier's entries
         # (tier membership comes from the rule-namespace registry in
         # analysis/tiers.py, not per-tier string checks)
-        active = "ir" if args.ir else "conc" if args.conc else "ast"
+        active = "ir" if args.ir else "conc" if args.conc \
+            else "mem" if args.mem else "ast"
         keep = {k: v for k, v in existing.counts.items()
                 if tier_of_key(k) != active}
         if args.ir and args.ir_case:
@@ -460,6 +583,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 {k: v for k, v in existing.counts.items()
                  if tier_of_key(k) == "ir"
                  and k.split("::")[-1] != args.ir_case})
+        elif args.mem and args.mem_case:
+            keep.update(
+                {k: v for k, v in existing.counts.items()
+                 if tier_of_key(k) == "mem"
+                 and k.split("::")[-1] != args.mem_case})
         elif active == "ast" and args.paths:
             # scoped run: replace entries for the scanned files
             # only, keep the rest of the baseline untouched

@@ -112,6 +112,15 @@ _DOMAIN_PREFIXES = (
 )
 
 
+#: kernel_cases() names that analysis_cases() re-registers with a richer
+#: CaseProgram (variants / max_traces); _aot_cases skips them so each
+#: name appears exactly once in the registry
+_RICHER_REGISTRATIONS = frozenset({
+    "gpt2s_host_tier_gather",
+    "gpt2s_host_tier_promote",
+})
+
+
 def _domain_for(name: str) -> str:
     for prefix, domain in _DOMAIN_PREFIXES:
         if name.startswith(prefix):
@@ -134,6 +143,12 @@ def _aot_cases(root: Path) -> List[AnalysisCase]:
     out: List[AnalysisCase] = []
     for case in cases:
         name, fn, args = case[0], case[1], tuple(case[2])
+        if name in _RICHER_REGISTRATIONS:
+            # analysis_cases() appends these by hand with variants and a
+            # max_traces pin (the compile-key-cardinality probe) that the
+            # bare AOT tuple can't carry — one registration per name, the
+            # richer one wins
+            continue
         donate = tuple(case[3]) if len(case) > 3 else ()
 
         def build(fn=fn, args=args, donate=donate) -> CaseProgram:
@@ -637,8 +652,14 @@ def _build_tp_engine_program(kind: str, kv_dtype=None,
             sharded += _bytes(leaf)
         else:
             repl += _bytes(leaf)
+    # the declared sharding contract: the mem tier's spec rules
+    # (mem-spec-indivisible & co.) check these against the mesh before
+    # shard_map ever traces, and its HBM sweep scopes to per-chip bytes
+    from jax.sharding import PartitionSpec as P
+
     meta = {"tp": tp, "sharded_weight_bytes": sharded,
-            "replicated_weight_bytes": repl}
+            "replicated_weight_bytes": repl,
+            "mesh_axes": {"model": tp}}
     i32 = jnp.int32
     if kind == "decode":
         args = (engine.cache, dvars,
@@ -647,8 +668,12 @@ def _build_tp_engine_program(kind: str, kv_dtype=None,
                 jax.ShapeDtypeStruct((4,), i32),           # n_left
                 jax.ShapeDtypeStruct((4, 2), jnp.uint32),  # req_keys
                 jax.ShapeDtypeStruct((4,), i32))           # samp_i
+        meta["arg_specs"] = (engine._cache_specs, var_specs,
+                             P(), P(), P(), P(), P())
         return CaseProgram(fn=engine._step_fn(), args=args, meta=meta)
     assert kind == "admit"
+    meta["arg_specs"] = (engine._cache_specs, var_specs,
+                         P(), P(), P(), P(), P(), P())
 
     def args_for(s0: int) -> tuple:
         bucket = prompt_bucket(s0, engine.page_size,

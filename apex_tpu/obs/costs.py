@@ -34,6 +34,11 @@ being revision-stable, not on them being cycle-accurate):
 - peak live bytes: a liveness sweep over the top-level equation list
   (inner-jaxpr scratch is not modeled — pool/weight residency dominates
   every program here).
+- all bytes are LOGICAL (what the program streams), not tiled-padded
+  (what arrays occupy on chip). The padding math lives once, in
+  ``apex_tpu/analysis/mem/layout.py``; the mem lint tier prices the
+  padded side for HBM-fit proofs, and reports here carry a note when
+  the two diverge materially.
 
 ``python -m apex_tpu.obs.costs`` emits the report (text, or ``--json``)
 covering EVERY registered case, including the decode chunk's
@@ -411,9 +416,34 @@ def cost_of_jaxpr(closed, profile: ChipProfile, *,
                   root: Optional[Path] = None, name: str = "<program>",
                   domain: str = "ops", top_k: int = 5) -> CaseCost:
     """Price one ClosedJaxpr against ``profile``. ``root`` enables
-    source-line attribution (anchors resolved like IR lint findings)."""
+    source-line attribution (anchors resolved like IR lint findings).
+
+    All byte counts here are LOGICAL — the bytes the program streams,
+    which is what bandwidth/roofline math wants. On chip, arrays occupy
+    their TPU tiled-layout PADDED size (minor dim to 128 lanes, second-
+    minor to the dtype's sublane multiple); when that gap is material
+    for the program's boundary arrays, a note says so and points at the
+    mem lint tier, which prices the padded side (HBM *fit*, not
+    bandwidth — ``apex_tpu/analysis/mem/layout.py`` is the one place
+    the padding math lives)."""
+    from apex_tpu.analysis.mem.layout import (aval_logical_bytes,
+                                              aval_padded_bytes)
+
     w = _Walk(root)
     w.walk(closed.jaxpr)
+    b_logical = b_padded = 0
+    for v in list(closed.jaxpr.invars) + list(closed.jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            b_logical += aval_logical_bytes(aval)
+            b_padded += aval_padded_bytes(aval)
+    if b_logical and b_padded >= 1.25 * b_logical:
+        w.notes.append(
+            f"tiled layout: boundary arrays occupy "
+            f"{b_padded / GIB:.3f} GiB on chip vs {b_logical / GIB:.3f} "
+            f"GiB logical ({b_padded / b_logical:.2f}x) — bytes here "
+            f"price the logical stream; the mem lint tier prices the "
+            f"padded residency")
     flops = sum(l.flops for l in w.leaves.values())
     nbytes = sum(l.bytes for l in w.leaves.values())
     flop_t = sum(l.flops / profile.peak_flops(l.dtype_key)

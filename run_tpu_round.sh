@@ -40,6 +40,20 @@ if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --conc; then
   echo "  hazards; fix or suppress with justification (docs/static_analysis.md)"
   exit 1
 fi
+# Memory tier: trace the same registry (plus the AOT acceptance meshes)
+# on CPU and prove every program FITS — per-chip padded-liveness peak vs
+# its declared HBM budget (scan-carry double-buffering priced in), every
+# pallas_call's VMEM residency vs the 16 MiB scoped budget, and the
+# sharding contracts (indivisible specs, collective-free replicated
+# outputs, donation/spec aliasing, scale/weight shard drift). The PR 10
+# d=64 padding OOM and the PR 14 VMEM overflow both die here now, on
+# the CI box, before a tunnel window sees the compile.
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate (mem tier)..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --mem; then
+  echo "[$(date +%H:%M:%S)] tpu-lint --mem found memory-budget/sharding"
+  echo "  hazards; fix or suppress with justification (docs/static_analysis.md)"
+  exit 1
+fi
 # diff-aware gate: when CI exports LINT_DIFF_BASE (e.g. the PR merge
 # base), ALSO fail on AST + conc findings introduced relative to it —
 # catches regressions even if someone grows the baseline file in the
@@ -48,6 +62,14 @@ if [ -n "${LINT_DIFF_BASE:-}" ]; then
   echo "[$(date +%H:%M:%S)] tpu-lint diff gate vs ${LINT_DIFF_BASE}..."
   if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --diff "$LINT_DIFF_BASE"; then
     echo "[$(date +%H:%M:%S)] tpu-lint: findings introduced since ${LINT_DIFF_BASE}"
+    exit 1
+  fi
+  # the mem tier diffs too — its base side runs in a throwaway worktree
+  # (traced programs need real code, not git blobs); a base rev that
+  # predates the tier counts every mem finding as new
+  echo "[$(date +%H:%M:%S)] tpu-lint mem diff gate vs ${LINT_DIFF_BASE}..."
+  if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --diff "$LINT_DIFF_BASE" --mem; then
+    echo "[$(date +%H:%M:%S)] tpu-lint: mem findings introduced since ${LINT_DIFF_BASE}"
     exit 1
   fi
 fi

@@ -642,6 +642,12 @@ MULTICHIP_CASE_NAMES = (
 #: decode chunk's lax.scan DOUBLE-BUFFERS the pool carry in XLA, so a
 #: chip needs ~2x its pool shard transient — which is why 18 GiB
 #: shards over four chips, not two (2 x 9 GiB + weights > 16 GiB).
+#: Both lessons are now lint rules (mem-padding-blowup and
+#: mem-scan-carry-double-buffer, `python -m apex_tpu.analysis --mem`):
+#: the next pool that repeats either mistake dies in the CPU-only mem
+#: gate, and tests/test_aot_mosaic.py pins the lint tier's static
+#: per-chip peaks within +/-20% of this sweep's memory_analysis() so
+#: the two accountings cannot silently drift apart.
 TP_SERVING_SLOTS = 384
 TP_SERVING_PAGE_SIZE = 32
 TP_SERVING_MAX_PAGES_PER_SEQ = 32
