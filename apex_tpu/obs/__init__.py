@@ -25,16 +25,32 @@ Performance attribution (PR 8) adds three more, CLI-first:
   frontend's recompile-storm warning built on top.
 - ``ledger`` — the persistent perf ledger + regression gate
   (``python -m apex_tpu.obs.ledger --check``, ``PERF_LEDGER.jsonl``).
+
+The fleet plane (``fleet``, docs/observability.md "Fleet plane") spans
+processes: process-independent trace ids stitched across replica
+failovers, router-side metrics federation (:class:`FleetCollector`),
+multi-window SLO burn-rate alerting (:class:`BurnRateAlerter`), and
+the schema-pinned postmortem flight recorder
+(:func:`build_flight` / :func:`validate_flight`).
 """
 
 from apex_tpu.obs.compile_watch import CompileWatcher, watcher
 from apex_tpu.obs.events import EventLog
-from apex_tpu.obs.export import (health_doc, json_snapshot, latest_costs,
-                                 prometheus_text, publish_costs, serve,
-                                 write_snapshot)
+from apex_tpu.obs.export import (describe, health_doc, json_snapshot,
+                                 latest_costs, prometheus_text,
+                                 publish_costs, serve, write_snapshot)
+from apex_tpu.obs.fleet import (FLIGHT_SCHEMA, BurnRateAlerter,
+                                FleetCollector, build_flight,
+                                mint_trace_id, parse_traceparent,
+                                row_from_snapshot, stitch_traces,
+                                traceparent, validate_flight)
 from apex_tpu.obs.spans import PHASES, Span, SpanTracer
 
-__all__ = ["CompileWatcher", "EventLog", "PHASES", "Span", "SpanTracer",
-           "health_doc", "json_snapshot", "latest_costs",
-           "prometheus_text", "publish_costs", "serve", "watcher",
+__all__ = ["BurnRateAlerter", "CompileWatcher", "EventLog",
+           "FLIGHT_SCHEMA", "FleetCollector", "PHASES", "Span",
+           "SpanTracer", "build_flight", "describe", "health_doc",
+           "json_snapshot", "latest_costs", "mint_trace_id",
+           "parse_traceparent", "prometheus_text", "publish_costs",
+           "row_from_snapshot", "serve", "stitch_traces",
+           "traceparent", "validate_flight", "watcher",
            "write_snapshot"]

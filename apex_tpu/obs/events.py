@@ -12,6 +12,12 @@ Events carry a monotonically increasing ``seq`` (gap-free — a reader can
 detect drops between two dumps) and a wall-clock ``t`` (``time.time``)
 for correlation with external logs; the injectable ``clock`` makes tests
 deterministic.
+
+Incremental reads (the fleet-federation scrape, ``/events?since_seq=``
+on the serving HTTP port): :meth:`EventLog.since` returns only the
+events past a caller-held cursor plus the count the ring dropped past
+it — a scraper re-ships nothing and still *knows* when it lost events
+to a lap. :meth:`EventLog.dump` takes the same ``since_seq`` cursor.
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ import io
 import json
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 __all__ = ["EventLog"]
 
@@ -75,16 +81,43 @@ class EventLog:
             events = events[-n:]
         return [dict(e) for e in events]
 
-    def dump(self, path: Optional[str] = None) -> str:
+    def since(self, seq: int) -> Tuple[List[dict], int]:
+        """Incremental read past a cursor: ``(events, dropped)`` where
+        ``events`` are the retained events with ``seq > seq`` (oldest
+        first, copies) and ``dropped`` counts the events emitted after
+        the cursor that the ring already pushed out — a non-zero value
+        means the scraper's view has a gap it cannot recover. A cursor
+        of ``-1`` reads from the beginning."""
+        with self._lock:
+            events = [dict(e) for e in self._buf if e["seq"] > seq]
+            emitted_after = max(self._seq - (seq + 1), 0)
+            dropped = emitted_after - len(events)
+        return events, dropped
+
+    def dump(self, path: Optional[str] = None, *,
+             since_seq: Optional[int] = None) -> str:
         """Serialize the retained events as JSONL (one event per line,
         oldest first), preceded by a header line with total/dropped
         counts. Writes to ``path`` when given; always returns the text —
-        the postmortem artifact docs/observability.md walks through."""
-        with self._lock:
-            events = list(self._buf)
-            header = {"kind": "event_log_header", "capacity": self.capacity,
-                      "total": self._seq,
-                      "dropped": self._seq - len(events)}
+        the postmortem artifact docs/observability.md walks through.
+
+        With ``since_seq``, only events past that cursor are emitted and
+        the header grows ``since_seq`` plus a cursor-relative ``dropped``
+        (events the ring lapped past the cursor — the gap-detection
+        contract federation scrapes rely on). The default header shape
+        (no cursor) is pinned byte-for-byte by the wraparound test."""
+        if since_seq is not None:
+            events, dropped = self.since(since_seq)
+            with self._lock:
+                header = {"kind": "event_log_header",
+                          "capacity": self.capacity, "total": self._seq,
+                          "dropped": dropped, "since_seq": since_seq}
+        else:
+            with self._lock:
+                events = [dict(e) for e in self._buf]
+                header = {"kind": "event_log_header",
+                          "capacity": self.capacity, "total": self._seq,
+                          "dropped": self._seq - len(events)}
         out = io.StringIO()
         out.write(json.dumps(header) + "\n")
         for e in events:

@@ -35,9 +35,56 @@ from typing import Dict, Optional
 from apex_tpu.utils import metrics
 
 __all__ = ["prometheus_text", "json_snapshot", "write_snapshot", "serve",
-           "publish_costs", "latest_costs", "health_doc"]
+           "publish_costs", "latest_costs", "health_doc", "describe"]
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# per-instrument description registry: `# HELP` text per metric family
+# (registry names — sanitized on emit). Seeded with the core serving
+# families; describe() registers more. Families without an entry get a
+# generated default so every TYPE line still carries a HELP line (the
+# exposition-parse test pins the pairing).
+_HELP_LOCK = threading.Lock()
+_HELP: Dict[str, str] = {
+    "serving.ttft_ms": "Time to first token per request (ms).",
+    "serving.tpot_ms": "Steady-state time per output token (ms).",
+    "serving.queue_wait_ms": "Enqueue-to-admit wait per request (ms).",
+    "serving.decode_step_ms": "Wall time per batched decode step (ms).",
+    "serving.queue_depth": "Requests waiting for admission.",
+    "serving.slots_in_use": "Decode slots currently occupied.",
+    "serving.slo_burn": "SLO miss rate over the rolling retirement "
+                        "window.",
+    "serving.admitted": "Requests admitted to decode slots.",
+    "serving.retired": "Requests retired (complete/cancelled/failed).",
+    "router.replicas_alive": "Live replicas behind the router.",
+    "router.replica_queue_depth": "Queue depth per routed replica.",
+    "fleet.ttft_ms_p95": "Federated per-replica TTFT p95 (ms).",
+    "fleet.tpot_ms_p95": "Federated per-replica TPOT p95 (ms).",
+    "fleet.queue_depth": "Federated per-replica queue depth.",
+    "fleet.slo_burn": "Federated per-replica SLO burn rate.",
+    "fleet.scrape_age_s": "Seconds since the replica's last "
+                          "successful federation scrape.",
+    "kv_pool.free_pages": "Free pages in the device KV pool.",
+    "http.connections": "Open HTTP connections.",
+    "http.streams_active": "Live SSE token streams.",
+}
+
+
+def describe(name: str, help_text: str) -> None:
+    """Register the ``# HELP`` description for a metric family (by
+    registry name, e.g. ``serving.ttft_ms``)."""
+    with _HELP_LOCK:
+        _HELP[name] = " ".join(str(help_text).split())
+
+
+def _help_for(prom_name: str) -> str:
+    """The HELP text for a sanitized family name (falls back to a
+    generated default — HELP/TYPE pairing is unconditional)."""
+    with _HELP_LOCK:
+        for name, text in _HELP.items():
+            if _prom_name(name) == prom_name:
+                return text
+    return f"apex-tpu metric {prom_name}."
 
 
 def _prom_name(name: str) -> str:
@@ -87,9 +134,15 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     def sample(name, labels_str, value):
         lines.append(f"{name}{labels_str} {_fmt(value)}")
 
-    # ONE `# TYPE` line per metric family: all label sets of a name are
-    # samples of the same family (a second TYPE line for a name is
-    # invalid text exposition — two engine-labeled counters hit this)
+    def family(name, prom_type):
+        # ONE `# HELP` + `# TYPE` pair per metric family: all label
+        # sets of a name are samples of the same family (a second TYPE
+        # line for a name is invalid text exposition — two
+        # engine-labeled counters hit this), and every TYPE line is
+        # preceded by its HELP line from the description registry
+        lines.append(f"# HELP {name} {_help_for(name)}")
+        lines.append(f"# TYPE {name} {prom_type}")
+
     for kind, prom_type in (("counters", "counter"), ("gauges", "gauge")):
         seen = set()
         for entry in sorted(snap.get(kind, ()),
@@ -98,7 +151,7 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
             name = _prom_name(entry["name"])
             if name not in seen:
                 seen.add(name)
-                lines.append(f"# TYPE {name} {prom_type}")
+                family(name, prom_type)
             sample(name, _prom_labels(entry["labels"]), entry["value"])
 
     seen = set()
@@ -108,7 +161,7 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
         name = _prom_name(entry["name"])
         if name not in seen:
             seen.add(name)
-            lines.append(f"# TYPE {name} histogram")
+            family(name, "histogram")
         for le, cum in entry["buckets"]:
             le_str = "+Inf" if le is None else format(le, ".6g")
             sample(name + "_bucket",
@@ -131,7 +184,7 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
             continue
         for suffix, value in (("_count", s["count"]), ("_mean", s["mean"]),
                               ("_last", s["last"])):
-            lines.append(f"# TYPE {name}{suffix} gauge")
+            family(name + suffix, "gauge")
             sample(name + suffix, "", value)
 
     return "\n".join(lines) + "\n" if lines else ""
@@ -202,6 +255,13 @@ def health_doc(frontend=None, router=None) -> dict:
             failure=repr(failure) if failure is not None else None)
         doc["ok"] = failure is None
     if router is not None:
+        # fleet-plane staleness (PR 19): liveness is readable from
+        # /healthz alone — supervision-tick age, per-replica failover
+        # counts, and federation scrape age ride along. All three read
+        # through getattr so a router-shaped stub (tests) stays valid.
+        fleet = getattr(router, "fleet", None)
+        ages = fleet.scrape_ages() if fleet is not None else {}
+        tick_age = getattr(router, "last_tick_age_s", None)
         per_replica = []
         for rep in router.replicas:
             per_replica.append({
@@ -214,6 +274,9 @@ def health_doc(frontend=None, router=None) -> dict:
                 else None,
                 "failure": repr(rep.dead_reason)
                 if rep.dead_reason is not None else None,
+                "last_tick_age_s": tick_age,
+                "failovers": getattr(rep, "failovers", 0),
+                "scrape_age_s": ages.get(f"replica{rep.index}"),
             })
         n_alive = sum(1 for r in per_replica if r["alive"])
         doc["router"] = {"replicas": len(per_replica), "alive": n_alive,

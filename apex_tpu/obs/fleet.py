@@ -1,0 +1,753 @@
+"""Fleet observability plane: cross-replica traces, metrics federation,
+SLO burn-rate alerts, and the postmortem flight recorder.
+
+Everything PR 4/8 built — typed instruments, :class:`SpanTracer`
+lifecycles, event rings, ``/metrics`` — is process-local. The replica
+router (serving/router.py) supervises N replicas that may live in OTHER
+processes behind HTTP (serving/http.py), and a request that fails over
+between replicas leaves two disconnected span fragments. This module is
+the layer that re-joins the fleet into one observable system:
+
+- **Trace propagation** — every submit path mints a process-independent
+  ``trace_id`` (:func:`mint_trace_id`, 32 lowercase hex — the W3C
+  ``traceparent`` trace-id field), carried on ``Request.trace_id``, as a
+  ``traceparent`` header on ``POST /v1/generate``, and as a ``trace_id``
+  attr on each replica tracer's ``enqueue`` event.
+  :func:`stitch_traces` merges per-replica span dumps into ONE lifecycle
+  per trace: segment metrics are summed exactly like
+  :meth:`~apex_tpu.obs.spans.SpanTracer.lifecycle` (TTFT anchors at the
+  FIRST replica's first token), and the gap between one replica's last
+  span and the next replica's first is synthesized as a ``failover``
+  preempt/resume segment naming both replicas, counted into
+  ``preempted_ms``.
+- **Metrics federation** — :class:`FleetCollector` scrapes every replica
+  on the router's supervision tick: local replicas read the process
+  registry directly (filtered to the replica engine's labels); remote
+  replicas go through the client's ``fleet_scrape()`` (one
+  ``/metrics.json`` + one incremental ``/events?since_seq=`` GET). Rows
+  are re-derived from the snapshot with the SAME bucket interpolation
+  the in-process :meth:`~apex_tpu.utils.metrics.Histogram.quantile`
+  uses (:func:`row_from_snapshot` — scrape fidelity is by
+  construction), published as ``fleet.*{replica=}`` gauges with a
+  per-replica ``fleet.scrape_age_s`` staleness gauge, and aggregated
+  into the pinned ``fleet`` block (``report.FLEET_FIELDS``).
+- **SLO burn-rate alerting** — :class:`BurnRateAlerter` evaluates the
+  federated ``slo_burn`` series over a fast and a slow window
+  (multi-window burn-rate alerting, Google SRE workbook ch. 5): it
+  fires only when BOTH window means sit at/above the threshold (a
+  transient spike cannot page) and resolves only once the fast window
+  falls under ``threshold * hysteresis`` (no flapping at the
+  boundary), emitting ``fleet.alert`` events — the signal ROADMAP
+  item 2's autoscaler consumes.
+- **Flight recorder** — :func:`build_flight` assembles the correlated
+  postmortem bundle (every replica's event-ring tail, spans stitched by
+  trace_id, instrument snapshot, pool gauges, the router's routing
+  table and counters) under the pinned :data:`FLIGHT_SCHEMA`;
+  :func:`validate_flight` rejects a malformed bundle. The router dumps
+  one on any replica death or supervisor failure and on explicit
+  ``flight_snapshot()``; the chaos CI round banks it as
+  ``FLIGHT_<tag>.json``.
+
+Concurrency (the conc-lint tier pins this): the collector's scrape I/O
+runs with NO lock held — replica targets are snapshotted under the
+router's lock (``router.fleet_targets()``), the scrape happens between
+locks, and only the result merge takes the collector's own ``_lock``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import math
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.obs.events import EventLog
+from apex_tpu.utils import metrics
+
+__all__ = ["BurnRateAlerter", "FLIGHT_SCHEMA", "FleetCollector",
+           "build_flight", "mint_trace_id", "parse_traceparent",
+           "row_from_snapshot", "stitch_traces", "traceparent",
+           "validate_flight"]
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+#: per-process mint sequence (uniqueness within one process even when
+#: the clock stalls)
+_TRACE_SEQ = itertools.count()
+#: per-process salt: two processes minting at the same nanosecond with
+#: the same pid-recycled id still diverge
+_TRACE_SALT = os.urandom(16)
+
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def mint_trace_id() -> str:
+    """A process-independent trace id: 32 lowercase hex chars (the W3C
+    ``traceparent`` trace-id field width). Collision-resistant across
+    processes and restarts — pid, wall-clock nanoseconds, a per-process
+    salt, and a mint sequence all feed the hash."""
+    h = hashlib.sha256()
+    h.update(_TRACE_SALT)
+    h.update(os.getpid().to_bytes(8, "big"))
+    h.update(time.time_ns().to_bytes(16, "big", signed=True))
+    h.update(next(_TRACE_SEQ).to_bytes(8, "big"))
+    return h.hexdigest()[:32]
+
+
+def traceparent(trace_id: str, span_id: str = "0" * 16) -> str:
+    """The ``traceparent`` header value carrying ``trace_id``
+    (version 00, sampled)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value) -> Optional[str]:
+    """The trace id inside a ``traceparent`` header value (or a bare
+    32-hex trace id); None when absent/malformed — a bad header must
+    degrade to a fresh mint, never to a 400."""
+    if not isinstance(value, str):
+        return None
+    value = value.strip().lower()
+    if _TRACE_ID_RE.match(value):
+        return value
+    parts = value.split("-")
+    if len(parts) >= 2 and _TRACE_ID_RE.match(parts[1]):
+        return parts[1]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# trace stitching
+# ---------------------------------------------------------------------------
+
+def _closed_ms(span: dict) -> Optional[float]:
+    return span.get("duration_ms")
+
+
+def _stitch_one(trace_id: str, items: List[Tuple[str, dict]]) -> dict:
+    """One stitched lifecycle from ``(replica, span_dict)`` pairs —
+    the cross-replica mirror of :meth:`SpanTracer.lifecycle`: boundary
+    instants anchor on the FIRST occurrence across the merged timeline,
+    segment spans are summed, and inter-replica gaps become synthesized
+    ``failover`` preempt/resume segments."""
+    items = sorted(items, key=lambda rs: rs[1]["t_start"])
+    by_name: Dict[str, List[dict]] = {}
+    replicas: List[str] = []
+    request_ids: List[object] = []
+    for replica, s in items:
+        by_name.setdefault(s["name"], []).append(s)
+        if replica not in replicas:
+            replicas.append(replica)
+        if s["request_id"] not in request_ids:
+            request_ids.append(s["request_id"])
+
+    def first(name: str) -> Optional[dict]:
+        spans = by_name.get(name)
+        return spans[0] if spans else None
+
+    out: Dict[str, object] = {"trace_id": trace_id, "replicas": replicas,
+                              "request_ids": request_ids,
+                              "spans": len(items)}
+    enq, admit, ftok = first("enqueue"), first("admit"), first("first_token")
+    if enq is not None and admit is not None:
+        out["queue_wait_ms"] = (admit["t_start"] - enq["t_start"]) * 1e3
+    if enq is not None and ftok is not None:
+        out["ttft_ms"] = (ftok["t_start"] - enq["t_start"]) * 1e3
+    prefills = [s for s in by_name.get("prefill", ())
+                if _closed_ms(s) is not None]
+    if prefills:
+        out["prefill_ms"] = sum(_closed_ms(s) for s in prefills)
+        for k in ("cached_tokens", "computed_tokens"):
+            vals = [s["attrs"][k] for s in prefills if k in s["attrs"]]
+            if vals:
+                out[k] = sum(vals)
+    decodes = [s for s in by_name.get("decode", ())
+               if _closed_ms(s) is not None]
+    if decodes:
+        out["decode_ms"] = sum(_closed_ms(s) for s in decodes)
+        n_new = [s["attrs"]["new_tokens"] for s in decodes
+                 if "new_tokens" in s["attrs"]]
+        if n_new:
+            total_new = int(sum(n_new))
+            out["new_tokens"] = total_new
+            out["tpot_ms"] = out["decode_ms"] / max(total_new - 1, 1)
+    preempted = [s for s in by_name.get("preempted", ())
+                 if _closed_ms(s) is not None]
+    preemptions = len(by_name.get("preempted", ()))
+    preempted_ms = sum(_closed_ms(s) for s in preempted)
+    retires = by_name.get("retire")
+    if enq is not None and retires:
+        out["total_ms"] = (retires[-1]["t_start"] - enq["t_start"]) * 1e3
+
+    # per-replica segments (span-extent envelopes), ordered by start,
+    # with the inter-replica handoff gaps synthesized as failover
+    # preempt/resume segments naming BOTH replicas — a failed-over
+    # request's time in limbo is preempted time, exactly like an
+    # in-replica preemption
+    segments = []
+    for replica in replicas:
+        mine = [s for r, s in items if r == replica]
+        start = min(s["t_start"] for s in mine)
+        end = max(s["t_end"] if s["t_end"] is not None else s["t_start"]
+                  for s in mine)
+        segments.append({"replica": replica, "t_start": start,
+                         "t_end": end, "spans": len(mine)})
+    segments.sort(key=lambda seg: seg["t_start"])
+    failovers = []
+    for prev, nxt in zip(segments, segments[1:]):
+        gap_ms = max((nxt["t_start"] - prev["t_end"]) * 1e3, 0.0)
+        failovers.append({"name": "failover",
+                          "from_replica": prev["replica"],
+                          "to_replica": nxt["replica"],
+                          "preempt_t": prev["t_end"],
+                          "resume_t": nxt["t_start"],
+                          "gap_ms": gap_ms})
+    preemptions += len(failovers)
+    preempted_ms += sum(f["gap_ms"] for f in failovers)
+    if preemptions:
+        out["preemptions"] = preemptions
+        out["preempted_ms"] = preempted_ms
+    out["segments"] = segments
+    out["failovers"] = failovers
+    return out
+
+
+def stitch_traces(dumps: Dict[str, List[dict]]) -> dict:
+    """Merge per-replica span dumps into one lifecycle per trace.
+
+    ``dumps`` maps a replica name to that replica tracer's
+    ``to_dicts()`` output. Within each replica, any span carrying a
+    ``trace_id`` attr (the ``enqueue`` event, by the propagation
+    contract) binds its ``request_id`` to that trace; every span of a
+    bound request joins the trace. Returns ``{"traces": {trace_id:
+    stitched_lifecycle}, "orphans": [span, ...]}`` — orphans are spans
+    whose request never carried a trace id (zero, when propagation
+    works)."""
+    trace_of: Dict[Tuple[str, object], str] = {}
+    for replica, spans in dumps.items():
+        for s in spans:
+            tid = (s.get("attrs") or {}).get("trace_id")
+            if tid:
+                trace_of[(replica, s["request_id"])] = str(tid)
+    grouped: Dict[str, List[Tuple[str, dict]]] = {}
+    orphans: List[dict] = []
+    for replica, spans in dumps.items():
+        for s in spans:
+            tid = trace_of.get((replica, s["request_id"]))
+            if tid is None:
+                orphan = dict(s)
+                orphan["replica"] = replica
+                orphans.append(orphan)
+            else:
+                grouped.setdefault(tid, []).append((replica, s))
+    return {"traces": {tid: _stitch_one(tid, items)
+                       for tid, items in grouped.items()},
+            "orphans": orphans}
+
+
+# ---------------------------------------------------------------------------
+# snapshot -> fleet row (the scrape-fidelity core)
+# ---------------------------------------------------------------------------
+
+def _labels_match(entry_labels: Dict[str, str],
+                  want: Dict[str, str]) -> bool:
+    return all(entry_labels.get(k) == v for k, v in want.items())
+
+
+def _entries(snap: dict, kind: str, name: str,
+             want: Dict[str, str]) -> List[dict]:
+    return [e for e in snap.get(kind, ())
+            if e["name"] == name
+            and _labels_match(e.get("labels", {}), want)]
+
+
+def _merged_quantile(entries: List[dict], q: float) -> float:
+    """Quantile over one or more snapshot histogram entries of one
+    family, mirroring :meth:`Histogram.quantile` exactly (same linear
+    interpolation inside the target bucket, clamped to the observed
+    min/max) — the remote side of the scrape-fidelity contract: a p95
+    recomputed from ``/metrics.json`` buckets equals the replica's
+    in-process ``quantile(0.95)``."""
+    entries = [e for e in entries if e.get("count")]
+    if not entries:
+        return 0.0
+    total = sum(e["count"] for e in entries)
+    vmin = min(e["min"] for e in entries)
+    vmax = max(e["max"] for e in entries)
+    les = [le for le, _ in entries[0]["buckets"]]
+    counts = [0] * len(les)
+    for e in entries:
+        prev = 0
+        for i, (_, cum) in enumerate(e["buckets"]):
+            counts[i] += cum - prev
+            prev = cum
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = 0.0 if i == 0 else les[i - 1]
+            hi = les[i]
+            if hi is None or math.isinf(hi):
+                hi = vmax
+            frac = (target - cum) / c
+            v = lo + frac * (hi - lo)
+            return min(max(v, vmin), vmax)
+        cum += c
+    return vmax
+
+
+def row_from_snapshot(snap: dict,
+                      labels: Optional[Dict[str, str]] = None) -> dict:
+    """One replica's fleet row from a registry snapshot (the in-process
+    ``metrics.snapshot()`` or a scraped ``/metrics.json`` document).
+
+    ``labels`` filters to one engine's label set (the LOCAL path — the
+    process registry holds every in-process replica). A remote scrape
+    passes no filter: the replica's process registry is merged across
+    label sets, which is exact for the one-engine-per-serving-process
+    deployment shape (docs/http.md Limits)."""
+    want = {k: str(v) for k, v in (labels or {}).items()}
+    row = {
+        "ttft_ms_p95": _merged_quantile(
+            _entries(snap, "histograms", "serving.ttft_ms", want), 0.95),
+        "tpot_ms_p95": _merged_quantile(
+            _entries(snap, "histograms", "serving.tpot_ms", want), 0.95),
+        "queue_depth": sum(
+            e["value"] for e in _entries(snap, "gauges",
+                                         "serving.queue_depth", want)),
+        "slo_burn": max(
+            [e["value"] for e in _entries(snap, "gauges",
+                                          "serving.slo_burn", want)]
+            or [0.0]),
+    }
+    return row
+
+
+def _scrape(fe, cursor: int) -> dict:
+    """Scrape ONE replica (no locks held — pure I/O / registry reads).
+
+    A frontend-shaped object exposing ``fleet_scrape`` (the HTTP
+    replica client) is scraped over the wire; anything else is a local
+    replica whose registry slice and engine event ring are read
+    directly. Returns ``{"row", "events", "dropped", "cursor"}``."""
+    remote = getattr(fe, "fleet_scrape", None)
+    if remote is not None:
+        doc = remote(cursor)
+        snap = doc.get("metrics", {})
+        edoc = doc.get("events", {})
+        events = list(edoc.get("events", ()))
+        dropped = int(edoc.get("dropped", 0))
+        row = row_from_snapshot(snap)
+    else:
+        row = row_from_snapshot(metrics.snapshot(),
+                                labels=fe.engine.obs_labels)
+        row["queue_depth"] = fe.queue_depth
+        events, dropped = fe.engine.events.since(cursor)
+    new_cursor = cursor
+    for e in events:
+        new_cursor = max(new_cursor, int(e.get("seq", cursor)))
+    if dropped:
+        # a lapped cursor: everything up to the ring's oldest retained
+        # event is gone — advance past the gap so it is counted once
+        new_cursor = max(new_cursor, cursor + dropped)
+    return {"row": row, "events": events, "dropped": dropped,
+            "cursor": new_cursor}
+
+
+# ---------------------------------------------------------------------------
+# burn-rate alerting
+# ---------------------------------------------------------------------------
+
+class BurnRateAlerter:
+    """Multi-window SLO burn-rate alerting over an injectable clock.
+
+    ``observe(burn)`` appends one sample of the federated ``slo_burn``
+    series (the SLO miss rate the serving frontend maintains —
+    TTFT-deadline and TPOT misses per retirement). The alert FIRES when
+    the mean burn over BOTH the fast and the slow window reaches
+    ``threshold`` — the fast window gives detection latency, the slow
+    window confirms it is not a transient (the multi-window burn-rate
+    pattern, Google SRE workbook ch. 5). It RESOLVES only once the
+    fast-window mean drops below ``threshold * hysteresis`` — the
+    asymmetric band pins flap-free behavior at the boundary. Each
+    transition emits one ``fleet.alert`` event (``state`` firing /
+    resolved) into ``events``.
+
+    Thread-safe; sample state is guarded by the alerter's own lock and
+    the event emission happens outside it."""
+
+    def __init__(self, *, threshold: float = 0.1,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 hysteresis: float = 0.5,
+                 events: Optional[EventLog] = None,
+                 clock=time.monotonic):
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        if not 0.0 <= hysteresis <= 1.0:
+            raise ValueError(f"hysteresis must be in [0, 1], got "
+                             f"{hysteresis}")
+        if fast_window_s <= 0 or slow_window_s < fast_window_s:
+            raise ValueError("need 0 < fast_window_s <= slow_window_s")
+        self.threshold = float(threshold)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.hysteresis = float(hysteresis)
+        self.events = events if events is not None else EventLog(256)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: deque = deque()   # (t, burn), slow-window bound
+        self._firing = False
+        self._fired = 0
+
+    @property
+    def firing(self) -> bool:
+        with self._lock:
+            return self._firing
+
+    @property
+    def fired(self) -> int:
+        """Fire transitions so far (the ``fleet.alerts_fired`` field)."""
+        with self._lock:
+            return self._fired
+
+    def windows(self) -> Tuple[float, float]:
+        """Current ``(fast, slow)`` window means (0.0 when empty)."""
+        with self._lock:
+            return self._means_locked(self._clock())
+
+    def _means_locked(self, now: float) -> Tuple[float, float]:
+        while self._samples and \
+                now - self._samples[0][0] > self.slow_window_s:
+            self._samples.popleft()
+        slow_vals = [b for _, b in self._samples]
+        fast_vals = [b for t, b in self._samples
+                     if now - t <= self.fast_window_s]
+        fast = sum(fast_vals) / len(fast_vals) if fast_vals else 0.0
+        slow = sum(slow_vals) / len(slow_vals) if slow_vals else 0.0
+        return fast, slow
+
+    def observe(self, burn: float) -> bool:
+        """Feed one federated burn sample; returns the (possibly
+        updated) firing state."""
+        now = self._clock()
+        with self._lock:
+            self._samples.append((now, float(burn)))
+            fast, slow = self._means_locked(now)
+            was = self._firing
+            if not was and fast >= self.threshold \
+                    and slow >= self.threshold:
+                self._firing = True
+                self._fired += 1
+            elif was and fast < self.threshold * self.hysteresis:
+                self._firing = False
+            firing = self._firing
+            fired = self._fired
+        if firing != was:
+            self.events.emit("fleet.alert",
+                             state="firing" if firing else "resolved",
+                             fast_burn=round(fast, 6),
+                             slow_burn=round(slow, 6),
+                             threshold=self.threshold,
+                             alerts_fired=fired)
+        return firing
+
+
+# ---------------------------------------------------------------------------
+# the federation collector
+# ---------------------------------------------------------------------------
+
+class FleetCollector:
+    """Router-side metrics/event federation over N replicas.
+
+    ``tick()`` — called from the router's supervision tick — snapshots
+    the replica set via ``router.fleet_targets()`` (the router takes
+    its own lock for exactly that read), scrapes each live replica with
+    NO lock held (:func:`_scrape` — registry reads locally, two HTTP
+    GETs remotely), then merges the results under the collector's own
+    ``_lock``: per-replica rows, incremental event tails (cursor-based,
+    gap-counting), ``fleet.*{replica=}`` gauges with scrape-staleness,
+    and one :class:`BurnRateAlerter` sample of the worst live replica's
+    ``slo_burn``. Scrapes are throttled to ``interval_s`` of the
+    injected clock (``force=True`` bypasses — the flight recorder's
+    final scrape)."""
+
+    def __init__(self, router, *, interval_s: float = 0.05,
+                 event_tail: int = 512,
+                 alerter: Optional[BurnRateAlerter] = None,
+                 clock=time.monotonic):
+        self._router = router
+        self.interval_s = float(interval_s)
+        self.event_tail = int(event_tail)
+        self.alerter = alerter
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._order: List[str] = []
+        self._rows: Dict[str, dict] = {}
+        self._tails: Dict[str, deque] = {}
+        self._cursors: Dict[str, int] = {}
+        self._scraped_at: Dict[str, float] = {}
+        self._storms: Dict[str, int] = {}
+        self._dropped: Dict[str, int] = {}
+        self._alive: Dict[str, bool] = {}
+
+    # -- the federation tick -------------------------------------------------
+
+    def tick(self, force: bool = False) -> bool:
+        """One federation pass; returns False when throttled."""
+        now = self._clock()
+        with self._lock:
+            last = max(self._scraped_at.values(), default=None)
+            if not force and last is not None \
+                    and now - last < self.interval_s:
+                return False
+            cursors = dict(self._cursors)
+        targets = self._router.fleet_targets()
+        results: Dict[str, Optional[dict]] = {}
+        for name, alive, fe in targets:
+            got = None
+            if alive:
+                try:
+                    got = _scrape(fe, cursors.get(name, -1))
+                except Exception:        # noqa: BLE001 — a scrape
+                    got = None           # failure is staleness, never
+                #                          a supervisor crash
+            results[name] = got
+        now = self._clock()
+        burn = None
+        with self._lock:
+            self._order = [name for name, _, _ in targets]
+            for name, alive, _ in targets:
+                self._alive[name] = alive
+                got = results[name]
+                if got is None:
+                    continue             # row + age keep their last
+                #                          scrape (staleness grows)
+                self._rows[name] = got["row"]
+                self._cursors[name] = got["cursor"]
+                self._scraped_at[name] = now
+                self._dropped[name] = (self._dropped.get(name, 0)
+                                       + got["dropped"])
+                tail = self._tails.setdefault(
+                    name, deque(maxlen=self.event_tail))
+                for e in got["events"]:
+                    tail.append(e)
+                    if e.get("kind") == "compile_storm":
+                        self._storms[name] = \
+                            self._storms.get(name, 0) + 1
+            for name in self._order:
+                row = self._rows.get(name, {})
+                lbl = {"replica": name}
+                for field in ("ttft_ms_p95", "tpot_ms_p95",
+                              "queue_depth", "slo_burn"):
+                    metrics.gauge(f"fleet.{field}", labels=lbl).set(
+                        row.get(field, 0.0))
+                age = now - self._scraped_at[name] \
+                    if name in self._scraped_at else 0.0
+                metrics.gauge("fleet.scrape_age_s", labels=lbl).set(age)
+            live_burns = [self._rows[n].get("slo_burn", 0.0)
+                          for n in self._order
+                          if self._alive.get(n) and n in self._rows]
+            if live_burns:
+                burn = max(live_burns)
+        if burn is not None and self.alerter is not None:
+            self.alerter.observe(burn)
+        return True
+
+    # -- read side -----------------------------------------------------------
+
+    def scrape_ages(self) -> Dict[str, Optional[float]]:
+        """Per-replica seconds since the last successful scrape (None
+        before the first) — the ``/healthz`` staleness fields."""
+        with self._lock:
+            now = self._clock()
+            return {name: (round(now - self._scraped_at[name], 6)
+                           if name in self._scraped_at else None)
+                    for name in self._order}
+
+    def events_tail(self, name: Optional[str] = None):
+        """The federated event tail for one replica (or all, keyed by
+        replica name) — the flight recorder's per-replica ring copy,
+        which survives the replica's death."""
+        with self._lock:
+            if name is not None:
+                return [dict(e) for e in self._tails.get(name, ())]
+            return {n: [dict(e) for e in t]
+                    for n, t in self._tails.items()}
+
+    def block(self) -> dict:
+        """The pinned ``fleet`` block (``report.FLEET_FIELDS``):
+        per-replica rows plus fleet aggregates — worst-replica p95s and
+        burn (an SLO is only as good as the slowest replica), summed
+        depth/storms, max scrape age, and the alerter's state."""
+        with self._lock:
+            now = self._clock()
+            per = []
+            for name in self._order:
+                row = dict(self._rows.get(
+                    name, {"ttft_ms_p95": 0.0, "tpot_ms_p95": 0.0,
+                           "queue_depth": 0, "slo_burn": 0.0}))
+                row["replica"] = name
+                row["alive"] = bool(self._alive.get(name, False))
+                row["scrape_age_s"] = \
+                    round(now - self._scraped_at[name], 6) \
+                    if name in self._scraped_at else 0.0
+                row["compile_storms"] = self._storms.get(name, 0)
+                row["events_dropped"] = self._dropped.get(name, 0)
+                per.append(row)
+        alerter = self.alerter
+        out = {
+            "replicas": len(per),
+            "ttft_ms_p95": max((r["ttft_ms_p95"] for r in per),
+                               default=0.0),
+            "tpot_ms_p95": max((r["tpot_ms_p95"] for r in per),
+                               default=0.0),
+            "queue_depth": sum(r["queue_depth"] for r in per),
+            "slo_burn": max((r["slo_burn"] for r in per), default=0.0),
+            "compile_storms": sum(r["compile_storms"] for r in per),
+            "scrape_age_s_max": max((r["scrape_age_s"] for r in per),
+                                    default=0.0),
+            "alerts_fired": alerter.fired if alerter is not None else 0,
+            "alert_firing": (alerter.firing
+                             if alerter is not None else False),
+            "per_replica": per,
+        }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the flight recorder
+# ---------------------------------------------------------------------------
+
+#: pinned bundle schema — validate_flight() and the banked
+#: FLIGHT_<tag>.json artifacts both key on it
+FLIGHT_SCHEMA = "apex-tpu/flight/v1"
+
+#: required top-level keys of a flight bundle
+FLIGHT_KEYS = ("schema", "reason", "tag", "time_unix", "replicas",
+               "router", "traces", "orphan_spans", "fleet",
+               "pool_gauges", "metrics")
+
+#: required keys of each per-replica entry
+FLIGHT_REPLICA_KEYS = ("alive", "dead_reason", "events",
+                       "events_dropped", "queue_depth", "routed",
+                       "scrape_age_s")
+
+
+def build_flight(*, reason: str, routing: List[dict],
+                 counters: Dict[str, int], router_events: List[dict],
+                 dumps: Dict[str, List[dict]],
+                 collector: Optional[FleetCollector] = None,
+                 replica_events: Optional[Dict[str, List[dict]]] = None,
+                 tag: Optional[str] = None,
+                 event_tail: int = 256) -> dict:
+    """Assemble the correlated postmortem bundle.
+
+    The router passes its lock-snapshotted ``routing`` table (one dict
+    per replica with ``replica``/``alive``/``draining``/``routed``/
+    ``dead_reason``/``queue_depth``), its counter deltas, its own event
+    tail, and every replica tracer's span dump keyed by replica name.
+    ``replica_events`` overrides a replica's event tail (local replicas
+    read their engine ring directly — complete even for a replica the
+    supervisor just declared dead); anything else falls back to the
+    collector's federated tail copy, which survives a remote replica's
+    process. Spans are stitched by trace id — the bundle's ``traces``
+    block is one entry per request lifecycle across however many
+    replicas served it."""
+    stitched = stitch_traces(dumps)
+    ages = collector.scrape_ages() if collector is not None else {}
+    fed = collector.events_tail() if collector is not None else {}
+    block = collector.block() if collector is not None else None
+    fed_rows = {r["replica"]: r for r in block["per_replica"]} \
+        if block is not None else {}
+    replicas: Dict[str, dict] = {}
+    for entry in routing:
+        name = entry["replica"]
+        events = (replica_events or {}).get(name)
+        if events is None:
+            events = fed.get(name, [])
+        replicas[name] = {
+            "alive": entry["alive"],
+            "draining": entry.get("draining", False),
+            "dead_reason": entry.get("dead_reason"),
+            "routed": entry.get("routed", 0),
+            "queue_depth": entry.get("queue_depth", 0),
+            "events": events[-event_tail:],
+            "events_dropped": fed_rows.get(name, {}).get(
+                "events_dropped", 0),
+            "scrape_age_s": ages.get(name),
+        }
+    snap = metrics.snapshot()
+    pool_gauges = {
+        f"{e['name']}{sorted(e['labels'].items())}": e["value"]
+        for e in snap.get("gauges", ())
+        if e["name"].startswith(("pool.", "kv_pool", "host_tier"))}
+    return {
+        "schema": FLIGHT_SCHEMA,
+        "reason": reason,
+        "tag": tag,
+        "time_unix": time.time(),
+        "replicas": replicas,
+        "router": {
+            "replicas": len(routing),
+            "alive": sum(1 for e in routing if e["alive"]),
+            "counters": dict(counters),
+            "routing": routing,
+            "events": router_events[-event_tail:],
+        },
+        "traces": stitched["traces"],
+        "orphan_spans": stitched["orphans"],
+        "fleet": block,
+        "pool_gauges": pool_gauges,
+        "metrics": snap,
+    }
+
+
+def validate_flight(doc: dict) -> dict:
+    """Validate a flight bundle against the pinned schema; returns the
+    document, raises ``ValueError`` naming every problem otherwise —
+    the CI round's bank step refuses a malformed postmortem."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        raise ValueError("flight bundle must be a dict")
+    if doc.get("schema") != FLIGHT_SCHEMA:
+        problems.append(f"schema is {doc.get('schema')!r}, expected "
+                        f"{FLIGHT_SCHEMA!r}")
+    for key in FLIGHT_KEYS:
+        if key not in doc:
+            problems.append(f"missing key {key!r}")
+    reps = doc.get("replicas")
+    if not isinstance(reps, dict) or not reps:
+        problems.append("replicas must be a non-empty dict")
+    else:
+        for name, entry in reps.items():
+            for key in FLIGHT_REPLICA_KEYS:
+                if key not in entry:
+                    problems.append(f"replica {name!r} missing {key!r}")
+            if not isinstance(entry.get("events"), list):
+                problems.append(f"replica {name!r} events must be a "
+                                f"list (the ring tail)")
+    router = doc.get("router")
+    if not isinstance(router, dict):
+        problems.append("router block must be a dict")
+    else:
+        for key in ("replicas", "alive", "counters", "routing",
+                    "events"):
+            if key not in router:
+                problems.append(f"router block missing {key!r}")
+    if not isinstance(doc.get("traces"), dict):
+        problems.append("traces must be a dict keyed by trace_id")
+    if not isinstance(doc.get("orphan_spans"), list):
+        problems.append("orphan_spans must be a list")
+    if problems:
+        raise ValueError("invalid flight bundle: " + "; ".join(problems))
+    return doc

@@ -164,6 +164,16 @@ _SCENARIO_ROUTER_FIELDS = ("failover_recovered_rate",
 _SCENARIO_HOST_TIER_FIELDS = ("tier_on_hit_rate", "tier_off_hit_rate",
                               "tier_delta_hit_rate", "promote_hit_rate")
 
+#: per-scenario FLEET fields (the federated observability plane,
+#: docs/observability.md "Fleet plane"): extracted from a report's
+#: ``fleet`` block as ``scenario.<name>.fleet_<field>``. The latency
+#: aggregates band-gate as ``_ms`` lower-better; the rest are
+#: informational counters banked so the alerting/federation trajectory
+#: stays reviewable per round
+_SCENARIO_FLEET_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "queue_depth",
+                          "slo_burn", "compile_storms",
+                          "alerts_fired")
+
 #: per-scenario HTTP fields (the over-the-wire chaos tier,
 #: docs/http.md): extracted from a report's ``http`` block as
 #: ``scenario.<name>.http_<field>``. Counters, so informational —
@@ -221,6 +231,11 @@ def _scenario_metrics(doc: dict) -> Dict[str, float]:
             v = tier.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 out[f"scenario.{name}.{field}"] = float(v)
+        fleet = rep.get("fleet", {}) if isinstance(rep, dict) else {}
+        for field in _SCENARIO_FLEET_FIELDS:
+            v = fleet.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"scenario.{name}.fleet_{field}"] = float(v)
         http = rep.get("http", {}) if isinstance(rep, dict) else {}
         for field in _SCENARIO_HTTP_FIELDS:
             v = http.get(field)

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.obs import compile_watch
+from apex_tpu.obs import fleet
 from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.ops._dispatch import round_up
 from apex_tpu.serving import kv_pool
@@ -481,11 +482,19 @@ class ServingFrontend:
         entry = _Entry(idx, handle, prompt, request.max_new_tokens,
                        request.priority, deadline_at, arrival, seq)
         entry.tpot_slo = request.tpot_slo_ms
+        # trace propagation (docs/observability.md "Fleet plane"): the
+        # enqueue event binds this request id to its fleet-wide trace —
+        # a routed request arrives with the router's mint, a direct
+        # submit mints here, and stitch_traces() joins every replica's
+        # spans on it
+        trace_id = request.trace_id if request.trace_id is not None \
+            else fleet.mint_trace_id()
         self.tracer.event(idx, "enqueue",
                           prompt_tokens=int(prompt.shape[0]),
                           max_new_tokens=request.max_new_tokens,
                           priority=request.priority,
-                          deadline_ms=request.deadline_ms)
+                          deadline_ms=request.deadline_ms,
+                          trace_id=trace_id)
         with self._ingest_lock:
             # re-check under the lock: a pump failure drains the ingest
             # queue under this lock, so an entry either lands before the

@@ -57,6 +57,7 @@ state that submit/cancel/drain touch from OTHER threads is guarded by a
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import json
 import socket
 import threading
@@ -66,6 +67,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from apex_tpu.obs import export as obs_export
+from apex_tpu.obs import fleet
 from apex_tpu.obs.spans import SpanTracer
 from apex_tpu.serving.aio import AsyncStreamHandle
 from apex_tpu.serving.frontend import ServingError, StreamHandle
@@ -315,11 +317,13 @@ class HttpServingServer:
         clen = int(headers.get("content-length", "0") or 0)
         if clen:
             body = await reader.readexactly(clen)
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if method == "POST" and path == "/v1/generate":
-            await self._generate(reader, writer, body)
+            await self._generate(reader, writer, body, headers)
         elif method == "POST" and path.startswith("/v1/cancel/"):
             await self._cancel(writer, path[len("/v1/cancel/"):])
+        elif method == "GET" and path == "/events":
+            await self._events(writer, query)
         elif method == "GET" and path == "/healthz":
             await self._resp(writer, 200, _json_bytes(self._health_doc()))
         elif method == "GET" and path in ("/metrics", "/"):
@@ -354,6 +358,30 @@ class HttpServingServer:
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
         await writer.drain()
 
+    async def _events(self, writer, query: str) -> None:
+        """``GET /events?since_seq=N`` — the replica's event ring as an
+        incremental, cursor-based read (the federation scrape's second
+        endpoint): events past the cursor plus the count the ring
+        lapped past it (``dropped`` — the scraper's gap detector)."""
+        since = -1
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            if key == "since_seq" and val:
+                try:
+                    since = int(val)
+                except ValueError:
+                    await self._resp(writer, 400, _json_bytes(
+                        {"error": f"since_seq must be an integer, "
+                                  f"got {val!r}"}))
+                    return
+        log = self.target.events if self.is_router \
+            else self.target.engine.events
+        events, dropped = log.since(since)
+        await self._resp(writer, 200, _json_bytes(
+            {"kind": "event_log", "capacity": log.capacity,
+             "total": log.total, "dropped": dropped,
+             "since_seq": since, "events": events}))
+
     async def _cancel(self, writer, request_id: str) -> None:
         with self._lock:
             handle = self._streams.get(request_id)
@@ -368,7 +396,7 @@ class HttpServingServer:
 
     # -- the generate stream -------------------------------------------------
 
-    def _submit(self, body: dict):
+    def _submit(self, body: dict, headers: Optional[dict] = None):
         """Parse + submit (sync — the frontend's submit path is
         non-blocking bookkeeping). Returns ``(handle, request_id)``;
         raises ValueError (400), OverloadError (429), ServingError
@@ -384,11 +412,20 @@ class HttpServingServer:
             ttft_ms = float(ttft_timeout_s) * 1e3
             deadline_ms = ttft_ms if deadline_ms is None \
                 else min(float(deadline_ms), ttft_ms)
+        # trace propagation: the traceparent header (or a bare body
+        # trace_id) carries the caller's fleet-wide trace into this
+        # replica's Request, so the local tracer's spans stitch with
+        # the router side's; absent/malformed degrades to a local mint
+        # downstream, never to a 400
+        trace_id = fleet.parse_traceparent(
+            (headers or {}).get("traceparent")) \
+            or fleet.parse_traceparent(body.get("trace_id"))
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=int(body.get("max_new_tokens", 16)),
                       priority=int(body.get("priority", 0)),
                       deadline_ms=deadline_ms,
-                      tpot_slo_ms=body.get("tpot_slo_ms"))
+                      tpot_slo_ms=body.get("tpot_slo_ms"),
+                      trace_id=trace_id)
         if self.max_queue_depth is not None:
             depth = self._queue_depth()
             if depth >= self.max_queue_depth:
@@ -412,7 +449,8 @@ class HttpServingServer:
             handle = self.target.submit(req, request_id=request_id)
         return handle, str(handle.request_id)
 
-    async def _generate(self, reader, writer, raw: bytes) -> None:
+    async def _generate(self, reader, writer, raw: bytes,
+                        headers: Optional[dict] = None) -> None:
         self._C["requests"].inc()
         with self._lock:
             draining = self._draining
@@ -425,7 +463,7 @@ class HttpServingServer:
             body = json.loads(raw.decode() or "{}")
             if not isinstance(body, dict):
                 raise ValueError("body must be a JSON object")
-            handle, rid = self._submit(body)
+            handle, rid = self._submit(body, headers)
         except OverloadError as exc:
             self._C["rejected"].inc()
             retry = getattr(exc, "retry_after_s", self.retry_after_s)
@@ -638,6 +676,12 @@ class HttpReplicaClient:
     def submit(self, request: Request, *,
                request_id=None) -> StreamHandle:
         self.engine._validate_request(request)
+        if request.trace_id is None:
+            # a direct client submit mints its own trace id (the router
+            # mints before it reaches us) — minted HERE so the wire
+            # request carries it and the server tags the same trace
+            request = dataclasses.replace(
+                request, trace_id=fleet.mint_trace_id())
         with self._lock:
             if self._failure is not None:
                 raise ServingError("http replica has failed") \
@@ -653,12 +697,16 @@ class HttpReplicaClient:
                 target=self._stream, args=(request, request_id, handle),
                 name=f"http-replica-stream-{request_id}", daemon=True)
             self._threads[request_id] = thread
+        # the client-side enqueue binds this request to its fleet-wide
+        # trace — the span dump this tracer produces is one of the
+        # inputs stitch_traces() joins across replicas
         self.tracer.event(request_id, "enqueue",
                           prompt_tokens=int(np.asarray(
                               request.prompt).reshape(-1).shape[0]),
                           max_new_tokens=request.max_new_tokens,
                           priority=request.priority,
-                          deadline_ms=request.deadline_ms)
+                          deadline_ms=request.deadline_ms,
+                          trace_id=request.trace_id)
         thread.start()
         return handle
 
@@ -677,6 +725,44 @@ class HttpReplicaClient:
 
     def counter_deltas(self) -> Dict[str, float]:
         return {name: 0.0 for name in _RUN_COUNTERS}
+
+    # -- fleet scrape (blocking; caller must hold NO lock) --------------------
+
+    def _get_json(self, path: str) -> dict:
+        """Blocking GET against the remote replica; returns the parsed
+        JSON body.  Raises :class:`ServingError` on connect failure or a
+        non-200 status — the fleet collector treats that as a missed
+        scrape, not a fatal error."""
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s)
+        try:
+            sock.sendall((f"GET {path} HTTP/1.1\r\n"
+                          f"Host: {self.host}:{self.port}\r\n"
+                          f"Connection: close\r\n\r\n").encode())
+            f = sock.makefile("rb")
+            status_line = f.readline().decode("ascii", "replace")
+            parts = status_line.split(" ", 2)
+            status = int(parts[1]) if len(parts) > 1 else 0
+            while True:                  # headers; Connection: close ⇒
+                line = f.readline()      # body runs to EOF
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body = f.read()
+            if status != 200:
+                raise ServingError(
+                    f"scrape GET {path} -> {status}: {body[:200]!r}")
+            return json.loads(body.decode())
+        finally:
+            sock.close()
+
+    def fleet_scrape(self, since_seq: int = -1) -> dict:
+        """One federation scrape: the replica's metrics snapshot plus its
+        event ring past ``since_seq``.  Shape is consumed by
+        :func:`apex_tpu.obs.fleet.FleetCollector.tick`."""
+        return {
+            "metrics": self._get_json("/metrics.json"),
+            "events": self._get_json(f"/events?since_seq={since_seq}"),
+        }
 
     def shutdown(self, deadline_s: float = 30.0, *,
                  mode: str = "drain") -> None:
@@ -727,11 +813,16 @@ class HttpReplicaClient:
                 "deadline_ms": request.deadline_ms,
                 "tpot_slo_ms": request.tpot_slo_ms,
                 "request_id": str(request_id),
+                "trace_id": request.trace_id,
             }).encode()
+            trace_hdr = "" if request.trace_id is None else \
+                (f"traceparent: "
+                 f"{fleet.traceparent(request.trace_id)}\r\n")
             head = (f"POST /v1/generate HTTP/1.1\r\n"
                     f"Host: {self.host}:{self.port}\r\n"
                     f"Content-Type: application/json\r\n"
                     f"Content-Length: {len(body)}\r\n"
+                    f"{trace_hdr}"
                     f"Connection: close\r\n\r\n").encode()
             sock.sendall(head + body)
             sock.settimeout(None)        # SSE streams at the pump's pace

@@ -68,6 +68,8 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from apex_tpu.obs.events import EventLog
+from apex_tpu.obs.fleet import (BurnRateAlerter, FleetCollector,
+                                build_flight, mint_trace_id)
 from apex_tpu.serving.frontend import ServingError, StreamHandle
 from apex_tpu.serving.scheduler import Request
 from apex_tpu.utils import metrics
@@ -143,7 +145,7 @@ class _Replica:
     guarded by the router's lock)."""
 
     __slots__ = ("index", "frontend", "alive", "draining", "started",
-                 "routed", "dead_reason")
+                 "routed", "failovers", "dead_reason")
 
     def __init__(self, index, frontend):
         self.index = index
@@ -152,6 +154,7 @@ class _Replica:
         self.draining = False
         self.started = False
         self.routed = 0
+        self.failovers = 0               # requests failed over OFF it
         self.dead_reason: Optional[BaseException] = None
 
 
@@ -234,6 +237,13 @@ class ReplicaRouter:
         self._rr_next = 0
         self._sup_thread: Optional[threading.Thread] = None
         self._sup_stop_evt = threading.Event()
+        self._last_tick_t: Optional[float] = None
+        self._flight_reason: Optional[str] = None
+        self.last_flight: Optional[dict] = None
+        self.alerter = BurnRateAlerter(events=self.events,
+                                       clock=self.clock)
+        self.fleet = FleetCollector(self, alerter=self.alerter,
+                                    clock=self.clock)
         labels = {"router": str(next(_ROUTER_IDS))}
         self.obs_labels = labels
         self._C = {name: metrics.counter(f"router.{name}", labels=labels)
@@ -264,6 +274,11 @@ class ReplicaRouter:
         the router is draining or no replica is alive. ``affinity_key``
         overrides the hashed prompt header (e.g. a tenant id)."""
         self.replicas[0].frontend.engine._validate_request(request)
+        if request.trace_id is None:
+            # router-side mint: every replica (and every failover hop)
+            # tags its spans with the SAME process-independent trace id
+            request = dataclasses.replace(request,
+                                          trace_id=mint_trace_id())
         now = self.clock()
         with self._lock:
             if not self._accepting:
@@ -343,7 +358,8 @@ class ReplicaRouter:
             - len(entry.delivered),
             priority=entry.request.priority,
             arrival_time=entry.arrival,
-            tpot_slo_ms=entry.request.tpot_slo_ms)
+            tpot_slo_ms=entry.request.tpot_slo_ms,
+            trace_id=entry.request.trace_id)
 
     @staticmethod
     def request_prompt(entry) -> np.ndarray:
@@ -464,7 +480,29 @@ class ReplicaRouter:
                 for entry in list(self._entries.values()):
                     self._fail_entry_locked(entry, err)
                 self._queued.clear()
+            try:
+                # the postmortem must never mask the original failure
+                self.flight_snapshot(f"supervisor_failed:{exc!r}")
+            except Exception:            # noqa: BLE001
+                pass
             raise
+
+    def fleet_targets(self) -> List[Tuple[str, bool, object]]:
+        """The fleet collector's scrape list: ``(name, alive,
+        frontend)`` per replica, snapshotted under the router lock so
+        the scrape itself (pure I/O) runs with no lock held."""
+        with self._lock:
+            return [(f"replica{rep.index}", rep.alive, rep.frontend)
+                    for rep in self.replicas]
+
+    @property
+    def last_tick_age_s(self) -> Optional[float]:
+        """Seconds since the supervision tick last completed (None
+        before the first tick) — the health doc's liveness signal for
+        the router itself."""
+        with self._lock:
+            last = self._last_tick_t
+        return None if last is None else max(self.clock() - last, 0.0)
 
     def _tick_impl(self) -> None:
         to_stop = []
@@ -491,6 +529,15 @@ class ReplicaRouter:
             for rep in self.replicas:
                 self._depth_gauges[rep.index].set(
                     rep.frontend.queue_depth if rep.alive else 0)
+            self._last_tick_t = self.clock()
+        # the fleet plane rides the tick, with NO router lock held: the
+        # collector snapshots its targets under the lock and scrapes
+        # between locks (docs/observability.md, "Fleet plane")
+        self.fleet.tick()
+        with self._lock:
+            reason, self._flight_reason = self._flight_reason, None
+        if reason is not None:
+            self.flight_snapshot(reason)
 
     def _consume_delay_locked(self, entry: _RouterEntry) -> float:
         if entry.done or entry.replica is None:
@@ -506,6 +553,11 @@ class ReplicaRouter:
         self._C["replica_deaths"].inc()
         self.events.emit("replica_dead", replica=rep.index,
                          error=repr(rep.dead_reason))
+        if self._flight_reason is None:
+            # the flight recorder fires at the END of this tick (the
+            # snapshot takes the collector lock and scrapes — neither
+            # belongs under the router lock)
+            self._flight_reason = f"replica_dead:{rep.index}"
 
     def _forward_locked(self, entry: _RouterEntry, sub, now: float) -> None:
         toks = sub.tokens_so_far()
@@ -597,6 +649,8 @@ class ReplicaRouter:
         entry.replica = None
         entry.handle.failovers += 1
         entry.retries += 1
+        if dead is not None:
+            self.replicas[dead].failovers += 1
         self._C["failovers"].inc()
         self._C["retries"].inc()
         self.events.emit("failover", request=entry.idx, replica=dead,
@@ -785,6 +839,59 @@ class ReplicaRouter:
         if stop_it:
             rep.frontend.stop()
 
+    # --- the flight recorder ------------------------------------------------
+
+    def flight_snapshot(self, reason: str, *,
+                        tag: Optional[str] = None) -> dict:
+        """Dump the correlated postmortem bundle (the flight recorder):
+        the routing table and counters under the lock, a forced fleet
+        scrape, every replica tracer's spans stitched by trace id, the
+        replicas' event-ring tails, and the registry snapshot —
+        schema-pinned (:data:`~apex_tpu.obs.fleet.FLIGHT_SCHEMA`),
+        validated by :func:`~apex_tpu.obs.fleet.validate_flight`.
+        Fires automatically on replica death and supervisor failure;
+        call it directly for an on-demand snapshot. The latest bundle
+        is kept on ``self.last_flight``."""
+        with self._lock:
+            routing = [{
+                "replica": f"replica{rep.index}",
+                "alive": rep.alive,
+                "draining": rep.draining,
+                "routed": rep.routed,
+                "failovers": rep.failovers,
+                "dead_reason": repr(rep.dead_reason)
+                if rep.dead_reason is not None else None,
+                "queue_depth": rep.frontend.queue_depth
+                if rep.alive else 0,
+            } for rep in self.replicas]
+            counters = {name: int(c.value - self._c0[name])
+                        for name, c in self._C.items()}
+            router_events = self.events.tail(256)
+        # scrape + stitch with NO router lock held (the collector takes
+        # the lock itself via fleet_targets; tracer reads are the
+        # tracers' own locks)
+        self.fleet.tick(force=True)
+        dumps: Dict[str, list] = {}
+        replica_events: Dict[str, list] = {}
+        for rep in self.replicas:        # the replica list never mutates
+            name = f"replica{rep.index}"
+            dumps[name] = rep.frontend.tracer.to_dicts()
+            ring = getattr(getattr(rep.frontend, "engine", None),
+                           "events", None)
+            if ring is not None:
+                replica_events[name] = ring.tail(256)
+        doc = build_flight(reason=reason, routing=routing,
+                           counters=counters,
+                           router_events=router_events, dumps=dumps,
+                           collector=self.fleet,
+                           replica_events=replica_events or None,
+                           tag=tag)
+        with self._lock:
+            self.last_flight = doc
+        self.events.emit("flight_recorded", reason=reason,
+                         replicas=len(dumps))
+        return doc
+
     # --- report adapters (the scenario engine's tracer surface) -------------
 
     def lifecycle(self, request_id) -> Dict[str, object]:
@@ -897,4 +1004,7 @@ class ReplicaRouter:
         for name, val in stats.items():
             if isinstance(val, (int, float)) and not isinstance(val, bool):
                 metrics.record(f"router.{name}", val)
+        # the federated fleet block (pinned shape — report.FLEET_FIELDS);
+        # a dict, so the record loop above never sees it
+        stats["fleet"] = self.fleet.block()
         return stats

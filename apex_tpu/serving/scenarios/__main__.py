@@ -46,6 +46,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(EngineSpec(http=True)): every request is "
                              "a real POST /v1/generate stream and the "
                              "report grows the pinned http block")
+    parser.add_argument("--fleet", default=None, metavar="OUT",
+                        help="write the replicated scenarios' federated "
+                             "fleet blocks here (one JSON document, "
+                             "docs/observability.md \"Fleet plane\")")
+    parser.add_argument("--flight", default=None, metavar="OUT",
+                        help="write the kill-triggered postmortem "
+                             "flight bundle here (schema-validated; "
+                             "skipped when no replica died)")
     parser.add_argument("--save-trace", default=None, metavar="DIR",
                         help="save each materialized trace as "
                              "<DIR>/<name>.trace.jsonl")
@@ -91,6 +99,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  for name, spec in specs.items()}
 
     reports = {}
+    fleets = {}
+    flight_doc = None
     check_failed = False
     doc_seed = args.seed
     for name in args.scenario:
@@ -134,6 +144,13 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"miss_rate={agg['deadline_miss_rate']:.2f} "
               f"hit_rate={agg['prefix_hit_rate']:.2f}", flush=True)
         reports[name] = result.report
+        if "fleet" in result.report:
+            fleets[name] = result.report["fleet"]
+        if result.flight is not None and flight_doc is None:
+            from apex_tpu.obs.fleet import validate_flight
+
+            flight_doc = validate_flight(dict(result.flight,
+                                              tag=name))
         if args.save_trace:
             os.makedirs(args.save_trace, exist_ok=True)
             path = os.path.join(args.save_trace,
@@ -151,6 +168,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
         print(f"[scenarios] report written to {args.json}")
+    if args.fleet:
+        fleet_out = {"schema": "apex-tpu/fleet/v1", "seed": doc_seed,
+                     "time_unix": round(time.time(), 3),
+                     "scenarios": fleets}
+        with open(args.fleet, "w") as f:
+            json.dump(fleet_out, f, indent=2, sort_keys=True)
+        print(f"[scenarios] fleet blocks written to {args.fleet}")
+    if args.flight:
+        if flight_doc is None:
+            print("[scenarios] no flight recorded (no replica died); "
+                  f"skipping {args.flight}")
+        else:
+            with open(args.flight, "w") as f:
+                json.dump(flight_doc, f, indent=2, sort_keys=True)
+            print(f"[scenarios] flight bundle written to {args.flight}")
     return 1 if check_failed else 0
 
 

@@ -50,7 +50,9 @@ The catalog (docs/scenarios.md has the prose):
 - ``chaos-replica-kill`` — replicated serving (``serving/router.py``)
   with a seeded mid-decode replica kill (``serving/faults.py``): every
   in-flight request must re-home to the survivor token-identically
-  (the greedy-identity amplifier proves recovery corrupts nothing).
+  (the greedy-identity amplifier proves recovery corrupts nothing);
+  the kill triggers the flight recorder and the report banks the
+  federated ``fleet`` block (docs/observability.md "Fleet plane").
 - ``chaos-pump-stall`` — a wedged-but-alive replica (injected pump
   stalls): latency, not death — nothing may hang, fail over, or leak.
 - ``chaos-slow-reader`` — the replay driven over real localhost HTTP
@@ -310,7 +312,11 @@ def _chaos_replica_kill(seed: int) -> ScenarioSpec:
     # every request it held (active, pending, mid-stream) must re-home
     # to the survivor with its generated-so-far tokens folded into the
     # resume prompt — greedy outputs identical to an unfailed run (the
-    # check amplifier), zero hung handles, zero leaked pages
+    # check amplifier), zero hung handles, zero leaked pages. The kill
+    # also exercises the fleet plane: the report banks the federated
+    # ``fleet`` block and the death triggers the flight recorder, so
+    # the CI round banks FLEET_/FLIGHT_ artifacts off this scenario
+    # (``--fleet``/``--flight``; docs/observability.md "Fleet plane")
     return ScenarioSpec(
         name="chaos-replica-kill", seed=seed, n_requests=12,
         arrival=Arrival(kind="poisson", rate_rps=600.0),

@@ -24,7 +24,8 @@ import numpy as np
 
 __all__ = ["REPORT_SCHEMA", "SCENARIOS_SCHEMA", "AGGREGATE_FIELDS",
            "TENANT_FIELDS", "ROUTER_FIELDS", "HTTP_FIELDS",
-           "HOST_TIER_FIELDS", "build_report", "validate_report"]
+           "HOST_TIER_FIELDS", "FLEET_FIELDS", "build_report",
+           "validate_report"]
 
 REPORT_SCHEMA = "apex-tpu/scenario-report/v1"
 #: the multi-scenario CLI document wrapping one report per scenario
@@ -69,6 +70,15 @@ HOST_TIER_FIELDS = (
     "tier_delta_hit_rate",
 )
 
+#: pinned ``fleet`` block keys (present on replicated scenarios — the
+#: router's federated observability block, ``router.fleet.block()``;
+#: docs/observability.md "Fleet plane")
+FLEET_FIELDS = (
+    "replicas", "ttft_ms_p95", "tpot_ms_p95", "queue_depth",
+    "slo_burn", "compile_storms", "scrape_age_s_max",
+    "alerts_fired", "alert_firing", "per_replica",
+)
+
 #: pinned ``http`` block keys (present when the scenario replayed over
 #: the wire — ``EngineSpec(http=True)``, scenarios/http_driver.py)
 HTTP_FIELDS = (
@@ -107,13 +117,16 @@ def build_report(spec, trace, outputs, stats: dict, tracer,
                  wall_s: float, checks: Optional[dict] = None,
                  router: Optional[dict] = None,
                  http: Optional[dict] = None,
-                 host_tier: Optional[dict] = None) -> dict:
+                 host_tier: Optional[dict] = None,
+                 fleet: Optional[dict] = None) -> dict:
     """Assemble the pinned-schema report for one replayed scenario.
     ``router`` is the replicated-scenario block (``ROUTER_FIELDS``) —
     failover/recovery facts and the affinity A/B; ``http`` the
     over-the-wire replay's block (``HTTP_FIELDS``); ``host_tier`` the
     tiered-pool block (``HOST_TIER_FIELDS``) — demote/promote facts and
-    the tier-on/off A/B; ``tracer`` may be the router's cross-replica
+    the tier-on/off A/B; ``fleet`` the router's federated
+    observability block (``FLEET_FIELDS``, ``router.fleet.block()``);
+    ``tracer`` may be the router's cross-replica
     lifecycle adapter (same ``lifecycle``/``spans`` surface as a
     :class:`~apex_tpu.obs.spans.SpanTracer`)."""
     events = trace.events
@@ -168,6 +181,8 @@ def build_report(spec, trace, outputs, stats: dict, tracer,
     }
     if router is not None:
         report["router"] = dict(router)
+    if fleet is not None:
+        report["fleet"] = dict(fleet)
     if http is not None:
         report["http"] = dict(http)
     if host_tier is not None:
@@ -203,6 +218,11 @@ def validate_report(report: dict) -> None:
                      if f not in report["router"]]
         if r_missing:
             raise ValueError(f"router block missing {r_missing}")
+    if "fleet" in report:
+        f_missing = [f for f in FLEET_FIELDS
+                     if f not in report["fleet"]]
+        if f_missing:
+            raise ValueError(f"fleet block missing {f_missing}")
     if "http" in report:
         h_missing = [f for f in HTTP_FIELDS
                      if f not in report["http"]]

@@ -218,6 +218,10 @@ class ScenarioResult:
     outputs: List[np.ndarray]
     stats: dict
     report: dict
+    #: the router's kill-triggered postmortem bundle (replicated chaos
+    #: scenarios where a replica died; None otherwise) — schema-pinned,
+    #: ``apex_tpu.obs.fleet.validate_flight``-clean
+    flight: Optional[dict] = None
 
 
 def materialize(spec: ScenarioSpec) -> Trace:
@@ -412,7 +416,13 @@ def replay(spec: ScenarioSpec, trace: Trace, *, engine=None):
         _, model, v = build_model(spec.engine.model)
         router = _build_router(spec, model, v)
         outputs, wall_s = _replay_router(spec, trace, router)
-        return outputs, router.stats(), router, wall_s
+        # one final federation pass so the banked fleet block reflects
+        # end-of-run state; the kill-triggered flight (if any replica
+        # died) rides along for run_scenario to lift out
+        router.fleet.tick(force=True)
+        stats = router.stats()
+        stats["flight"] = router.last_flight
+        return outputs, stats, router, wall_s
     if engine is None:
         _, model, v = build_model(spec.engine.model)
         engine = _build_engine(spec, model, v)
@@ -594,6 +604,10 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
     outputs, stats, tracer, wall_s = replay(spec, trace)
     http_block = stats.pop("http", None) if isinstance(stats, dict) \
         else None
+    fleet_block = stats.pop("fleet", None) if isinstance(stats, dict) \
+        else None
+    flight = stats.pop("flight", None) if isinstance(stats, dict) \
+        else None
     checks = None
     if check:
         n_checked = _check_greedy_identity(spec, trace, outputs)
@@ -607,7 +621,8 @@ def run_scenario(spec: ScenarioSpec, *, check: bool = False,
     rep = report_mod.build_report(spec, trace, outputs, stats, tracer,
                                   wall_s, checks=checks,
                                   router=router_block, http=http_block,
-                                  host_tier=host_tier_block)
+                                  host_tier=host_tier_block,
+                                  fleet=fleet_block)
     report_mod.validate_report(rep)
     return ScenarioResult(spec=spec, trace=trace, outputs=outputs,
-                          stats=stats, report=rep)
+                          stats=stats, report=rep, flight=flight)

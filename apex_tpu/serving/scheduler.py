@@ -125,6 +125,13 @@ class Request:
       ``serving.tpot_slo_misses`` and feeds the rolling
       ``serving.slo_burn`` gauge — the request is never truncated.
       None = no TPOT SLO.
+    - ``trace_id``: process-independent trace identity (32 lowercase hex
+      chars, the ``traceparent`` trace-id field). Minted router-side for
+      routed requests (``obs/fleet.py``), carried over HTTP as a
+      ``traceparent`` header, and tagged onto the tracer's ``enqueue``
+      span on every replica that ever holds the request — the key
+      ``stitch_traces()`` merges failed-over span fragments on. None =
+      untraced (single-process callers lose nothing).
     """
 
     prompt: Any                      # (s0,) int array
@@ -133,6 +140,7 @@ class Request:
     deadline_ms: Optional[float] = None
     arrival_time: Optional[float] = None
     tpot_slo_ms: Optional[float] = None
+    trace_id: Optional[str] = None
 
 
 def _donate_cache():

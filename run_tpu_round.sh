@@ -155,14 +155,28 @@ JAX_PLATFORMS=cpu python -m apex_tpu.obs.ledger --append --tag "$TAG" \
 # scenarios' SLO percentiles band-gate too, while their
 # scenario.<name>.http_* counters (backpressure_spills, disconnects,
 # conn_reset_retries, ...) land as informational trajectory.
+#
+# The round also banks the FLEET plane (docs/observability.md "Fleet
+# plane"): FLEET_${TAG}.json holds every routed scenario's federated
+# fleet block (the ledger band-gates scenario.<name>.fleet_ttft_ms_p95
+# / fleet_tpot_ms_p95; burn / depth / alerts_fired ride as
+# informational trajectory), and the replica kill dumps the
+# schema-validated postmortem FLIGHT_${TAG}.json — the --flight write
+# refuses a malformed bundle, so a banked flight is always readable.
 if [ ! -f "CHAOS_${TAG}.json" ]; then
   echo "[$(date +%H:%M:%S)] chaos smoke (replica kill + affinity A/B + network chaos, CPU)..."
   if ! JAX_PLATFORMS=cpu timeout 1800 python -m apex_tpu.serving.scenarios \
       --scenario chaos-replica-kill --scenario router-affinity-ab \
       --scenario chaos-slow-reader --scenario chaos-disconnect-storm \
-      --check --json "CHAOS_${TAG}.json" --seed 0; then
+      --check --json "CHAOS_${TAG}.json" --seed 0 \
+      --fleet "FLEET_${TAG}.json" --flight "FLIGHT_${TAG}.json"; then
     echo "[$(date +%H:%M:%S)] chaos smoke failed; replica failover or the"
     echo "  HTTP surface is broken — fix before burning a tunnel window"
+    exit 1
+  fi
+  if [ ! -f "FLIGHT_${TAG}.json" ]; then
+    echo "[$(date +%H:%M:%S)] chaos round killed a replica but recorded no"
+    echo "  flight bundle — the postmortem recorder is broken"
     exit 1
   fi
 fi

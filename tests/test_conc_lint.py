@@ -450,6 +450,105 @@ def test_host_tier_guardedby_map_pinned():
                 ("HostPageTier", field)))
 
 
+def test_fleet_guardedby_map_pinned():
+    """ISSUE 19: the fleet plane's lock discipline is a CHECKED
+    contract. The collector merges scrape results under its OWN lock
+    (never the router's — the scrape I/O itself runs lock-free), the
+    alerter's sample window is single-lock, and the router's flight /
+    tick bookkeeping joined the router-lock family."""
+    model, _ = build_model(_surface_sources())
+    guards = {(f[1], f[2]): lock.display()
+              for f, (lock, _, _) in model.inferred_guards().items()}
+    for field in ("_order", "_rows", "_tails", "_cursors",
+                  "_scraped_at", "_storms", "_dropped", "_alive"):
+        assert guards[("FleetCollector", field)] == \
+            "FleetCollector._lock", (field, guards.get(
+                ("FleetCollector", field)))
+    for field in ("_samples", "_firing", "_fired"):
+        assert guards[("BurnRateAlerter", field)] == \
+            "BurnRateAlerter._lock", (field, guards.get(
+                ("BurnRateAlerter", field)))
+    for field in ("_flight_reason", "_last_tick_t", "last_flight"):
+        assert guards[("ReplicaRouter", field)] == \
+            "ReplicaRouter._lock", (field, guards.get(
+                ("ReplicaRouter", field)))
+    # the collector's read side is reachable from the supervisor color
+    # (the flight path), so the field rule treats its state as shared
+    colored = {k.qualname for k, v in model.colors.items()
+               if "serving-router-supervisor" in v}
+    for fn in ("FleetCollector.block", "FleetCollector.events_tail",
+               "FleetCollector.scrape_ages"):
+        assert fn in colored, sorted(c for c in colored if "Fleet" in c)
+
+
+def test_fleet_collector_tick_coloring_fixture():
+    """ISSUE 19: ``router._tick_impl`` invokes ``self.fleet.tick()``
+    across a module boundary the call-graph cannot resolve, so the
+    supervisor-coloring of the collector's tick is pinned on an inline
+    fixture instead: a literal-named supervisor thread drives a mini
+    collector whose tick scrapes LOCK-FREE and merges under its own
+    lock. The good twin is clean; dropping the merge lock fires
+    ``conc-unguarded-shared-field``."""
+    good = """\
+        import threading
+
+        def scrape(fe):
+            return fe.row()              # pure I/O — no lock held
+
+        class MiniCollector:
+            def __init__(self, targets):
+                self._lock = threading.Lock()
+                self._targets = targets
+                self._rows = {}
+
+            def tick(self):
+                got = {n: scrape(fe) for n, fe in self._targets}
+                with self._lock:
+                    for name, row in got.items():
+                        self._rows[name] = row
+
+            def block(self):
+                with self._lock:
+                    return dict(self._rows)
+
+        class Sup:
+            def __init__(self, collector):
+                self.fleet = collector
+
+            def _loop(self):
+                self.fleet.tick()
+
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 name="mini-fleet-supervisor",
+                                 daemon=True).start()
+    """
+    findings, _ = _run(good)
+    assert not findings, [(f.rule, f.message) for f in findings]
+    src = {"apex_tpu/mod.py": textwrap.dedent(good)}
+    model, _ = build_model(src)
+    colored = {k.qualname for k, v in model.colors.items()
+               if "mini-fleet-supervisor" in v}
+    # the supervisor color reaches the tick AND its lock-free scrape
+    for fn in ("Sup._loop", "MiniCollector.tick", "scrape"):
+        assert fn in colored, sorted(colored)
+    guards = {(f[1], f[2]): lock.display()
+              for f, (lock, _, _) in model.inferred_guards().items()}
+    assert guards[("MiniCollector", "_rows")] == "MiniCollector._lock"
+    bad = good.replace("""\
+                with self._lock:
+                    for name, row in got.items():
+                        self._rows[name] = row
+""", """\
+                for name, row in got.items():
+                    self._rows[name] = row
+""")
+    assert bad != good, "mutation did not apply"
+    findings, _ = _run(bad)
+    assert "conc-unguarded-shared-field" in [f.rule for f in findings], \
+        [(f.rule, f.message) for f in findings]
+
+
 def test_promote_pairing_catches_dropped_promotion():
     """ISSUE 17: ``promote_pages`` pops device pages off the free stack
     exactly like an allocation; the obligation discharges when

@@ -1,0 +1,404 @@
+"""Fleet observability plane (apex_tpu/obs/fleet.py) — ISSUE 19.
+
+The acceptance bars, unit-tier (the over-the-wire integration bars —
+stitching across a real HTTP replica kill, remote scrape fidelity —
+live in tests/test_http.py):
+
+- trace ids are process-independent, traceparent round-trips, and
+  malformed headers degrade to None (a fresh mint), never an error;
+- ``stitch_traces`` merges per-replica span dumps into ONE lifecycle
+  per request across a failover: TTFT anchored at the FIRST replica's
+  first token, the failover gap counted into ``preempted_ms``, the
+  synthesized failover segment naming both replicas, zero orphans for
+  fully-bound dumps;
+- the burn-rate alerter is multi-window with pinned hysteresis under
+  injected clocks: a fast spike alone never fires, sustained burn
+  fires exactly once, and it resolves only below
+  ``threshold * hysteresis``;
+- federated rows reproduce replica-local registry values exactly
+  (``row_from_snapshot`` / ``_merged_quantile`` vs
+  ``Histogram.quantile``);
+- the flight bundle is schema-pinned: ``validate_flight`` accepts what
+  ``build_flight`` produces and names every missing key otherwise;
+- ``EventLog.since`` is an incremental cursor with gap detection (the
+  federation scrape's second endpoint).
+"""
+
+import numpy as np
+import pytest
+
+from apex_tpu.obs.events import EventLog
+from apex_tpu.obs.fleet import (BurnRateAlerter, FLIGHT_SCHEMA,
+                                FleetCollector, _merged_quantile,
+                                build_flight, mint_trace_id,
+                                parse_traceparent, row_from_snapshot,
+                                stitch_traces, traceparent,
+                                validate_flight)
+from apex_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.clear()
+    yield
+    metrics.clear()
+
+
+# --------------------------------------------------------------------------
+# trace ids
+# --------------------------------------------------------------------------
+
+def test_mint_trace_id_format_and_uniqueness():
+    ids = {mint_trace_id() for _ in range(256)}
+    assert len(ids) == 256
+    for tid in ids:
+        assert len(tid) == 32
+        assert all(c in "0123456789abcdef" for c in tid)
+
+
+def test_traceparent_round_trip():
+    tid = mint_trace_id()
+    header = traceparent(tid)
+    assert header == f"00-{tid}-{'0' * 16}-01"
+    assert parse_traceparent(header) == tid
+    # a bare 32-hex id is accepted too (the JSON-body carrier)
+    assert parse_traceparent(tid) == tid
+
+
+def test_parse_traceparent_malformed_degrades_to_none():
+    # malformed headers must degrade to a fresh mint (None), never 400
+    for bad in (None, "", "nonsense", "00-zz-00-01", "00-abc-def-01",
+                "00-" + "g" * 32 + "-0000000000000000-01", 123,
+                "0" * 31, "0" * 33):
+        assert parse_traceparent(bad) is None
+
+
+# --------------------------------------------------------------------------
+# stitching
+# --------------------------------------------------------------------------
+
+def _span(request_id, name, t0, t1, **attrs):
+    return {"request_id": request_id, "name": name, "t_start": t0,
+            "t_end": t1, "duration_ms": None if t1 is None
+            else (t1 - t0) * 1e3, "attrs": attrs}
+
+
+def test_stitch_traces_single_lifecycle_across_failover():
+    tid = mint_trace_id()
+    # replica0 serves enqueue..first_token then dies at t=3.0; replica1
+    # resumes at t=5.0 (the 2 s gap is the failover) and retires at 7.0
+    dumps = {
+        "replica0": [
+            _span(7, "enqueue", 1.0, 1.0, trace_id=tid),
+            _span(7, "admit", 1.5, 1.5),
+            _span(7, "prefill", 1.5, 2.0, computed_tokens=8,
+                  cached_tokens=0),
+            _span(7, "first_token", 2.0, 2.0),
+            _span(7, "decode", 2.0, 3.0, new_tokens=4),
+        ],
+        "replica1": [
+            _span(7, "enqueue", 5.0, 5.0, trace_id=tid),
+            _span(7, "admit", 5.0, 5.0),
+            _span(7, "prefill", 5.0, 5.5, computed_tokens=12,
+                  cached_tokens=8),
+            _span(7, "first_token", 5.5, 5.5),
+            _span(7, "decode", 5.5, 6.5, new_tokens=4),
+            _span(7, "retire", 7.0, 7.0),
+        ],
+    }
+    st = stitch_traces(dumps)
+    assert st["orphans"] == []
+    assert list(st["traces"]) == [tid]
+    tr = st["traces"][tid]
+    assert tr["trace_id"] == tid
+    assert tr["replicas"] == ["replica0", "replica1"]
+    assert tr["request_ids"] == [7]
+    # TTFT anchors at the FIRST replica's first token, not the resume
+    assert tr["ttft_ms"] == pytest.approx((2.0 - 1.0) * 1e3)
+    assert tr["total_ms"] == pytest.approx((7.0 - 1.0) * 1e3)
+    # the failover gap (replica0's last span end -> replica1's first
+    # span start) is preemption time from the caller's point of view
+    assert len(tr["failovers"]) == 1
+    fo = tr["failovers"][0]
+    assert fo["from_replica"] == "replica0"
+    assert fo["to_replica"] == "replica1"
+    assert fo["gap_ms"] == pytest.approx((5.0 - 3.0) * 1e3)
+    assert tr["preempted_ms"] == pytest.approx(fo["gap_ms"])
+    assert tr["preemptions"] == 1
+    # per-replica segments cover both sides, in failover order
+    assert [s["replica"] for s in tr["segments"]] == ["replica0",
+                                                      "replica1"]
+    assert tr["cached_tokens"] == 8      # the survivor's prefix hit
+
+
+def test_stitch_traces_unbound_spans_are_orphans():
+    tid = mint_trace_id()
+    dumps = {
+        "replica0": [_span(1, "enqueue", 0.0, 0.0, trace_id=tid),
+                     _span(1, "retire", 1.0, 1.0),
+                     _span(2, "admit", 0.5, 0.5)],   # no trace_id bound
+    }
+    st = stitch_traces(dumps)
+    assert list(st["traces"]) == [tid]
+    assert len(st["orphans"]) == 1
+    assert st["orphans"][0]["request_id"] == 2
+    assert st["orphans"][0]["replica"] == "replica0"
+
+
+# --------------------------------------------------------------------------
+# burn-rate alerting (injected clocks — the hysteresis pin)
+# --------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_burn_alert_fast_spike_alone_does_not_fire():
+    clk = _Clock()
+    a = BurnRateAlerter(threshold=0.1, fast_window_s=60.0,
+                        slow_window_s=600.0, clock=clk)
+    # one hot sample inside the fast window, long cold history behind
+    # it: the fast mean crosses, the slow mean does not -> no alert
+    for _ in range(60):
+        a.observe(0.0)
+        clk.t += 10.0
+    fired = a.observe(0.9)
+    assert fired is False and a.fired == 0 and not a.firing
+
+
+def test_burn_alert_fires_once_and_resolves_with_hysteresis():
+    clk = _Clock()
+    events = EventLog(capacity=64)
+    a = BurnRateAlerter(threshold=0.1, fast_window_s=60.0,
+                        slow_window_s=600.0, hysteresis=0.5,
+                        events=events, clock=clk)
+    # sustained burn fills BOTH windows -> fires exactly once
+    for _ in range(80):
+        a.observe(0.5)
+        clk.t += 10.0
+    assert a.firing and a.fired == 1
+    # above threshold*hysteresis (0.05): still firing (the hysteresis
+    # band suppresses flapping)
+    for _ in range(10):
+        a.observe(0.07)
+        clk.t += 10.0
+    assert a.firing and a.fired == 1
+    # below the resolve bound -> resolves
+    for _ in range(10):
+        a.observe(0.01)
+        clk.t += 10.0
+    assert not a.firing and a.fired == 1
+    kinds = [(e["kind"], e["state"]) for e in events.tail()
+             if e["kind"] == "fleet.alert"]
+    assert kinds == [("fleet.alert", "firing"),
+                     ("fleet.alert", "resolved")]
+
+
+def test_burn_alert_silent_on_steady_zero():
+    clk = _Clock()
+    a = BurnRateAlerter(threshold=0.1, clock=clk)
+    for _ in range(200):
+        a.observe(0.0)
+        clk.t += 5.0
+    assert a.fired == 0 and not a.firing
+
+
+def test_burn_alerter_validates_params():
+    for kw in ({"threshold": 0.0}, {"hysteresis": 1.5},
+               {"fast_window_s": 60.0, "slow_window_s": 30.0}):
+        with pytest.raises(ValueError):
+            BurnRateAlerter(**kw)
+
+
+# --------------------------------------------------------------------------
+# federation fidelity: merged quantiles == Histogram.quantile
+# --------------------------------------------------------------------------
+
+def test_row_from_snapshot_matches_local_registry():
+    labels = {"engine": "0"}
+    h = metrics.histogram("serving.ttft_ms", labels=labels)
+    rng = np.random.default_rng(0)
+    for v in rng.lognormal(3.0, 1.0, 500):
+        h.observe(float(v))
+    t = metrics.histogram("serving.tpot_ms", labels=labels)
+    for v in rng.lognormal(1.0, 0.5, 300):
+        t.observe(float(v))
+    metrics.gauge("serving.queue_depth", labels=labels).set(7)
+    metrics.gauge("serving.slo_burn", labels=labels).set(0.25)
+
+    row = row_from_snapshot(metrics.snapshot(), labels=labels)
+    # the federated row must reproduce the replica-local instruments
+    # EXACTLY (same bucket interpolation — the scrape fidelity bar)
+    assert row["ttft_ms_p95"] == pytest.approx(h.quantile(0.95))
+    assert row["tpot_ms_p95"] == pytest.approx(t.quantile(0.95))
+    assert row["queue_depth"] == 7
+    assert row["slo_burn"] == 0.25
+
+
+def test_merged_quantile_sums_replica_buckets():
+    h0 = metrics.histogram("serving.ttft_ms", labels={"engine": "0"})
+    h1 = metrics.histogram("serving.ttft_ms", labels={"engine": "1"})
+    both = metrics.histogram("merged.ttft_ms")
+    rng = np.random.default_rng(1)
+    for i, v in enumerate(rng.lognormal(3.0, 1.0, 400)):
+        (h0 if i % 2 else h1).observe(float(v))
+        both.observe(float(v))
+    snap = metrics.snapshot()
+    entries = [e for e in snap["histograms"]
+               if e["name"] == "serving.ttft_ms"]
+    assert len(entries) == 2
+    # fleet-level p95 over BOTH replicas == one histogram fed everything
+    # (identical bucket layout, so the merge is exact up to min/max
+    # clamping — use interior quantiles)
+    for q in (0.5, 0.9, 0.95):
+        assert _merged_quantile(entries, q) == pytest.approx(
+            both.quantile(q), rel=1e-9)
+
+
+# --------------------------------------------------------------------------
+# the collector over stub replicas (injected clock)
+# --------------------------------------------------------------------------
+
+class _StubFrontend:
+    def __init__(self, name, depth):
+        class _Eng:
+            obs_labels = {"engine": name}
+            events = EventLog(capacity=32)
+        self.engine = _Eng()
+        self.queue_depth = depth
+
+
+class _StubRouter:
+    def __init__(self, targets):
+        self._targets = targets
+
+    def fleet_targets(self):
+        return list(self._targets)
+
+
+def test_collector_federates_local_rows_and_staleness():
+    fe0, fe1 = _StubFrontend("0", 3), _StubFrontend("1", 5)
+    metrics.gauge("serving.slo_burn", labels={"engine": "0"}).set(0.4)
+    metrics.gauge("serving.slo_burn", labels={"engine": "1"}).set(0.1)
+    fe0.engine.events.emit("compile_storm", fn="decode")
+    fe1.engine.events.emit("admit", request=1)
+
+    clk = _Clock()
+    router = _StubRouter([("replica0", True, fe0),
+                          ("replica1", False, fe1)])
+    alerter = BurnRateAlerter(threshold=0.1, fast_window_s=60.0,
+                              slow_window_s=60.0, clock=clk)
+    col = FleetCollector(router, interval_s=0.05, alerter=alerter,
+                         clock=clk)
+    assert col.tick(force=True) is True
+    # throttle: a second tick inside interval_s is a no-op
+    assert col.tick() is False
+
+    block = col.block()
+    rows = {r["replica"]: r for r in block["per_replica"]}
+    assert rows["replica0"]["queue_depth"] == 3
+    assert rows["replica0"]["slo_burn"] == 0.4
+    assert rows["replica0"]["compile_storms"] == 1
+    # the dead replica is never scraped: zeros + alive=False
+    assert rows["replica1"]["alive"] is False
+    assert rows["replica1"]["slo_burn"] == 0.0
+    assert block["queue_depth"] == 3           # sum over scraped rows
+    assert block["slo_burn"] == 0.4            # max over live rows
+    assert block["replicas"] == 2
+    # fleet.* gauges carry replica= labels
+    g = metrics.gauge("fleet.slo_burn", labels={"replica": "replica0"})
+    assert g.value == 0.4
+    # staleness: the scrape age grows with the injected clock
+    clk.t += 2.0
+    assert col.scrape_ages()["replica0"] == pytest.approx(2.0)
+    assert col.scrape_ages()["replica1"] is None
+    # the burn fed the alerter (max over live rows)
+    assert alerter.windows()[0] == pytest.approx(0.4)
+
+
+# --------------------------------------------------------------------------
+# flight bundle schema
+# --------------------------------------------------------------------------
+
+def _flight_fixture():
+    tid = mint_trace_id()
+    dumps = {"replica0": [_span(1, "enqueue", 0.0, 0.0, trace_id=tid),
+                          _span(1, "retire", 1.0, 1.0)],
+             "replica1": []}
+    routing = [{"replica": "replica0", "alive": True, "draining": False,
+                "routed": 4, "dead_reason": None, "queue_depth": 2},
+               {"replica": "replica1", "alive": False,
+                "draining": False, "routed": 1,
+                "dead_reason": "InjectedFault('kill')",
+                "queue_depth": 0}]
+    return build_flight(
+        reason="replica_dead:1", routing=routing,
+        counters={"routed": 5, "failovers": 1},
+        router_events=[{"kind": "replica_dead", "seq": 0}],
+        dumps=dumps,
+        replica_events={"replica0": [{"kind": "admit", "seq": 0}],
+                        "replica1": [{"kind": "step", "seq": 3}]},
+        tag="t1")
+
+
+def test_build_flight_is_schema_valid_and_names_every_replica():
+    doc = _flight_fixture()
+    assert validate_flight(doc) is doc
+    assert doc["schema"] == FLIGHT_SCHEMA
+    assert set(doc["replicas"]) == {"replica0", "replica1"}
+    assert doc["replicas"]["replica1"]["events"] == [{"kind": "step",
+                                                      "seq": 3}]
+    assert doc["router"]["counters"]["failovers"] == 1
+    assert len(doc["traces"]) == 1 and doc["orphan_spans"] == []
+
+
+def test_validate_flight_names_every_problem():
+    doc = _flight_fixture()
+    doc.pop("traces")
+    doc["schema"] = "wrong/schema"
+    doc["replicas"]["replica0"].pop("queue_depth")
+    with pytest.raises(ValueError) as err:
+        validate_flight(doc)
+    msg = str(err.value)
+    assert "traces" in msg and "schema" in msg and "queue_depth" in msg
+    with pytest.raises(ValueError):
+        validate_flight({"schema": FLIGHT_SCHEMA})
+    with pytest.raises(ValueError):
+        validate_flight([])
+
+
+# --------------------------------------------------------------------------
+# the event cursor (the federation scrape's gap detector)
+# --------------------------------------------------------------------------
+
+def test_event_log_since_cursor_and_gap_detection():
+    log = EventLog(capacity=4)
+    for i in range(3):
+        log.emit("tick", i=i)
+    events, dropped = log.since(-1)
+    assert [e["seq"] for e in events] == [0, 1, 2] and dropped == 0
+    cursor = events[-1]["seq"]
+    events, dropped = log.since(cursor)
+    assert events == [] and dropped == 0
+    # the ring laps the cursor: 6 more events into capacity 4 — two of
+    # the post-cursor events are gone, and the scraper must learn it
+    for i in range(3, 9):
+        log.emit("tick", i=i)
+    events, dropped = log.since(cursor)
+    assert [e["seq"] for e in events] == [5, 6, 7, 8]
+    assert dropped == 2                  # seqs 3 and 4 lapped away
+
+
+def test_event_log_dump_with_cursor(tmp_path):
+    log = EventLog(capacity=8)
+    for i in range(5):
+        log.emit("tick", i=i)
+    import json
+    text = log.dump(str(tmp_path / "e.jsonl"), since_seq=1)
+    lines = [json.loads(ln) for ln in text.splitlines()]
+    assert lines[0]["since_seq"] == 1 and lines[0]["dropped"] == 0
+    assert [r["seq"] for r in lines[1:]] == [2, 3, 4]

@@ -36,12 +36,15 @@ import pytest
 
 from apex_tpu.models.generation import generate
 from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
+from apex_tpu.obs.fleet import (row_from_snapshot, stitch_traces,
+                                validate_flight)
 from apex_tpu.serving import (PagedDecodeEngine, ReplicaRouter, Request,
                               RouterPolicy, ServingFrontend,
                               free_page_count)
 from apex_tpu.serving.faults import FaultInjector, FaultSpec
 from apex_tpu.serving.http import (HttpReplicaClient, HttpServingServer,
                                    _iter_sse)
+from apex_tpu.utils import metrics
 
 
 @pytest.fixture(scope="module")
@@ -308,6 +311,74 @@ def test_drain_503_then_clean_shutdown(tiny, rng):
 
 
 # --------------------------------------------------------------------------
+# fleet plane over the wire: /events cursor + scrape fidelity
+# --------------------------------------------------------------------------
+
+def test_events_endpoint_since_seq_cursor(tiny):
+    """GET /events?since_seq= serves the engine ring incrementally: a
+    cursor past the last seq yields nothing new, a stale cursor reports
+    the gap as ``dropped``, and a malformed cursor is a 400 — the wire
+    half of the federation cursor contract (docs/observability.md)."""
+    with _serving(tiny) as (engine, _, srv):
+        for i in range(3):
+            engine.events.emit("probe", i=i)
+        status, body = _get(srv.port, "/events?since_seq=-1")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["kind"] == "event_log"
+        assert doc["since_seq"] == -1 and doc["dropped"] == 0
+        seqs = [e["seq"] for e in doc["events"]]
+        assert seqs == sorted(seqs) and len(seqs) >= 3
+        # incremental scrape from the last seen seq: empty, no gap
+        status, body = _get(srv.port, f"/events?since_seq={seqs[-1]}")
+        assert status == 200
+        tail = json.loads(body)
+        assert tail["events"] == [] and tail["dropped"] == 0
+        # new events land past the cursor
+        engine.events.emit("probe", i=99)
+        status, body = _get(srv.port, f"/events?since_seq={seqs[-1]}")
+        more = json.loads(body)["events"]
+        assert [e["kind"] for e in more] == ["probe"]
+        assert more[0]["seq"] == seqs[-1] + 1
+        # a malformed cursor is the client's fault, not a crash
+        assert _get(srv.port, "/events?since_seq=abc")[0] == 400
+
+
+def test_remote_scrape_fidelity(tiny, rng):
+    """The federated fleet row recomputed from a REMOTE replica's
+    ``/metrics.json`` scrape equals the row the replica computes from
+    its own in-process registry — p95s from wire-serialized buckets,
+    gauges, and queue depth all match (the scrape-fidelity bar)."""
+    cfg, model, v = tiny
+    metrics.clear()              # only this replica's series in play
+    try:
+        with _serving(tiny) as (engine, fe, srv):
+            for _ in range(3):
+                prompt = rng.integers(0, cfg.vocab_size,
+                                      (8,)).astype(np.int32)
+                toks, finish = _stream(
+                    srv.port, {"prompt": prompt.tolist(),
+                               "max_new_tokens": 4})
+                assert len(toks) == 4 and finish == "stop"
+            client = HttpReplicaClient("127.0.0.1", srv.port)
+            doc = client.fleet_scrape(-1)
+            remote = row_from_snapshot(doc["metrics"])
+            local = row_from_snapshot(metrics.snapshot(),
+                                      labels=engine.obs_labels)
+            local["queue_depth"] = fe.queue_depth
+            assert set(remote) == set(local)
+            for key, want in local.items():
+                assert remote[key] == pytest.approx(want), key
+            assert remote["ttft_ms_p95"] > 0.0
+            # the event half of the scrape carries the engine ring
+            edoc = doc["events"]
+            assert edoc["kind"] == "event_log"
+            assert edoc["total"] == engine.events.total
+    finally:
+        metrics.clear()
+
+
+# --------------------------------------------------------------------------
 # router over remote HTTP replicas — the networked kill bar
 # --------------------------------------------------------------------------
 
@@ -316,7 +387,14 @@ def test_router_over_http_replicas_kill_recovers_token_identical(
     """Two remote HTTP replicas behind one ReplicaRouter; replica 0's
     server dies mid-stream. Its in-flight requests must re-home to the
     survivor with delivered tokens folded in — outputs token-identical
-    to an unfailed run, nothing hung, both pools clean."""
+    to an unfailed run, nothing hung, both pools clean.
+
+    The fleet-plane half of the bar rides the same run: stitching the
+    two replicas' span dumps yields ONE trace per request (same
+    trace_id on both replicas for every failed-over request, zero
+    orphans), stitched TTFT anchors at the FIRST replica's first token,
+    ``preempted_ms`` covers the failover gap, and the death dumped a
+    schema-valid flight bundle naming both replicas' event rings."""
     cfg, model, v = tiny
     backends = []
     for i in range(2):
@@ -341,7 +419,18 @@ def test_router_over_http_replicas_kill_recovers_token_identical(
                         max_new_tokens=8) for _ in range(4)]
         handles = [router.submit(r, request_id=i)
                    for i, r in enumerate(reqs)]
-        time.sleep(0.25)                    # streams in flight
+        # wait until replica 0 has delivered a first token, so the kill
+        # lands mid-generation AND the stitched trace below has a
+        # pre-kill TTFT anchor (the stall spec keeps its remaining
+        # decode slow enough that the stream cannot finish first)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if any(s["name"] == "first_token"
+                   for s in clients[0].tracer.to_dicts()):
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("replica 0 never delivered a first token")
         backends[0][2].close()              # kill replica 0's server
         for h, r in zip(handles, reqs):
             np.testing.assert_array_equal(
@@ -358,6 +447,63 @@ def test_router_over_http_replicas_kill_recovers_token_identical(
     assert stats["failover_recovered_rate"] == 1.0
     assert stats["completed"] == 4 and stats["failed"] == 0
     assert _pool_settled(backends[1][0]), "survivor pool not clean"
+    assert stats["fleet"]["replicas"] == 2
+
+    # -- one stitched trace per request across the failover ---------------
+    dumps = {f"replica{i}": client.tracer.to_dicts()
+             for i, client in enumerate(clients)}
+    stitched = stitch_traces(dumps)
+    assert stitched["orphans"] == [], stitched["orphans"][:3]
+    assert len(stitched["traces"]) == 4
+    crossed = [t for t in stitched["traces"].values()
+               if len(t["replicas"]) == 2]
+    assert crossed, "no request failed over across replicas"
+    for trace in crossed:
+        # the request started on replica 0 and finished on the survivor
+        assert trace["replicas"] == ["replica0", "replica1"]
+        assert len(trace["failovers"]) == 1
+        fo = trace["failovers"][0]
+        assert (fo["from_replica"], fo["to_replica"]) == ("replica0",
+                                                          "replica1")
+        # the time in limbo between the kill and the re-home is
+        # preempted time, and nothing else was preempted here
+        assert trace["preempted_ms"] == pytest.approx(fo["gap_ms"])
+        # the same trace_id binds spans on BOTH replicas' dumps
+        tid = trace["trace_id"]
+        rid = trace["request_ids"][0]
+        for name in ("replica0", "replica1"):
+            bound = [s for s in dumps[name]
+                     if s["request_id"] == rid
+                     and (s.get("attrs") or {}).get("trace_id") == tid]
+            assert bound, f"{name} has no span bound to {tid}"
+    # TTFT anchors at the FIRST replica's first token (pre-failover),
+    # not at the resumed stream's first token on the survivor
+    anchored = 0
+    for trace in crossed:
+        rid = trace["request_ids"][0]
+        r0 = {s["name"]: s for s in dumps["replica0"]
+              if s["request_id"] == rid}
+        if "first_token" not in r0:
+            continue                     # killed before its first token
+        anchored += 1
+        want = (r0["first_token"]["t_start"]
+                - r0["enqueue"]["t_start"]) * 1e3
+        assert trace["ttft_ms"] == pytest.approx(want)
+        assert trace["ttft_ms"] < (trace["failovers"][0]["resume_t"]
+                                   - r0["enqueue"]["t_start"]) * 1e3
+    assert anchored, "no failed-over request had a pre-kill first token"
+
+    # -- the death dumped a flight bundle naming both replicas ------------
+    flight = router.last_flight
+    assert flight is not None, "replica death recorded no flight"
+    validate_flight(flight)
+    assert flight["reason"] == "replica_dead:0"
+    assert set(flight["replicas"]) == {"replica0", "replica1"}
+    for entry in flight["replicas"].values():
+        assert isinstance(entry["events"], list)
+    assert flight["replicas"]["replica0"]["alive"] is False
+    assert flight["replicas"]["replica1"]["alive"] is True
+    assert any(t["trace_id"] for t in flight["traces"].values())
 
 
 # --------------------------------------------------------------------------

@@ -425,13 +425,15 @@ def test_prometheus_exposition_golden_file():
 
 
 _PROM_LINE = re.compile(
-    r"^(?:# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|gauge|histogram)"
+    r"^(?:# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (?:counter|gauge|histogram)"
     r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? -?(?:[0-9.e+-]+|\+Inf))$")
 
 
 def test_serving_run_exposition_parses():
     """Acceptance: the Prometheus text exposition of a real serving run
-    parses line by line, and histogram buckets are cumulative."""
+    parses line by line, every family's # HELP line immediately
+    precedes its # TYPE line, and histogram buckets are cumulative."""
     rng = np.random.default_rng(2)
     cfg, engine = _tiny_engine()
     engine.run([Request(prompt=rng.integers(0, cfg.vocab_size, (9,)
@@ -441,11 +443,24 @@ def test_serving_run_exposition_parses():
     assert "serving_ttft_ms_bucket" in text
     assert "serving_slots_in_use" in text
     cums = []
-    for line in text.rstrip("\n").split("\n"):
+    lines = text.rstrip("\n").split("\n")
+    for line in lines:
         assert _PROM_LINE.match(line), f"unparseable line: {line!r}"
         if line.startswith("serving_ttft_ms_bucket"):
             cums.append(float(line.rsplit(" ", 1)[1]))
     assert cums == sorted(cums) and cums[-1] == 1.0
+    # HELP/TYPE pairing: exactly one HELP per family, named the same as
+    # — and directly above — its TYPE line (the exposition contract
+    # registered HELP text rides on; docs/observability.md)
+    helps = [i for i, ln in enumerate(lines) if ln.startswith("# HELP")]
+    types = [i for i, ln in enumerate(lines) if ln.startswith("# TYPE")]
+    assert helps and len(helps) == len(types)
+    for i in helps:
+        assert lines[i + 1].startswith("# TYPE")
+        assert lines[i].split(" ")[2] == lines[i + 1].split(" ")[2]
+    # a registered description is used verbatim; the fallback is generic
+    assert "# HELP serving_ttft_ms Time to first token per request" \
+           in text
 
 
 def test_exposition_survives_nan_and_inf():
@@ -610,6 +625,15 @@ def test_healthz_router_block(tmp_path):
     assert rows[0]["queue_depth"] == 3 and rows[0]["failure"] is None
     assert not rows[1]["alive"] and rows[1]["queue_depth"] is None
     assert "killed" in rows[1]["failure"]
+    # fleet-plane fields (ISSUE 19 satellite): every row carries the
+    # supervision-tick age, its failover count, and its federation
+    # scrape staleness — None/0 on a router without the fleet plane
+    for row in rows.values():
+        assert set(row) >= {"last_tick_age_s", "failovers",
+                            "scrape_age_s"}
+        assert row["last_tick_age_s"] is None    # stub has no tick
+        assert row["failovers"] == 0
+        assert row["scrape_age_s"] is None       # stub has no collector
 
     class _DeadRouter:
         replicas = [_StubReplica(0, False, 0,

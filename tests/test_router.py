@@ -590,3 +590,47 @@ def test_lifecycle_and_stats_surface(tiny, rng):
     assert 0.0 <= stats["prefix_hit_rate"] <= 1.0
     assert len(stats["per_replica"]) == 2
     assert time.time() > 0               # keep the import honest
+
+
+def test_burn_rate_alert_fires_on_violation_silent_on_steady(tiny, rng):
+    """ISSUE 19 acceptance: a fleet whose every request misses an
+    impossible TTFT deadline drives the federated ``slo_burn`` to 1 and
+    the router's burn-rate alerter FIRES (``fleet.alert`` in the router
+    ring, ``alerts_fired`` in the pinned fleet block); the same fleet
+    under deadline-free traffic stays silent. The hysteresis band
+    itself is pinned with injected clocks in tests/test_fleet.py —
+    this is the real-serving twin."""
+    cfg, model, v = tiny
+    router = _router(tiny, 2)
+    reqs = [Request(prompt=rng.integers(0, cfg.vocab_size, (12,)
+                                        ).astype(np.int32),
+                    max_new_tokens=4, deadline_ms=0.001)
+            for _ in range(6)]
+    handles = [router.submit(r, request_id=i)
+               for i, r in enumerate(reqs)]
+    router.drain()
+    for h in handles:
+        h.result(timeout=0)              # misses never drop requests
+    router.fleet.tick(force=True)        # sample the final burn
+    fleet = router.stats()["fleet"]
+    assert fleet["slo_burn"] == pytest.approx(1.0)
+    assert fleet["alerts_fired"] >= 1 and fleet["alert_firing"]
+    fired = [e for e in router.events.tail()
+             if e["kind"] == "fleet.alert"]
+    assert fired and fired[0]["state"] == "firing"
+    assert fired[0]["threshold"] == router.alerter.threshold
+
+    # deadline-free traffic on a FRESH fleet: burn stays 0, no alert
+    steady = _router(tiny, 2)
+    reqs = _reqs(cfg, rng, 6, max_new=4)
+    handles = [steady.submit(r, request_id=i)
+               for i, r in enumerate(reqs)]
+    steady.drain()
+    for h in handles:
+        h.result(timeout=0)
+    steady.fleet.tick(force=True)
+    fleet = steady.stats()["fleet"]
+    assert fleet["slo_burn"] == 0.0
+    assert fleet["alerts_fired"] == 0 and not fleet["alert_firing"]
+    assert not any(e["kind"] == "fleet.alert"
+                   for e in steady.events.tail())
