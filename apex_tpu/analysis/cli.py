@@ -174,10 +174,13 @@ def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="apex-tpu-lint",
         description="AST + jaxpr-IR + host-concurrency + memory-budget "
-                    "static analysis for jit/Pallas/serving hazards "
-                    "(four tiers: source, staged jaxprs, the host "
-                    "threading/lock/resource discipline of the serving "
-                    "stack, and per-chip HBM/VMEM fit proofs)")
+                    "+ wire-contract static analysis for "
+                    "jit/Pallas/serving hazards (five tiers: source, "
+                    "staged jaxprs, the host threading/lock/resource "
+                    "discipline of the serving stack, per-chip "
+                    "HBM/VMEM fit proofs, and producer/consumer drift "
+                    "proofs for the string-keyed observability "
+                    "surface)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to scan (default: apex_tpu/, "
                         "tpu_*.py, bench*.py under --root)")
@@ -217,13 +220,21 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mem-case", default=None, metavar="NAME",
                    help="mem tier for ONE registered case (implies "
                         "--mem)")
+    p.add_argument("--contract", action="store_true",
+                   help="run the wire/observability contract tier "
+                        "instead: index every metric family, event "
+                        "kind, HTTP route, SSE frame, schema pin and "
+                        "ledger class against its consumers (docs "
+                        "catalogs, goldens, validators, parsers) and "
+                        "prove both directions agree")
     p.add_argument("--diff", default=None, metavar="BASE_REV",
                    help="fail only on findings introduced relative to "
                         "this git rev. Default: AST module rules + the "
-                        "conc tier (source-only, so the base rev is "
-                        "analyzable from git history). With --mem: the "
-                        "mem tier on both sides — the base side runs in "
-                        "a temporary worktree of the base rev")
+                        "conc and contract tiers (source-only, so the "
+                        "base rev is analyzable from git history). "
+                        "With --mem: the mem tier on both sides — the "
+                        "base side runs in a temporary worktree of the "
+                        "base rev")
     return p
 
 
@@ -293,44 +304,88 @@ def _base_rev_sources(root: Path, rev: str) -> "dict[str, str]":
     return sources
 
 
+def _base_rev_texts(root: Path, rev: str) -> "dict[str, str]":
+    """The contract tier's text surface (docs catalogs + goldens) as it
+    existed at ``rev``. Missing paths are simply absent — a base rev
+    that predates a catalog contributes no consumer entries, so
+    everything the current catalog pins reads as new."""
+    import subprocess
+
+    from apex_tpu.analysis.contract import TEXT_SURFACE
+
+    proc = subprocess.run(
+        ["git", "-C", str(root), "cat-file", "--batch"],
+        input="\n".join(f"{rev}:{rel}"
+                        for rel in TEXT_SURFACE).encode(),
+        capture_output=True)
+    if proc.returncode != 0:
+        raise ValueError(
+            f"git cat-file failed: {proc.stderr.decode().strip()}")
+    texts: "dict[str, str]" = {}
+    buf, pos = proc.stdout, 0
+    for rel in TEXT_SURFACE:
+        nl = buf.index(b"\n", pos)
+        header = buf[pos:nl].decode()
+        pos = nl + 1
+        if header.endswith(("missing", "ambiguous")):
+            continue                    # path absent at rev
+        size = int(header.rsplit(" ", 1)[1])
+        texts[rel] = buf[pos:pos + size].decode(errors="replace")
+        pos += size + 1                 # trailing newline after content
+    return texts
+
+
 def _run_diff(args, root: Path, select) -> int:
-    """Diff-aware mode: current module-rule AND conc-tier findings,
-    minus whatever the base rev already had (counted with the same
-    line-number-free ``path::rule::scope`` keys the baseline uses).
-    Both tiers are source-only, so the base side is fully analyzable
-    from git history. Project rules are skipped on both sides — they
-    need an on-disk tree; the absolute gate still runs them."""
+    """Diff-aware mode: current module-rule, conc-tier AND
+    contract-tier findings, minus whatever the base rev already had
+    (counted with the same line-number-free ``path::rule::scope`` keys
+    the baseline uses). All three tiers are source-only, so the base
+    side is fully analyzable from git history (the contract tier's
+    text surface rides along via ``_base_rev_texts``). Project rules
+    are skipped on both sides — they need an on-disk tree; the
+    absolute gate still runs them."""
     from collections import Counter
 
     from apex_tpu.analysis.conc.conc_report import (analyze_conc_sources,
                                                     build_model)
     from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+    from apex_tpu.analysis.contract import (analyze_contract_sources,
+                                            read_text_surface)
+    from apex_tpu.analysis.contract.contract_rules import CONTRACT_RULES
 
-    ast_sel = conc_sel = None
+    ast_sel = conc_sel = contract_sel = None
     if select is not None:
         ast_sel = [s for s in select if s in RULES]
         conc_sel = [s for s in select if s in CONC_RULES]
+        contract_sel = [s for s in select if s in CONTRACT_RULES]
 
-    def both_tiers(sources):
-        """AST module rules + conc rules over ONE parse+link of a
-        surface (each side of the diff pays the parse once)."""
+    def all_tiers(sources, texts):
+        """AST module rules + conc rules + contract rules over ONE
+        parse+link of a surface (each side of the diff pays the parse
+        once; the text surface feeds only the contract tier)."""
         model, findings = build_model(sources)
         ast_f, ast_supp = analyze_sources(
             sources, select=ast_sel, modules=model.modules)
         conc_f, conc_supp = analyze_conc_sources(
             sources, select=conc_sel, model=model)
-        return findings + ast_f + conc_f, ast_supp + conc_supp
+        con_f, con_supp = analyze_contract_sources(
+            {**sources, **texts}, select=contract_sel,
+            modules=model.modules)
+        return (findings + ast_f + conc_f + con_f,
+                ast_supp + conc_supp + con_supp)
 
     try:
         base_sources = _base_rev_sources(root, args.diff)
+        base_texts = _base_rev_texts(root, args.diff)
     except ValueError as e:
         print(f"error: --diff {args.diff}: {e}", file=sys.stderr)
         return 2
-    base_findings, _ = both_tiers(base_sources)
+    base_findings, _ = all_tiers(base_sources, base_texts)
     base = Baseline(Counter(f.baseline_key() for f in base_findings))
 
     cur_sources, findings = read_sources(root)
-    cur_findings, suppressed = both_tiers(cur_sources)
+    cur_findings, suppressed = all_tiers(cur_sources,
+                                         read_text_surface(root))
     findings += cur_findings
     new, absorbed = base.split(findings)
     if args.format == "json":
@@ -438,12 +493,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.mem = True
     if args.list_rules:
         from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+        from apex_tpu.analysis.contract.contract_rules import \
+            CONTRACT_RULES
         from apex_tpu.analysis.ir.ir_rules import IR_RULES
         from apex_tpu.analysis.mem.mem_rules import MEM_RULES
 
         width = max(len(n) for n in
                     list(RULES) + list(IR_RULES) + list(CONC_RULES)
-                    + list(MEM_RULES))
+                    + list(MEM_RULES) + list(CONTRACT_RULES))
         for name, r in sorted(RULES.items()):
             kind = "project" if r.project else "module"
             print(f"{name:<{width}}  {r.severity:<7} ast:{kind:<7} "
@@ -457,6 +514,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for name, r in sorted(MEM_RULES.items()):
             print(f"{name:<{width}}  {r.severity:<7} mem:budget  "
                   f"{r.summary}")
+        for name, r in sorted(CONTRACT_RULES.items()):
+            print(f"{name:<{width}}  {r.severity:<7} contract:wire "
+                  f"{r.summary}")
         return 0
 
     root = Path(args.root)
@@ -465,9 +525,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
-    if sum((args.ir, args.conc, args.mem)) > 1:
-        print("error: --ir, --conc and --mem are separate tiers; run "
-              "them in separate invocations", file=sys.stderr)
+    if sum((args.ir, args.conc, args.mem, args.contract)) > 1:
+        print("error: --ir, --conc, --mem and --contract are separate "
+              "tiers; run them in separate invocations", file=sys.stderr)
         return 2
     if args.diff is not None:
         if args.ir:
@@ -479,6 +539,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.conc:
             print("error: --diff already covers the conc tier; drop "
                   "--conc", file=sys.stderr)
+            return 2
+        if args.contract:
+            print("error: --diff already covers the contract tier; "
+                  "drop --contract", file=sys.stderr)
             return 2
         if args.write_baseline or args.baseline:
             print("error: --diff uses the base rev's findings AS the "
@@ -505,8 +569,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return _run_mem_diff(args, root, select)
             if select:
                 from apex_tpu.analysis.conc.conc_rules import CONC_RULES
+                from apex_tpu.analysis.contract.contract_rules import \
+                    CONTRACT_RULES
 
-                unknown = set(select) - set(RULES) - set(CONC_RULES)
+                unknown = (set(select) - set(RULES) - set(CONC_RULES)
+                           - set(CONTRACT_RULES))
                 if unknown:
                     raise ValueError("unknown rule(s): "
                                      + ", ".join(sorted(unknown)))
@@ -535,6 +602,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from apex_tpu.analysis.conc import analyze_conc
 
             findings, suppressed = analyze_conc(root, select=select)
+        elif args.contract:
+            if args.paths:
+                print("error: --contract indexes the whole default "
+                      "surface plus the docs/golden text surface (a "
+                      "producer and its consumer live in different "
+                      "files); drop the explicit paths",
+                      file=sys.stderr)
+                return 2
+            from apex_tpu.analysis.contract import analyze_contract
+
+            findings, suppressed = analyze_contract(root, select=select)
         elif args.mem:
             if args.paths:
                 print("error: --mem lints registered entry points, not "
@@ -573,7 +651,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # (tier membership comes from the rule-namespace registry in
         # analysis/tiers.py, not per-tier string checks)
         active = "ir" if args.ir else "conc" if args.conc \
-            else "mem" if args.mem else "ast"
+            else "mem" if args.mem \
+            else "contract" if args.contract else "ast"
         keep = {k: v for k, v in existing.counts.items()
                 if tier_of_key(k) != active}
         if args.ir and args.ir_case:

@@ -1,0 +1,156 @@
+"""Orchestration for the tpu-lint contract tier.
+
+:func:`analyze_contract_sources` is the engine: split the scanned
+surface into its python half (parsed with the same ``parse_sources``
+the other tiers use) and its text half (the docs catalogs and the
+golden exposition), build the :class:`~apex_tpu.analysis.contract.
+extract.ContractIndex`, run the selected ``contract-*`` rules, and
+apply inline suppressions — the ordinary tokenize-based pragmas for
+``.py`` files, a line-regex variant (:class:`TextSuppressions`) for the
+markdown/prom files tokenize cannot read. Purely syntactic (stdlib
+``ast`` + text, no jax import), so ``--diff`` can run it against a git
+base rev's sources like the AST and conc tiers.
+
+:func:`analyze_contract` is the disk-backed wrapper the CLI uses: the
+same default python surface as every other tier, plus the fixed
+:data:`TEXT_SURFACE` consumer files. Like the conc tier it always
+analyzes the full surface — a producer and its consumer are usually in
+different files, so path subsets would fabricate drift.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from apex_tpu.analysis.contract.contract_rules import CONTRACT_RULES
+from apex_tpu.analysis.contract.extract import ContractIndex, build_index
+from apex_tpu.analysis.suppressions import Suppressions, _parse_rules
+from apex_tpu.analysis.walker import Finding, ModuleIndex
+
+#: the non-python consumer surface, relative to the repo root — docs
+#: catalogs (markdown tables) and the golden Prometheus exposition
+TEXT_SURFACE = (
+    "docs/observability.md",
+    "docs/http.md",
+    "docs/router.md",
+    "tests/golden/observability.prom",
+)
+
+_TEXT_PRAGMA = re.compile(r"tpu-lint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+
+
+class TextSuppressions:
+    """Inline-suppression pragmas for non-python files (markdown, prom)
+    — same syntax, found by line regex instead of tokenize. A pragma
+    covers its own line and the next one, so a table row can be
+    suppressed by an HTML comment (``<!-- tpu-lint: disable=rule --
+    why -->``) on the line above it."""
+
+    def __init__(self, text: str):
+        self._by_line: Dict[int, frozenset] = {}
+        for i, line in enumerate(text.splitlines(), 1):
+            m = _TEXT_PRAGMA.search(line)
+            if not m:
+                continue
+            rules = _parse_rules(m.group(1))
+            if not rules:
+                continue
+            for ln in (i, i + 1):
+                self._by_line[ln] = self._by_line.get(
+                    ln, frozenset()) | rules
+        self.count = len(self._by_line)
+
+    def covers(self, finding: Finding) -> bool:
+        last = max(finding.line, finding.end_line or finding.line)
+        for ln in range(finding.line, last + 1):
+            rules = self._by_line.get(ln, ())
+            if finding.rule in rules or "all" in rules:
+                return True
+        return False
+
+
+def split_surface(sources: Dict[str, str]
+                  ) -> Tuple[Dict[str, str], Dict[str, str]]:
+    py = {k: v for k, v in sources.items() if k.endswith(".py")}
+    texts = {k: v for k, v in sources.items() if not k.endswith(".py")}
+    return py, texts
+
+
+def build_contract_index(sources: Dict[str, str], *,
+                         modules: Optional[Dict[str, ModuleIndex]] = None,
+                         ) -> Tuple[ContractIndex, List[Finding]]:
+    """Index one surface; returns the index and any parse-error
+    findings. ``modules`` supplies an already-parsed python half (what
+    ``--diff`` uses so one parse feeds all source-only tiers — the
+    caller then owns its parse-error findings)."""
+    from apex_tpu.analysis.cli import parse_sources
+
+    py, texts = split_surface(sources)
+    findings: List[Finding] = []
+    if modules is None:
+        modules, findings = parse_sources(py)
+    return build_index(modules, texts), findings
+
+
+def analyze_contract_sources(sources: Dict[str, str], *,
+                             select: Optional[Iterable[str]] = None,
+                             modules: Optional[
+                                 Dict[str, ModuleIndex]] = None,
+                             ) -> Tuple[List[Finding], int]:
+    """Run the contract rules over an in-memory ``{rel path: content}``
+    map (python and text files together); returns ``(surviving
+    findings, #suppressed)``."""
+    chosen = set(select) if select is not None else set(CONTRACT_RULES)
+    unknown = chosen - set(CONTRACT_RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown contract rule(s): {', '.join(sorted(unknown))}")
+    index, findings = build_contract_index(sources, modules=modules)
+    raw: List[Finding] = []
+    for name in sorted(chosen):
+        raw.extend(CONTRACT_RULES[name].check(index))
+    suppressed = 0
+    supp_cache: Dict[str, object] = {}
+    for f in raw:
+        supp = supp_cache.get(f.path)
+        if supp is None:
+            content = sources.get(f.path, "")
+            supp = Suppressions(content) if f.path.endswith(".py") \
+                else TextSuppressions(content)
+            supp_cache[f.path] = supp
+        if supp.covers(f):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def read_text_surface(root) -> Dict[str, str]:
+    """The :data:`TEXT_SURFACE` files that exist under ``root``."""
+    out: Dict[str, str] = {}
+    base = Path(root).resolve()
+    for rel in TEXT_SURFACE:
+        p = base / rel
+        if p.is_file():
+            try:
+                out[rel] = p.read_text(encoding="utf-8",
+                                       errors="replace")
+            except OSError:
+                continue
+    return out
+
+
+def analyze_contract(root, *, select: Optional[Iterable[str]] = None,
+                     ) -> Tuple[List[Finding], int]:
+    """Disk-backed run: the default python lint surface plus the text
+    consumer surface under ``root``."""
+    from apex_tpu.analysis.cli import read_sources
+
+    sources, findings = read_sources(Path(root).resolve())
+    merged = dict(sources)
+    merged.update(read_text_surface(root))
+    more, suppressed = analyze_contract_sources(merged, select=select)
+    findings.extend(more)
+    return findings, suppressed

@@ -1,11 +1,12 @@
 """Shared rule-namespace registry for tpu-lint's tiers.
 
-The four tiers (AST, jaxpr IR, host-concurrency, memory-budget) share
-one CLI, one suppression-pragma syntax, and one baseline file; what
-keeps them from clobbering each other's recorded debt is the RULE
-NAMESPACE: ``ir-*`` rules belong to the IR tier, ``conc-*`` to the
-concurrency tier, ``mem-*`` to the memory tier, and everything else to
-the AST tier. This module is the single place that
+The five tiers (AST, jaxpr IR, host-concurrency, memory-budget,
+wire/observability contracts) share one CLI, one suppression-pragma
+syntax, and one baseline file; what keeps them from clobbering each
+other's recorded debt is the RULE NAMESPACE: ``ir-*`` rules belong to
+the IR tier, ``conc-*`` to the concurrency tier, ``mem-*`` to the
+memory tier, ``contract-*`` to the contract tier, and everything else
+to the AST tier. This module is the single place that
 mapping lives — ``cli.py``'s tier-partitioned ``--write-baseline`` and
 any future consumer derive a rule's tier from here instead of
 re-implementing per-tier string checks (which is how the IR tier's
@@ -22,6 +23,7 @@ TIER_PREFIXES = (
     ("ir-", "ir"),
     ("conc-", "conc"),
     ("mem-", "mem"),
+    ("contract-", "contract"),
 )
 
 AST_TIER = "ast"

@@ -337,3 +337,63 @@ def serve(port: int = 0, host: str = "127.0.0.1",
                               name="apex-tpu-metrics", daemon=True)
     thread.start()
     return server
+
+
+# --------------------------------------------------------------------------
+# golden regeneration (``python -m apex_tpu.obs.export --golden``)
+# --------------------------------------------------------------------------
+
+def seed_golden_registry() -> None:
+    """Seed the registry with the FIXED state the golden exposition
+    pins (``tests/golden/observability.prom``). One representative of
+    every exposition shape, each a real production family (the contract
+    tier proves golden families against registered instruments): an
+    unlabeled counter, a labeled counter, a gauge, a histogram with its
+    ``_bucket``/``_sum``/``_count`` triplet, and a raw ``record()``
+    series with its ``_count``/``_mean``/``_last`` gauges. Clears the
+    registry first — the golden describes exactly this state."""
+    metrics.clear()
+    metrics.counter("serving.admitted").inc(3)
+    metrics.counter("jit.compiles", labels={"fn": "decode_step"}).inc(2)
+    metrics.gauge("kv_pool.free_pages").set(12)
+    h = metrics.histogram("serving.ttft_ms", base=1.0, growth=2.0,
+                          n_buckets=6)
+    for v in (0.5, 1.0, 3.0, 100.0):
+        h.observe(v)
+    metrics.record("serving.decode_steps", 9)
+
+
+def _default_golden_path() -> str:
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tests", "golden", "observability.prom")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.obs.export",
+        description="Regenerate the golden Prometheus exposition from "
+                    "the canonical seeded registry state (instead of "
+                    "hand-editing it).")
+    parser.add_argument("--golden", action="store_true", required=True,
+                        help="write the golden exposition file")
+    parser.add_argument("--out", default=None,
+                        help="output path (default: the in-repo "
+                             "tests/golden/observability.prom)")
+    args = parser.parse_args(argv)
+    path = args.out or _default_golden_path()
+    seed_golden_registry()
+    text = prometheus_text()
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"[export] golden exposition written to {path} "
+          f"({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -543,6 +543,10 @@ class HttpServingServer:
         n = 0
         finish = "stop"
         try:
+            # the `start` frame is informational preamble; both in-repo
+            # clients key on token/done/error and skip unknown events,
+            # per the SSE spec. Kept for curl users and future clients.
+            # tpu-lint: disable=contract-endpoint-undocumented -- see above
             await self._sse(writer, "start", {"request_id": rid})
             while True:
                 dl = ttft_dl if (n == 0 and ttft_dl is not None) \

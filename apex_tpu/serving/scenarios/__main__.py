@@ -169,7 +169,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             json.dump(doc, f, indent=2, sort_keys=True)
         print(f"[scenarios] report written to {args.json}")
     if args.fleet:
-        fleet_out = {"schema": "apex-tpu/fleet/v1", "seed": doc_seed,
+        fleet_out = {"schema": report.FLEET_DOC_SCHEMA, "seed": doc_seed,
                      "time_unix": round(time.time(), 3),
                      "scenarios": fleets}
         with open(args.fleet, "w") as f:

@@ -30,6 +30,11 @@ __all__ = ["REPORT_SCHEMA", "SCENARIOS_SCHEMA", "AGGREGATE_FIELDS",
 REPORT_SCHEMA = "apex-tpu/scenario-report/v1"
 #: the multi-scenario CLI document wrapping one report per scenario
 SCENARIOS_SCHEMA = "apex-tpu/scenarios/v1"
+#: the ``--fleet`` sidecar document (per-scenario federated fleet
+#: blocks). Write-only CI evidence — banked per round for human review,
+#: nothing in-repo reads it back, hence no paired validator.
+# tpu-lint: disable=contract-schema-unpinned -- write-only CI evidence
+FLEET_DOC_SCHEMA = "apex-tpu/fleet/v1"
 
 #: pinned aggregate keys — every report carries exactly these
 AGGREGATE_FIELDS = (

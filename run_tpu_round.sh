@@ -54,10 +54,23 @@ if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --mem; then
   echo "  hazards; fix or suppress with justification (docs/static_analysis.md)"
   exit 1
 fi
+# Contract tier: producer/consumer drift proofs for the string-keyed
+# observability surface — metric families vs the docs catalog and the
+# golden exposition, event kinds vs their readers, HTTP routes + SSE
+# frames vs both sides of the socket, schema pins vs their validators,
+# ledger extraction vs gating classes. A renamed gauge or a dropped
+# frame kind should die here, not as a flat dashboard weeks later.
+echo "[$(date +%H:%M:%S)] tpu-lint static-analysis gate (contract tier)..."
+if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --contract; then
+  echo "[$(date +%H:%M:%S)] tpu-lint --contract found wire/observability"
+  echo "  drift; fix or suppress with justification (docs/static_analysis.md)"
+  exit 1
+fi
 # diff-aware gate: when CI exports LINT_DIFF_BASE (e.g. the PR merge
-# base), ALSO fail on AST + conc findings introduced relative to it —
-# catches regressions even if someone grows the baseline file in the
-# same PR (both tiers are source-only, so the base rev is analyzable)
+# base), ALSO fail on AST + conc + contract findings introduced relative
+# to it — catches regressions even if someone grows the baseline file in
+# the same PR (all three tiers are source-only, so the base rev is
+# analyzable)
 if [ -n "${LINT_DIFF_BASE:-}" ]; then
   echo "[$(date +%H:%M:%S)] tpu-lint diff gate vs ${LINT_DIFF_BASE}..."
   if ! JAX_PLATFORMS=cpu python -m apex_tpu.analysis --diff "$LINT_DIFF_BASE"; then

@@ -34,6 +34,7 @@ from jax import lax
 from apex_tpu.models.gpt import GPTModel, gpt_tiny_config
 from apex_tpu.obs import (EventLog, SpanTracer, json_snapshot,
                           prometheus_text, serve, write_snapshot)
+from apex_tpu.obs import export
 from apex_tpu.serving import PagedDecodeEngine, Request, kv_pool
 from apex_tpu.utils import metrics
 
@@ -408,14 +409,10 @@ def test_observe_pool_direct():
 # --------------------------------------------------------------------------
 
 def _seed_golden_registry():
-    metrics.counter("requests", labels={"route": "decode"}).inc(2)
-    metrics.counter("serving.admitted").inc(3)
-    metrics.gauge("kv_pool.free_pages").set(12)
-    h = metrics.histogram("demo_latency_ms", base=1.0, growth=2.0,
-                          n_buckets=6)
-    for v in (0.5, 1.0, 3.0, 100.0):
-        h.observe(v)
-    metrics.record("serving.decode_steps", 9)
+    # the canonical seeded state lives in export.py so the golden can
+    # be regenerated (`python -m apex_tpu.obs.export --golden`) instead
+    # of hand-edited — the test and the regenerator CANNOT drift
+    export.seed_golden_registry()
 
 
 def test_prometheus_exposition_golden_file():
@@ -505,8 +502,8 @@ def test_json_snapshot_and_write(tmp_path):
     doc = json_snapshot(extra={"tag": "t"})
     assert doc["tag"] == "t"
     hists = {h["name"]: h for h in doc["histograms"]}
-    assert hists["demo_latency_ms"]["count"] == 4
-    assert hists["demo_latency_ms"]["buckets"][-1] == [None, 4]
+    assert hists["serving.ttft_ms"]["count"] == 4
+    assert hists["serving.ttft_ms"]["buckets"][-1] == [None, 4]
 
     path = write_snapshot(str(tmp_path / "snap.json"))
     with open(path) as f:
